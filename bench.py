@@ -1,0 +1,90 @@
+"""Headline benchmark: KMeans Lloyd-iteration throughput (samples/sec/chip).
+
+Mirrors the reference's flagship benchmark workload — KMeans on a large blob
+dataset (reference: benchmarks/k_means_kdd.py runs k=8 over ~4.9M×41;
+BASELINE.md config #1 is make_blobs 1e6×50, k=8). We time a fixed number of
+Lloyd iterations of the jitted SPMD loop on the accelerator and compare
+against scikit-learn's Lloyd on the host CPU (the reference's own qualitative
+baseline is "2-3x over scikit-learn", cluster/k_means.py:117-121).
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+"""
+
+import json
+import time
+
+import numpy as np
+
+N_SAMPLES = 1_000_000
+N_FEATURES = 50
+N_CLUSTERS = 8
+N_ITER = 20
+SK_SAMPLES = 200_000  # sklearn baseline runs a smaller slice, scaled by work
+
+
+def bench_tpu():
+    import jax
+    import jax.numpy as jnp
+
+    from dask_ml_tpu import datasets
+    from dask_ml_tpu.models import kmeans as core
+    from dask_ml_tpu.parallel.sharding import prepare_data
+
+    X, _ = datasets.make_blobs(
+        n_samples=N_SAMPLES, n_features=N_FEATURES, centers=N_CLUSTERS,
+        cluster_std=2.0, random_state=0,
+    )
+    data = prepare_data(np.asarray(X))
+    key = jax.random.key(0)
+    centers0 = core.init_random(data.X, data.weights, data.n, N_CLUSTERS, key)
+    tol = jnp.asarray(0.0, jnp.float32)
+
+    # compile + warm up the single-program Lloyd loop
+    out = core.lloyd_loop(data.X, data.weights, centers0, tol, N_ITER)
+    jax.block_until_ready(out)
+
+    t0 = time.perf_counter()
+    centers, inertia, n_iter, _ = core.lloyd_loop(
+        data.X, data.weights, centers0, tol, N_ITER
+    )
+    jax.block_until_ready(centers)
+    dt = time.perf_counter() - t0
+    iters = max(int(n_iter), 1)
+    return N_SAMPLES * iters / dt, float(inertia)
+
+
+def bench_sklearn_baseline():
+    from sklearn.cluster import KMeans as SKKMeans
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(SK_SAMPLES, N_FEATURES).astype(np.float32) * 2.0
+    init = X[rng.choice(SK_SAMPLES, N_CLUSTERS, replace=False)]
+    km = SKKMeans(
+        n_clusters=N_CLUSTERS, init=init, n_init=1, max_iter=N_ITER,
+        tol=0.0, algorithm="lloyd",
+    )
+    t0 = time.perf_counter()
+    km.fit(X)
+    dt = time.perf_counter() - t0
+    iters = max(int(km.n_iter_), 1)
+    return SK_SAMPLES * iters / dt
+
+
+def main():
+    tpu_throughput, _ = bench_tpu()
+    sk_throughput = bench_sklearn_baseline()
+    print(
+        json.dumps(
+            {
+                "metric": "kmeans_lloyd_throughput",
+                "value": round(tpu_throughput, 1),
+                "unit": "samples/sec/chip",
+                "vs_baseline": round(tpu_throughput / sk_throughput, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

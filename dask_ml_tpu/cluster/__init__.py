@@ -1,0 +1,3 @@
+"""Clustering estimators (reference: dask_ml/cluster/__init__.py)."""
+
+from dask_ml_tpu.cluster.k_means import KMeans  # noqa: F401

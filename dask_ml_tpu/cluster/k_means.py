@@ -1,0 +1,154 @@
+"""KMeans estimator over the jitted Lloyd/k-means|| core
+(reference: cluster/k_means.py:26-216 ``KMeans``).
+
+The sklearn-style shell keeps the reference's API (constructor signature,
+trailing-underscore learned attributes) while the compute path is the pure
+functional core in :mod:`dask_ml_tpu.models.kmeans`: one XLA program for the
+whole Lloyd optimization, SPMD over the data-sharded mesh.
+"""
+
+from __future__ import annotations
+
+import logging
+from timeit import default_timer as tic
+
+import jax.numpy as jnp
+import numpy as np
+from sklearn.base import BaseEstimator, TransformerMixin
+
+from dask_ml_tpu.models import kmeans as core
+from dask_ml_tpu.ops.pairwise import euclidean_distances
+from dask_ml_tpu.parallel.sharding import prepare_data, unpad_rows
+from dask_ml_tpu.utils.validation import check_array, check_random_state
+
+logger = logging.getLogger(__name__)
+
+
+class KMeans(TransformerMixin, BaseEstimator):
+    """Scalable KMeans with k-means|| initialization.
+
+    Parameters mirror the reference estimator
+    (reference: cluster/k_means.py:26-141):
+
+    n_clusters : int, default 8
+    init : {'k-means||', 'k-means++', 'random'} or ndarray
+        'k-means||' (default) is the parallel oversampling init of Bahmani
+        et al.; 'k-means++' materializes data on the host and is only
+        sensible for modest n (same caveat as the reference).
+    oversampling_factor : float, default 2
+        ℓ = oversampling_factor · n_clusters candidates drawn per init round.
+    max_iter : int, default 300
+    tol : float, default 1e-4 — scaled by mean feature variance, as in
+        sklearn and the reference.
+    random_state : int, jax PRNG key, or None
+    init_max_iter : int or None — cap on k-means|| rounds.
+    n_jobs / precompute_distances / copy_x / algorithm are accepted for
+        signature parity and ignored (placement is the mesh's job).
+
+    Attributes
+    ----------
+    cluster_centers_ : (n_clusters, n_features) ndarray
+    labels_ : (n_samples,) ndarray
+    inertia_ : float
+    n_iter_ : int
+    """
+
+    def __init__(
+        self,
+        n_clusters: int = 8,
+        init: str = "k-means||",
+        oversampling_factor: float = 2.0,
+        max_iter: int = 300,
+        tol: float = 1e-4,
+        precompute_distances: str = "auto",
+        random_state=None,
+        copy_x: bool = True,
+        n_jobs: int = 1,
+        algorithm: str = "full",
+        init_max_iter=None,
+    ):
+        self.n_clusters = n_clusters
+        self.init = init
+        self.oversampling_factor = oversampling_factor
+        self.max_iter = max_iter
+        self.tol = tol
+        self.precompute_distances = precompute_distances
+        self.random_state = random_state
+        self.copy_x = copy_x
+        self.n_jobs = n_jobs
+        self.algorithm = algorithm
+        self.init_max_iter = init_max_iter
+
+    def _check_params(self):
+        if self.n_clusters < 1:
+            raise ValueError("n_clusters must be >= 1")
+        if self.max_iter < 1:
+            raise ValueError("max_iter must be >= 1")
+
+    def fit(self, X, y=None, sample_weight=None):
+        self._check_params()
+        t0 = tic()
+        X = check_array(X)
+        data = prepare_data(X, sample_weight=sample_weight)
+        key = check_random_state(self.random_state)
+
+        centers = core.k_init(
+            data.X,
+            data.weights,
+            data.n,
+            self.n_clusters,
+            key,
+            init=self.init,
+            oversampling_factor=self.oversampling_factor,
+            max_iter=self.init_max_iter,
+        )
+        t_init = tic()
+        logger.info("init (%s) finished in %.2fs", self.init, t_init - t0)
+
+        tol = core.scaled_tolerance(data.X, data.weights, self.tol)
+        centers, inertia, n_iter, _ = core.lloyd_loop(
+            data.X, data.weights, centers, tol, self.max_iter
+        )
+        labels = core.predict_labels(data.X, centers)
+        logger.info(
+            "Lloyd finished in %.2fs: %d iterations, inertia %.4g",
+            tic() - t_init, int(n_iter), float(inertia),
+        )
+
+        self.cluster_centers_ = np.asarray(centers)
+        self.labels_ = np.asarray(unpad_rows(labels, data.n))
+        self.inertia_ = float(inertia)
+        self.n_iter_ = int(n_iter)
+        self.n_features_in_ = data.n_features
+        return self
+
+    def _check_fitted(self):
+        if not hasattr(self, "cluster_centers_"):
+            raise AttributeError("Model not fitted; call fit first")
+
+    def predict(self, X):
+        """Nearest-center labels (reference: cluster/k_means.py:196-216)."""
+        self._check_fitted()
+        X = check_array(X)
+        data = prepare_data(X)
+        labels = core.predict_labels(data.X, jnp.asarray(self.cluster_centers_))
+        return np.asarray(unpad_rows(labels, data.n))
+
+    def transform(self, X):
+        """Distances to each center (reference: cluster/k_means.py:191-194)."""
+        self._check_fitted()
+        X = check_array(X)
+        data = prepare_data(X)
+        d = euclidean_distances(data.X, jnp.asarray(self.cluster_centers_))
+        return np.asarray(unpad_rows(d, data.n))
+
+    def score(self, X, y=None):
+        """Negative inertia on X (higher is better), matching sklearn."""
+        self._check_fitted()
+        X = check_array(X)
+        data = prepare_data(X)
+        return -float(
+            core.compute_inertia(
+                data.X, data.weights, jnp.asarray(self.cluster_centers_)
+            )
+        )

@@ -1,0 +1,20 @@
+"""Sharded metrics, pairwise ops, and the scorer registry
+(reference: dask_ml/metrics/__init__.py)."""
+
+from dask_ml_tpu.ops.pairwise import (  # noqa: F401
+    euclidean_distances,
+    pairwise_distances,
+    pairwise_distances_argmin_min,
+    pairwise_kernels,
+)
+from dask_ml_tpu.metrics.classification import accuracy_score, log_loss  # noqa: F401
+from dask_ml_tpu.metrics.regression import (  # noqa: F401
+    mean_absolute_error,
+    mean_squared_error,
+    r2_score,
+)
+from dask_ml_tpu.metrics.scorer import (  # noqa: F401
+    SCORERS,
+    check_scoring,
+    get_scorer,
+)

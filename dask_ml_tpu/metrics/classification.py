@@ -1,0 +1,74 @@
+"""Classification metrics as weighted XLA reductions
+(reference: metrics/classification.py:8-93).
+
+``compute=True`` returns a Python float (the analogue of the reference's
+eager path); ``compute=False`` returns the device scalar so callers can keep
+the value on-device inside a larger fused computation (the analogue of the
+reference's lazy dask scalar).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def _accuracy(y_true, y_pred, sample_weight):
+    if y_true.ndim > 1:
+        # multilabel: a row counts only if every label matches
+        # (reference: metrics/classification.py:60-69)
+        match = jnp.all(y_true == y_pred, axis=1)
+    else:
+        match = y_true == y_pred
+    match = match.astype(jnp.float32)
+    return jnp.average(match, weights=sample_weight)
+
+
+@jax.jit
+def _accuracy_count(y_true, y_pred, sample_weight):
+    if y_true.ndim > 1:
+        match = jnp.all(y_true == y_pred, axis=1)
+    else:
+        match = y_true == y_pred
+    return jnp.sum(match.astype(jnp.float32) * sample_weight)
+
+
+def accuracy_score(
+    y_true, y_pred, normalize: bool = True, sample_weight=None, compute: bool = True
+):
+    y_true = jnp.asarray(y_true)
+    y_pred = jnp.asarray(y_pred)
+    if sample_weight is None:
+        sample_weight = jnp.ones(y_true.shape[0], dtype=jnp.float32)
+    else:
+        sample_weight = jnp.asarray(sample_weight, dtype=jnp.float32)
+    if normalize:
+        out = _accuracy(y_true, y_pred, sample_weight)
+    else:
+        out = _accuracy_count(y_true, y_pred, sample_weight)
+    return float(out) if compute else out
+
+
+@jax.jit
+def _log_loss(y_true, proba, sample_weight, eps: float = 1e-15):
+    p = jnp.clip(proba, eps, 1.0 - eps)
+    if p.ndim == 1:
+        ll = -(y_true * jnp.log(p) + (1.0 - y_true) * jnp.log(1.0 - p))
+    else:
+        onehot = jax.nn.one_hot(y_true.astype(jnp.int32), p.shape[1], dtype=p.dtype)
+        ll = -jnp.sum(onehot * jnp.log(p), axis=1)
+    return jnp.average(ll, weights=sample_weight)
+
+
+def log_loss(y_true, y_pred, sample_weight=None, compute: bool = True):
+    """Cross-entropy loss over probability predictions (capability-parity-plus:
+    the reference has no dask log_loss, but its GLM scoring needs one)."""
+    y_true = jnp.asarray(y_true)
+    y_pred = jnp.asarray(y_pred)
+    if sample_weight is None:
+        sample_weight = jnp.ones(y_true.shape[0], dtype=jnp.float32)
+    else:
+        sample_weight = jnp.asarray(sample_weight, dtype=jnp.float32)
+    out = _log_loss(y_true, y_pred, sample_weight)
+    return float(out) if compute else out

@@ -1,0 +1,80 @@
+"""Regression metrics as weighted XLA reductions
+(reference: metrics/regression.py:26-94 — ``uniform_average`` only, same
+restriction kept here)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _check_multioutput(multioutput):
+    if multioutput not in (None, "uniform_average"):
+        raise ValueError(
+            "Only multioutput='uniform_average' (or None) is supported "
+            "(same restriction as the reference, metrics/regression.py:26)"
+        )
+
+
+def _prep(y_true, y_pred, sample_weight):
+    y_true = jnp.asarray(y_true, dtype=jnp.float32)
+    y_pred = jnp.asarray(y_pred, dtype=jnp.float32)
+    if sample_weight is None:
+        sample_weight = jnp.ones(y_true.shape[0], dtype=jnp.float32)
+    else:
+        sample_weight = jnp.asarray(sample_weight, dtype=jnp.float32)
+    return y_true, y_pred, sample_weight
+
+
+@jax.jit
+def _mse(y_true, y_pred, w):
+    err = (y_true - y_pred) ** 2
+    if err.ndim > 1:
+        err = err.mean(axis=1)
+    return jnp.average(err, weights=w)
+
+
+@jax.jit
+def _mae(y_true, y_pred, w):
+    err = jnp.abs(y_true - y_pred)
+    if err.ndim > 1:
+        err = err.mean(axis=1)
+    return jnp.average(err, weights=w)
+
+
+@jax.jit
+def _r2(y_true, y_pred, w):
+    num = jnp.sum(w * (y_true - y_pred) ** 2)
+    mean = jnp.average(y_true, weights=w)
+    den = jnp.sum(w * (y_true - mean) ** 2)
+    return 1.0 - num / den
+
+
+def mean_squared_error(
+    y_true, y_pred, sample_weight=None, multioutput="uniform_average",
+    compute: bool = True,
+):
+    _check_multioutput(multioutput)
+    out = _mse(*_prep(y_true, y_pred, sample_weight))
+    return float(out) if compute else out
+
+
+def mean_absolute_error(
+    y_true, y_pred, sample_weight=None, multioutput="uniform_average",
+    compute: bool = True,
+):
+    _check_multioutput(multioutput)
+    out = _mae(*_prep(y_true, y_pred, sample_weight))
+    return float(out) if compute else out
+
+
+def r2_score(
+    y_true, y_pred, sample_weight=None, multioutput="uniform_average",
+    compute: bool = True,
+):
+    _check_multioutput(multioutput)
+    y_true, y_pred, w = _prep(y_true, y_pred, sample_weight)
+    if y_true.ndim > 1:
+        raise ValueError("r2_score supports 1-D targets only")
+    out = _r2(y_true, y_pred, w)
+    return float(out) if compute else out

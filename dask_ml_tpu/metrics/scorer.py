@@ -1,0 +1,64 @@
+"""Scorer registry over the sharded metrics
+(reference: metrics/scorer.py:12-69).
+
+Scorers follow the sklearn convention ``scorer(estimator, X, y) -> float`` so
+they slot into both our search estimators and sklearn's.
+"""
+
+from __future__ import annotations
+
+from sklearn.metrics import make_scorer
+
+from dask_ml_tpu.metrics.classification import accuracy_score, log_loss
+from dask_ml_tpu.metrics.regression import (
+    mean_absolute_error,
+    mean_squared_error,
+    r2_score,
+)
+
+# Same registry contents as the reference (accuracy, neg MSE, r2), plus the
+# obvious extensions its users get from sklearn.
+SCORERS = {
+    "accuracy": make_scorer(accuracy_score),
+    "neg_mean_squared_error": make_scorer(mean_squared_error, greater_is_better=False),
+    "neg_mean_absolute_error": make_scorer(mean_absolute_error, greater_is_better=False),
+    "neg_log_loss": make_scorer(log_loss, greater_is_better=False, response_method="predict_proba"),
+    "r2": make_scorer(r2_score),
+}
+
+
+def get_scorer(scoring, compute: bool = True):
+    """Resolve a scoring name or callable to a scorer
+    (reference: metrics/scorer.py:25-50)."""
+    if isinstance(scoring, str):
+        try:
+            return SCORERS[scoring]
+        except KeyError:
+            raise ValueError(
+                f"{scoring!r} is not a valid scoring value; "
+                f"valid options are {sorted(SCORERS)}"
+            )
+    if callable(scoring):
+        return scoring
+    raise ValueError(f"Invalid scoring: {scoring!r}")
+
+
+def check_scoring(estimator, scoring=None, **kwargs):
+    """Validate scoring for an estimator (reference: metrics/scorer.py:53-69).
+    Raw metric functions (e.g. ``accuracy_score`` itself) are rejected — pass
+    a name or a made scorer."""
+    if scoring is None:
+        if not hasattr(estimator, "score"):
+            raise TypeError(
+                f"estimator {estimator!r} has no score method; pass scoring="
+            )
+        return None
+    if callable(scoring) and getattr(scoring, "__module__", "").startswith(
+        ("dask_ml_tpu.metrics", "sklearn.metrics")
+    ) and not hasattr(scoring, "_score_func") and not hasattr(scoring, "_response_method"):
+        raise ValueError(
+            "scoring value looks like a raw metric function; wrap it with "
+            "sklearn.metrics.make_scorer (same rule as the reference, "
+            "metrics/scorer.py:53-69)"
+        )
+    return get_scorer(scoring)

@@ -1,0 +1,8 @@
+"""Pure-functional model cores.
+
+Each model is a set of jitted pure functions (``init → state``,
+``step(state, data) → state``, ``predict(state, data)``), SPMD over the mesh.
+The estimator classes in the public subpackages (:mod:`dask_ml_tpu.cluster`,
+:mod:`dask_ml_tpu.linear_model`, ...) are thin stateful shells over these, so
+the compute path stays functional and compiler-friendly.
+"""

@@ -1,0 +1,4 @@
+"""Compute primitives: pairwise kernels, distributed linear algebra,
+segment reductions. The TPU-native replacement for the reference's L3
+primitives layer (reference: dask_ml/metrics/pairwise.py, the Cython
+``_k_means.pyx`` kernel, and the ``da.linalg`` routines it borrows)."""

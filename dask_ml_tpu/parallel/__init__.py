@@ -1,0 +1,27 @@
+"""Mesh/runtime bootstrap, sharding helpers, and collective patterns.
+
+This is the substrate layer: the TPU-native replacement for the reference's
+dask schedulers + chunked collections (reference: dask_ml relies on dask
+scheduler selection at model_selection/_search.py:841-852 and axis-0-chunked
+``dask.array`` everywhere). Here a dataset is a ``jax.Array`` sharded along
+axis 0 over the ``"data"`` axis of a :class:`jax.sharding.Mesh`; aggregation
+happens through XLA collectives instead of task-graph reductions.
+"""
+
+from dask_ml_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    MODEL_AXIS,
+    data_sharding,
+    default_mesh,
+    make_mesh,
+    n_data_shards,
+    replicated_sharding,
+    use_mesh,
+)
+from dask_ml_tpu.parallel.sharding import (  # noqa: F401
+    DeviceData,
+    pad_rows,
+    prepare_data,
+    shard_rows,
+    unpad_rows,
+)

@@ -1,0 +1,136 @@
+"""Host→device sharding of sample-axis data, with padding and row weights.
+
+The reference represents a dataset as a dask array chunked along axis 0 and
+lets chunks be uneven (reference: utils.py:177-214 ``check_chunks``). XLA SPMD
+wants equal shards, so we pad the sample axis up to a multiple of the mesh's
+``data`` axis and carry an explicit per-row weight vector (1 for real rows,
+0 for padding) through every reduction. Algorithm cores in
+:mod:`dask_ml_tpu.models` are written to be weight-aware, which also gives us
+``sample_weight`` support mostly for free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from dask_ml_tpu.parallel import mesh as mesh_lib
+
+ArrayLike = Union[np.ndarray, jax.Array]
+
+
+def pad_rows(n: int, n_shards: int) -> int:
+    """Rows of padding needed to make ``n`` divisible by ``n_shards``."""
+    return (-n) % n_shards
+
+
+def shard_rows(
+    x: ArrayLike,
+    mesh: Optional[Mesh] = None,
+    dtype=None,
+) -> tuple[jax.Array, int]:
+    """Pad ``x`` along axis 0 to an even multiple of the data-axis size and
+    place it sharded ``P('data', None, ...)``. Returns ``(sharded, n_valid)``.
+
+    Padding rows are zeros; callers must mask them via weights from
+    :func:`row_weights` (or :func:`prepare_data`, which does both).
+    """
+    mesh = mesh or mesh_lib.default_mesh()
+    x = jnp.asarray(x, dtype=dtype)
+    n = int(x.shape[0])
+    pad = pad_rows(n, mesh_lib.n_data_shards(mesh))
+    if pad:
+        widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+        x = jnp.pad(x, widths)
+    sharding = mesh_lib.data_sharding(mesh, ndim=x.ndim)
+    return jax.device_put(x, sharding), n
+
+
+def row_weights(
+    n_padded: int,
+    n_valid: int,
+    mesh: Optional[Mesh] = None,
+    sample_weight: Optional[ArrayLike] = None,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Sharded per-row weights: user ``sample_weight`` (default 1) on real
+    rows, 0 on padding rows."""
+    mesh = mesh or mesh_lib.default_mesh()
+    if sample_weight is None:
+        w = np.ones(n_valid, dtype=np.float32)
+    else:
+        w = np.asarray(sample_weight, dtype=np.float32)
+        if w.shape != (n_valid,):
+            raise ValueError(
+                f"sample_weight shape {w.shape} != ({n_valid},)"
+            )
+    if n_padded > n_valid:
+        w = np.concatenate([w, np.zeros(n_padded - n_valid, dtype=np.float32)])
+    return jax.device_put(
+        jnp.asarray(w, dtype=dtype), mesh_lib.data_sharding(mesh, ndim=1)
+    )
+
+
+def unpad_rows(x: ArrayLike, n_valid: int) -> jax.Array:
+    """Drop padding rows from a padded per-row result (labels, transforms)."""
+    return jnp.asarray(x)[:n_valid]
+
+
+def replicate(x: ArrayLike, mesh: Optional[Mesh] = None, dtype=None) -> jax.Array:
+    """Place small state (centers, coefs) fully replicated on the mesh."""
+    mesh = mesh or mesh_lib.default_mesh()
+    return jax.device_put(
+        jnp.asarray(x, dtype=dtype), mesh_lib.replicated_sharding(mesh)
+    )
+
+
+@dataclasses.dataclass
+class DeviceData:
+    """A dataset staged onto the mesh: padded, sharded, weight-masked.
+
+    The moral equivalent of the reference's "checked dask array"
+    (reference: utils.py:95-143 ``check_array``): by the time an algorithm core
+    sees a ``DeviceData`` the layout and dtype invariants hold.
+    """
+
+    X: jax.Array  # (n_padded, d), sharded P('data', None)
+    weights: jax.Array  # (n_padded,), sharded P('data'); 0 on padding
+    n: int  # true number of rows
+    y: Optional[jax.Array] = None  # (n_padded, ...), sharded, 0-padded
+    mesh: Optional[Mesh] = None
+
+    @property
+    def n_padded(self) -> int:
+        return int(self.X.shape[0])
+
+    @property
+    def n_features(self) -> int:
+        return int(self.X.shape[1])
+
+
+def prepare_data(
+    X: ArrayLike,
+    y: Optional[ArrayLike] = None,
+    sample_weight: Optional[ArrayLike] = None,
+    mesh: Optional[Mesh] = None,
+    dtype=None,
+    y_dtype=None,
+) -> DeviceData:
+    """Stage ``(X, y, sample_weight)`` onto the mesh as a :class:`DeviceData`."""
+    mesh = mesh or mesh_lib.default_mesh()
+    Xs, n = shard_rows(X, mesh=mesh, dtype=dtype)
+    ys = None
+    if y is not None:
+        y_arr = jnp.asarray(y, dtype=y_dtype)
+        if y_arr.shape[0] != n:
+            raise ValueError(
+                f"X has {n} rows but y has {y_arr.shape[0]}"
+            )
+        ys, _ = shard_rows(y_arr, mesh=mesh)
+    w = row_weights(int(Xs.shape[0]), n, mesh=mesh, sample_weight=sample_weight)
+    return DeviceData(X=Xs, weights=w, n=n, y=ys, mesh=mesh)

@@ -1,0 +1,13 @@
+"""Shared utilities: validation, PRNG handling, test helpers.
+
+Parity with the reference's L2 layer (reference: dask_ml/utils.py,
+_utils.py, _compat.py).
+"""
+
+from dask_ml_tpu.utils._utils import copy_learned_attributes  # noqa: F401
+from dask_ml_tpu.utils.validation import (  # noqa: F401
+    check_array,
+    check_random_state,
+    check_random_state_np,
+)
+from dask_ml_tpu.utils.testing import assert_estimator_equal  # noqa: F401

@@ -1,0 +1,67 @@
+"""Test helpers — primarily :func:`assert_estimator_equal`, the differential
+oracle used throughout the suite (reference: utils.py:51-79, the dominant test
+technique per its test suite, e.g. tests/test_kmeans.py:59-89)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def _to_host(x):
+    if isinstance(x, jax.Array):
+        return np.asarray(x)
+    return x
+
+
+def _assert_eq(a, b, name: str, rtol: float, atol: float):
+    a, b = _to_host(a), _to_host(b)
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        np.testing.assert_allclose(
+            np.asarray(a, dtype=np.float64),
+            np.asarray(b, dtype=np.float64),
+            rtol=rtol,
+            atol=atol,
+            err_msg=f"attribute {name!r} differs",
+        )
+    elif isinstance(a, (float, np.floating)) or isinstance(b, (float, np.floating)):
+        np.testing.assert_allclose(float(a), float(b), rtol=rtol, atol=atol,
+                                   err_msg=f"attribute {name!r} differs")
+    elif isinstance(a, dict):
+        assert set(a) == set(b), f"attribute {name!r}: dict keys differ"
+        for k in a:
+            _assert_eq(a[k], b[k], f"{name}[{k!r}]", rtol, atol)
+    else:
+        assert a == b, f"attribute {name!r}: {a!r} != {b!r}"
+
+
+def assert_estimator_equal(
+    left,
+    right,
+    exclude=(),
+    rtol: float = 1e-4,
+    atol: float = 1e-4,
+):
+    """Check that two fitted estimators agree on every learned
+    (trailing-underscore) attribute, up to tolerance.
+
+    Mirrors the reference helper's semantics (same attribute discovery rule,
+    recursive array/dict comparison), with looser default tolerances because
+    our side computes in float32 on the accelerator.
+    """
+    exclude = set([exclude] if isinstance(exclude, str) else exclude)
+    left_attrs = {
+        a for a in dir(left) if a.endswith("_") and not a.startswith("_")
+    } - exclude
+    right_attrs = {
+        a for a in dir(right) if a.endswith("_") and not a.startswith("_")
+    } - exclude
+    assert left_attrs == right_attrs, (
+        f"Estimators have different fitted attributes: "
+        f"only-left={sorted(left_attrs - right_attrs)} "
+        f"only-right={sorted(right_attrs - left_attrs)}"
+    )
+    for attr in sorted(left_attrs):
+        l, r = getattr(left, attr), getattr(right, attr)
+        _assert_eq(l, r, attr, rtol, atol)

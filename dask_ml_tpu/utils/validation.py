@@ -1,0 +1,96 @@
+"""Input validation and PRNG handling.
+
+The TPU analogue of the reference's dask-aware ``check_array``
+(reference: utils.py:95-143) and ``check_random_state``
+(reference: utils.py:164-174, which returns a ``da.random.RandomState``).
+Here validation happens on the host array before staging to the mesh, and
+randomness is a ``jax.random`` key so every jitted kernel is reproducible and
+splittable per shard.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def check_array(
+    X,
+    *,
+    ensure_2d: bool = True,
+    allow_nd: bool = False,
+    force_all_finite: bool = True,
+    dtype: Optional[jnp.dtype] = None,
+    min_samples: int = 1,
+):
+    """Validate an input array on the host and return it as ``jnp``-compatible.
+
+    Dtype policy (TPU-first): integer and float64 inputs are converted to
+    float32 unless an explicit ``dtype`` is given — the reference similarly
+    upcasts ints to float for KMeans (reference: cluster/k_means.py:147-152),
+    but we *down*-cast doubles because f32/bf16 is the native TPU regime.
+    """
+    if hasattr(X, "iloc"):  # pandas — reject like the reference's KMeans path
+        raise TypeError(
+            "DataFrame inputs are not supported here; pass .values "
+            "(reference rejects dask.dataframe the same way, "
+            "cluster/k_means.py:153-160)"
+        )
+    arr = np.asarray(X) if not isinstance(X, jax.Array) else X
+    if ensure_2d and arr.ndim == 1:
+        raise ValueError(
+            f"Expected 2D array, got 1D array of shape {arr.shape}"
+        )
+    if not allow_nd and arr.ndim > 2:
+        raise ValueError(f"Expected <=2D array, got shape {arr.shape}")
+    if arr.shape[0] < min_samples:
+        raise ValueError(
+            f"Found array with {arr.shape[0]} sample(s) while a minimum of "
+            f"{min_samples} is required"
+        )
+    if dtype is None:
+        kind = np.dtype(arr.dtype).kind
+        if kind in "iub":
+            dtype = jnp.float32
+        elif kind == "f" and np.dtype(arr.dtype).itemsize > 4:
+            if not jax.config.jax_enable_x64:
+                dtype = jnp.float32
+        elif kind not in "f":
+            raise ValueError(f"Unsupported dtype {arr.dtype}")
+    out = jnp.asarray(arr, dtype=dtype)
+    if force_all_finite:
+        # Single fused reduction — the analogue of the reference's one-pass
+        # NaN/inf scan (reference: cluster/k_means.py:161-170).
+        if not bool(jnp.isfinite(out).all()):
+            raise ValueError("Input contains NaN or infinity")
+    return out
+
+
+KeyArray = jax.Array
+
+
+def check_random_state(
+    seed: Union[None, int, KeyArray, np.random.RandomState] = None,
+) -> KeyArray:
+    """Coerce ``seed`` into a ``jax.random`` key."""
+    if seed is None:
+        return jax.random.key(np.random.SeedSequence().entropy % (2**63))
+    if isinstance(seed, (int, np.integer)):
+        return jax.random.key(int(seed))
+    if isinstance(seed, np.random.RandomState):
+        return jax.random.key(int(seed.randint(0, 2**31 - 1)))
+    if isinstance(seed, jax.Array) and jnp.issubdtype(seed.dtype, jax.dtypes.prng_key):
+        return seed
+    raise TypeError(f"Cannot coerce {type(seed)!r} into a jax PRNG key")
+
+
+def check_random_state_np(
+    seed: Union[None, int, np.random.RandomState] = None,
+) -> np.random.RandomState:
+    """NumPy RandomState for host-side components (encoders, sklearn interop)."""
+    if isinstance(seed, np.random.RandomState):
+        return seed
+    return np.random.RandomState(seed)

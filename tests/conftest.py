@@ -1,0 +1,64 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh.
+
+The analogue of the reference's multi-"node"-without-a-cluster fixtures
+(reference: tests/conftest.py:131-141, which runs tests under threads and an
+in-process distributed cluster): we run every test over an 8-device CPU mesh
+via ``--xla_force_host_platform_device_count=8``, exercising real SPMD
+partitioning and collectives without TPU hardware.
+
+This module must configure JAX before any backend is created, so it runs its
+environment setup at import time, before importing the package under test.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from dask_ml_tpu.parallel import mesh as mesh_lib  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    """The full 8-device data mesh."""
+    return mesh_lib.make_mesh()
+
+
+@pytest.fixture(params=[1, 3, 8], ids=["mesh1", "mesh3", "mesh8"])
+def any_mesh(request):
+    """Parametrized mesh sizes — the analogue of the reference's chunk-count
+    parametrization (reference: tests/conftest.py:15-19 two-chunk fixtures).
+    3 devices exercises padding (uneven n % shards)."""
+    m = mesh_lib.make_mesh(n_devices=request.param)
+    with mesh_lib.use_mesh(m):
+        yield m
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)
+
+
+@pytest.fixture
+def xy_classification(rng):
+    """Small dense classification problem (reference: tests/conftest.py:15-19)."""
+    X = rng.uniform(size=(100, 4)).astype(np.float32)
+    y = (rng.uniform(size=100) > 0.5).astype(np.int32)
+    return X, y
+
+
+@pytest.fixture
+def xy_regression(rng):
+    X = rng.uniform(size=(100, 4)).astype(np.float32)
+    y = (X @ rng.uniform(size=4) + 0.1 * rng.uniform(size=100)).astype(np.float32)
+    return X, y

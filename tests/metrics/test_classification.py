@@ -1,0 +1,66 @@
+import numpy as np
+import pytest
+import sklearn.metrics as skm
+
+from dask_ml_tpu import metrics
+
+
+@pytest.fixture
+def yy(rng):
+    y_true = rng.randint(0, 2, size=50)
+    y_pred = rng.randint(0, 2, size=50)
+    return y_true, y_pred
+
+
+def test_accuracy(yy):
+    y_true, y_pred = yy
+    assert metrics.accuracy_score(y_true, y_pred) == pytest.approx(
+        skm.accuracy_score(y_true, y_pred)
+    )
+
+
+def test_accuracy_normalize_false(yy):
+    y_true, y_pred = yy
+    assert metrics.accuracy_score(y_true, y_pred, normalize=False) == pytest.approx(
+        skm.accuracy_score(y_true, y_pred, normalize=False)
+    )
+
+
+def test_accuracy_sample_weight(yy, rng):
+    y_true, y_pred = yy
+    w = rng.uniform(size=50)
+    assert metrics.accuracy_score(y_true, y_pred, sample_weight=w) == pytest.approx(
+        skm.accuracy_score(y_true, y_pred, sample_weight=w), rel=1e-5
+    )
+
+
+def test_accuracy_multilabel(rng):
+    y_true = rng.randint(0, 2, size=(30, 3))
+    y_pred = rng.randint(0, 2, size=(30, 3))
+    assert metrics.accuracy_score(y_true, y_pred) == pytest.approx(
+        skm.accuracy_score(y_true, y_pred)
+    )
+
+
+def test_accuracy_compute_false(yy):
+    y_true, y_pred = yy
+    out = metrics.accuracy_score(y_true, y_pred, compute=False)
+    assert not isinstance(out, float)
+    assert float(out) == pytest.approx(skm.accuracy_score(y_true, y_pred))
+
+
+def test_log_loss_binary(rng):
+    y_true = rng.randint(0, 2, size=40)
+    proba = rng.uniform(size=40)
+    assert metrics.log_loss(y_true, proba) == pytest.approx(
+        skm.log_loss(y_true, proba), rel=1e-4
+    )
+
+
+def test_log_loss_multiclass(rng):
+    y_true = rng.randint(0, 3, size=40)
+    proba = rng.uniform(size=(40, 3))
+    proba /= proba.sum(1, keepdims=True)
+    assert metrics.log_loss(y_true, proba) == pytest.approx(
+        skm.log_loss(y_true, proba, labels=[0, 1, 2]), rel=1e-4
+    )

@@ -1,0 +1,72 @@
+"""Differential tests of pairwise ops vs sklearn (the reference's primary
+oracle, SURVEY §4)."""
+
+import numpy as np
+import pytest
+import sklearn.metrics.pairwise as skp
+
+from dask_ml_tpu.ops import pairwise
+from dask_ml_tpu.parallel import shard_rows
+
+
+@pytest.fixture
+def XY(rng):
+    X = rng.randn(40, 6).astype(np.float32)
+    Y = rng.randn(5, 6).astype(np.float32)
+    return X, Y
+
+
+def test_euclidean_distances(XY, any_mesh):
+    X, Y = XY
+    Xs, n = shard_rows(X)
+    got = np.asarray(pairwise.euclidean_distances(Xs, Y))[:n]
+    np.testing.assert_allclose(got, skp.euclidean_distances(X, Y), rtol=1e-4, atol=1e-4)
+
+
+def test_euclidean_distances_self(XY):
+    X, _ = XY
+    got = np.asarray(pairwise.euclidean_distances(X))
+    np.testing.assert_allclose(got, skp.euclidean_distances(X), rtol=1e-3, atol=1e-3)
+
+
+def test_argmin_min(XY, any_mesh):
+    X, Y = XY
+    Xs, n = shard_rows(X)
+    am, mn = pairwise.pairwise_distances_argmin_min(Xs, Y)
+    sk_am, sk_mn = skp.pairwise_distances_argmin_min(X, Y)
+    np.testing.assert_array_equal(np.asarray(am)[:n], sk_am)
+    np.testing.assert_allclose(np.asarray(mn)[:n], sk_mn, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize(
+    "name,skfn,kwds",
+    [
+        ("linear", skp.linear_kernel, {}),
+        ("rbf", skp.rbf_kernel, {"gamma": 0.5}),
+        ("polynomial", skp.polynomial_kernel, {"degree": 2, "gamma": 0.3, "coef0": 1.5}),
+        ("sigmoid", skp.sigmoid_kernel, {"gamma": 0.1, "coef0": 0.2}),
+    ],
+)
+def test_kernels_vs_sklearn(XY, name, skfn, kwds):
+    X, Y = XY
+    got = np.asarray(pairwise.pairwise_kernels(X, Y, metric=name, **kwds))
+    np.testing.assert_allclose(got, skfn(X, Y, **kwds), rtol=1e-4, atol=1e-4)
+
+
+def test_kernels_default_gamma(XY):
+    X, Y = XY
+    got = np.asarray(pairwise.rbf_kernel(X, Y))
+    np.testing.assert_allclose(got, skp.rbf_kernel(X, Y), rtol=1e-4, atol=1e-4)
+
+
+def test_unknown_kernel_raises(XY):
+    with pytest.raises(ValueError, match="Unknown kernel"):
+        pairwise.pairwise_kernels(*XY, metric="nope")
+
+
+def test_pairwise_distances_callable(XY):
+    X, Y = XY
+    got = pairwise.pairwise_distances(X, Y, metric=pairwise.euclidean_distances)
+    np.testing.assert_allclose(
+        np.asarray(got), skp.euclidean_distances(X, Y), rtol=1e-4, atol=1e-4
+    )

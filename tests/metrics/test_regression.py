@@ -1,0 +1,60 @@
+import numpy as np
+import pytest
+import sklearn.metrics as skm
+
+from dask_ml_tpu import metrics
+
+
+@pytest.fixture
+def yy(rng):
+    y_true = rng.randn(60).astype(np.float32)
+    y_pred = (y_true + 0.3 * rng.randn(60)).astype(np.float32)
+    return y_true, y_pred
+
+
+@pytest.mark.parametrize(
+    "ours,theirs",
+    [
+        (metrics.mean_squared_error, skm.mean_squared_error),
+        (metrics.mean_absolute_error, skm.mean_absolute_error),
+        (metrics.r2_score, skm.r2_score),
+    ],
+)
+def test_vs_sklearn(yy, ours, theirs):
+    y_true, y_pred = yy
+    assert ours(y_true, y_pred) == pytest.approx(theirs(y_true, y_pred), rel=1e-4)
+
+
+@pytest.mark.parametrize(
+    "ours,theirs",
+    [
+        (metrics.mean_squared_error, skm.mean_squared_error),
+        (metrics.mean_absolute_error, skm.mean_absolute_error),
+        (metrics.r2_score, skm.r2_score),
+    ],
+)
+def test_sample_weight(yy, rng, ours, theirs):
+    y_true, y_pred = yy
+    w = rng.uniform(size=60)
+    assert ours(y_true, y_pred, sample_weight=w) == pytest.approx(
+        theirs(y_true, y_pred, sample_weight=w), rel=1e-4
+    )
+
+
+def test_multioutput_mse(rng):
+    y_true = rng.randn(30, 2)
+    y_pred = y_true + 0.1 * rng.randn(30, 2)
+    assert metrics.mean_squared_error(y_true, y_pred) == pytest.approx(
+        skm.mean_squared_error(y_true, y_pred), rel=1e-4
+    )
+
+
+def test_multioutput_rejected():
+    with pytest.raises(ValueError, match="uniform_average"):
+        metrics.mean_squared_error([1.0], [1.0], multioutput="raw_values")
+
+
+def test_compute_false(yy):
+    y_true, y_pred = yy
+    out = metrics.r2_score(y_true, y_pred, compute=False)
+    assert not isinstance(out, float)

@@ -1,0 +1,49 @@
+import numpy as np
+import pytest
+from sklearn.linear_model import LogisticRegression as SKLogisticRegression
+
+from dask_ml_tpu import metrics
+from dask_ml_tpu.metrics.scorer import SCORERS, check_scoring, get_scorer
+
+
+def test_registry_contents():
+    # the reference registry's three entries must exist
+    for name in ["accuracy", "neg_mean_squared_error", "r2"]:
+        assert name in SCORERS
+
+
+def test_get_scorer_unknown():
+    with pytest.raises(ValueError, match="not a valid scoring"):
+        get_scorer("nope")
+
+
+def test_scorer_scores_estimator(xy_classification):
+    X, y = xy_classification
+    est = SKLogisticRegression().fit(X, y)
+    scorer = get_scorer("accuracy")
+    got = scorer(est, X, y)
+    assert got == pytest.approx(est.score(X, y), rel=1e-6)
+
+
+def test_neg_mse_sign(xy_regression):
+    from sklearn.linear_model import LinearRegression as SKLinearRegression
+
+    X, y = xy_regression
+    est = SKLinearRegression().fit(X, y)
+    scorer = get_scorer("neg_mean_squared_error")
+    assert scorer(est, X, y) <= 0
+
+
+def test_check_scoring_rejects_raw_metric():
+    est = SKLogisticRegression()
+    with pytest.raises(ValueError, match="raw metric"):
+        check_scoring(est, scoring=metrics.accuracy_score)
+
+
+def test_check_scoring_none_requires_score():
+    class NoScore:
+        pass
+
+    with pytest.raises(TypeError, match="score"):
+        check_scoring(NoScore())
+    assert check_scoring(SKLogisticRegression()) is None

@@ -1,0 +1,72 @@
+"""Tests for sharded dataset generators (reference: tests/test_datasets.py)."""
+
+import jax
+import numpy as np
+import pytest
+
+from dask_ml_tpu import datasets
+from dask_ml_tpu.parallel import mesh as mesh_lib
+
+
+def test_make_blobs_shapes_and_sharding(mesh8):
+    with mesh_lib.use_mesh(mesh8):
+        X, y = datasets.make_blobs(
+            n_samples=80, n_features=4, centers=3, random_state=0
+        )
+    assert X.shape == (80, 4)
+    assert y.shape == (80,)
+    assert set(np.unique(np.asarray(y))) <= {0, 1, 2}
+    # evenly divisible → laid out sharded over the data axis
+    assert X.sharding.spec == jax.sharding.PartitionSpec("data", None)
+
+
+def test_make_blobs_explicit_centers():
+    centers = np.array([[0.0, 0.0], [100.0, 100.0]], dtype=np.float32)
+    X, y = datasets.make_blobs(
+        n_samples=64, n_features=2, centers=centers, cluster_std=0.01,
+        random_state=0,
+    )
+    Xh, yh = np.asarray(X), np.asarray(y)
+    # every point is within a tight ball of its assigned center
+    d = np.linalg.norm(Xh - centers[yh], axis=1)
+    assert d.max() < 1.0
+
+
+def test_make_blobs_deterministic():
+    X1, y1 = datasets.make_blobs(n_samples=40, random_state=42)
+    X2, y2 = datasets.make_blobs(n_samples=40, random_state=42)
+    np.testing.assert_array_equal(np.asarray(X1), np.asarray(X2))
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+
+
+def test_make_regression_coef_recovery():
+    X, y, coef = datasets.make_regression(
+        n_samples=200, n_features=10, n_informative=3, noise=0.0,
+        coef=True, random_state=1,
+    )
+    np.testing.assert_allclose(
+        np.asarray(X) @ np.asarray(coef), np.asarray(y), rtol=1e-4, atol=1e-3
+    )
+    assert (np.asarray(coef) != 0).sum() == 3
+
+
+def test_make_regression_effective_rank_unsupported():
+    with pytest.raises(NotImplementedError):
+        datasets.make_regression(effective_rank=5)
+
+
+def test_make_classification_binary():
+    X, y = datasets.make_classification(
+        n_samples=96, n_features=8, n_informative=4, random_state=0
+    )
+    assert X.shape == (96, 8)
+    assert set(np.unique(np.asarray(y))) <= {0, 1}
+
+
+def test_make_counts_nonnegative_ints():
+    X, y = datasets.make_counts(
+        n_samples=64, n_features=10, n_informative=2, random_state=0
+    )
+    yh = np.asarray(y)
+    assert yh.dtype == np.int32
+    assert (yh >= 0).all()

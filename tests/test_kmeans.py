@@ -1,0 +1,121 @@
+"""KMeans differential tests vs scikit-learn
+(reference: tests/test_kmeans.py — same oracle strategy: cluster-alignment +
+inertia tolerance)."""
+
+import numpy as np
+import pytest
+from sklearn.cluster import KMeans as SKKMeans
+from sklearn.metrics.pairwise import euclidean_distances as sk_euclidean
+
+from dask_ml_tpu import datasets
+from dask_ml_tpu.cluster import KMeans
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    X, y = datasets.make_blobs(
+        n_samples=400, n_features=4, centers=3, cluster_std=0.5, random_state=0
+    )
+    return np.asarray(X), np.asarray(y)
+
+
+def _align_centers(got, want):
+    """Match rows of `got` to nearest rows of `want` (clusters are unordered)."""
+    d = sk_euclidean(got, want)
+    perm = d.argmin(axis=1)
+    assert sorted(perm) == list(range(len(want))), "centers don't align 1:1"
+    return want[perm]
+
+
+@pytest.mark.parametrize("init", ["k-means||", "k-means++", "random"])
+def test_fit_matches_sklearn(blobs, init, any_mesh):
+    X, _ = blobs
+    km = KMeans(n_clusters=3, init=init, random_state=0).fit(X)
+    sk = SKKMeans(n_clusters=3, n_init=10, random_state=0).fit(X)
+    aligned = _align_centers(km.cluster_centers_, sk.cluster_centers_)
+    np.testing.assert_allclose(km.cluster_centers_, aligned, rtol=0.1, atol=0.1)
+    # inertia within 5% of sklearn's converged optimum
+    assert km.inertia_ <= sk.inertia_ * 1.05
+    assert km.labels_.shape == (400,)
+    assert km.n_iter_ >= 1
+
+
+def test_init_array(blobs):
+    X, _ = blobs
+    init = X[:3].copy()
+    km = KMeans(n_clusters=3, init=init, random_state=0).fit(X)
+    sk = SKKMeans(n_clusters=3, init=init, n_init=1, random_state=0).fit(X)
+    aligned = _align_centers(km.cluster_centers_, sk.cluster_centers_)
+    np.testing.assert_allclose(km.cluster_centers_, aligned, rtol=1e-2, atol=1e-2)
+    assert km.inertia_ == pytest.approx(sk.inertia_, rel=1e-2)
+
+
+def test_init_array_bad_shape(blobs):
+    X, _ = blobs
+    with pytest.raises(ValueError, match="shape"):
+        KMeans(n_clusters=3, init=np.zeros((2, 4))).fit(X)
+
+
+def test_predict_is_nearest_center(blobs):
+    X, _ = blobs
+    km = KMeans(n_clusters=3, random_state=0).fit(X)
+    labels = km.predict(X)
+    d = sk_euclidean(X, km.cluster_centers_)
+    np.testing.assert_array_equal(labels, d.argmin(axis=1))
+
+
+def test_transform_distances(blobs):
+    X, _ = blobs
+    km = KMeans(n_clusters=3, random_state=0).fit(X)
+    got = km.transform(X)
+    np.testing.assert_allclose(
+        got, sk_euclidean(X, km.cluster_centers_), rtol=1e-3, atol=1e-3
+    )
+
+
+def test_sample_weight_zero_rows_ignored(blobs):
+    X, _ = blobs
+    rng = np.random.RandomState(1)
+    outliers = rng.uniform(50, 60, size=(20, X.shape[1])).astype(np.float32)
+    Xo = np.vstack([X, outliers])
+    w = np.ones(len(Xo), dtype=np.float32)
+    w[len(X):] = 0.0
+    km = KMeans(n_clusters=3, random_state=0).fit(Xo, sample_weight=w)
+    # zero-weighted outliers must not drag centers anywhere near them
+    assert np.abs(km.cluster_centers_).max() < 20.0
+
+
+def test_score_negative_inertia(blobs):
+    X, _ = blobs
+    km = KMeans(n_clusters=3, random_state=0).fit(X)
+    assert km.score(X) == pytest.approx(-km.inertia_, rel=1e-3)
+
+
+def test_unfitted_raises(blobs):
+    X, _ = blobs
+    with pytest.raises(AttributeError, match="fit"):
+        KMeans().predict(X)
+
+
+def test_bad_params(blobs):
+    X, _ = blobs
+    with pytest.raises(ValueError):
+        KMeans(n_clusters=0).fit(X)
+    with pytest.raises(ValueError):
+        KMeans(max_iter=0).fit(X)
+    with pytest.raises(ValueError, match="init"):
+        KMeans(init="bogus").fit(X)
+
+
+def test_dataframe_rejected(blobs):
+    pd = pytest.importorskip("pandas")
+    X, _ = blobs
+    with pytest.raises(TypeError, match="DataFrame"):
+        KMeans().fit(pd.DataFrame(X))
+
+
+def test_determinism(blobs):
+    X, _ = blobs
+    a = KMeans(n_clusters=3, random_state=7).fit(X)
+    b = KMeans(n_clusters=3, random_state=7).fit(X)
+    np.testing.assert_array_equal(a.cluster_centers_, b.cluster_centers_)

@@ -1,0 +1,117 @@
+"""Tests for the mesh/sharding substrate (dask_ml_tpu.parallel)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dask_ml_tpu.parallel import (
+    DeviceData,
+    data_sharding,
+    default_mesh,
+    make_mesh,
+    n_data_shards,
+    prepare_data,
+    shard_rows,
+    unpad_rows,
+    use_mesh,
+)
+from dask_ml_tpu.utils import check_array, check_random_state
+
+
+def test_make_mesh_all_devices():
+    m = make_mesh()
+    assert m.shape["data"] == 8
+
+
+def test_use_mesh_override():
+    m1 = make_mesh(n_devices=2)
+    with use_mesh(m1):
+        assert default_mesh() is m1
+        assert n_data_shards() == 2
+    assert default_mesh() is not m1
+
+
+def test_shard_rows_divisible(any_mesh):
+    x = np.arange(48, dtype=np.float32).reshape(24, 2)
+    xs, n = shard_rows(x)
+    assert n == 24
+    nshards = n_data_shards(any_mesh)
+    assert xs.shape[0] % nshards == 0
+    np.testing.assert_array_equal(np.asarray(xs)[:24], x)
+    # padding rows, if any, are zeros
+    np.testing.assert_array_equal(np.asarray(xs)[24:], 0)
+
+
+def test_shard_rows_padding():
+    m = make_mesh(n_devices=8)
+    with use_mesh(m):
+        x = np.ones((13, 3), dtype=np.float32)
+        xs, n = shard_rows(x)
+        assert n == 13
+        assert xs.shape == (16, 3)
+
+
+def test_prepare_data_weights_mask_padding(any_mesh):
+    X = np.ones((10, 2), dtype=np.float32)
+    y = np.arange(10, dtype=np.float32)
+    d = prepare_data(X, y, sample_weight=2 * np.ones(10, dtype=np.float32))
+    assert isinstance(d, DeviceData)
+    assert d.n == 10
+    w = np.asarray(d.weights)
+    assert w[:10].sum() == 20.0
+    assert w[10:].sum() == 0.0
+    # weighted count recovers the true row count regardless of padding
+    assert float(jnp.sum(d.weights)) == 20.0
+    np.testing.assert_array_equal(unpad_rows(d.y, d.n), y)
+
+
+def test_weighted_mean_matches_numpy(any_mesh):
+    rng = np.random.RandomState(0)
+    X = rng.randn(37, 5).astype(np.float32)
+    d = prepare_data(X)
+
+    @jax.jit
+    def wmean(X, w):
+        return (X * w[:, None]).sum(0) / w.sum()
+
+    np.testing.assert_allclose(
+        np.asarray(wmean(d.X, d.weights)), X.mean(0), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_prepare_data_y_length_mismatch():
+    with pytest.raises(ValueError, match="rows"):
+        prepare_data(np.ones((4, 2)), y=np.ones(5))
+
+
+def test_check_array_dtype_policy():
+    out = check_array(np.arange(6, dtype=np.int64).reshape(3, 2))
+    assert out.dtype == jnp.float32
+    out = check_array(np.ones((3, 2), dtype=np.float64))
+    assert out.dtype == jnp.float32
+
+
+def test_check_array_rejects_nan_1d_nd():
+    with pytest.raises(ValueError, match="NaN"):
+        check_array(np.array([[1.0, np.nan]]))
+    with pytest.raises(ValueError, match="2D"):
+        check_array(np.ones(3))
+    with pytest.raises(ValueError, match="2D"):
+        check_array(np.ones((2, 2, 2)))
+
+
+def test_check_random_state_roundtrip():
+    k1 = check_random_state(0)
+    k2 = check_random_state(0)
+    assert jnp.array_equal(jax.random.key_data(k1), jax.random.key_data(k2))
+    k3 = check_random_state(k1)
+    assert k3 is k1
+    check_random_state(None)  # just shouldn't raise
+    with pytest.raises(TypeError):
+        check_random_state("seed")
+
+
+def test_data_sharding_spec(mesh8):
+    s = data_sharding(mesh8)
+    assert s.spec == jax.sharding.PartitionSpec("data", None)
