@@ -51,7 +51,8 @@ def bench_tpu():
     jax.block_until_ready(centers)
     dt = time.perf_counter() - t0
     iters = max(int(n_iter), 1)
-    return N_SAMPLES * iters / dt, float(inertia)
+    mesh_rate = N_SAMPLES * iters / dt  # whole-mesh samples/sec
+    return mesh_rate, mesh_rate / jax.device_count(), float(inertia)
 
 
 def bench_sklearn_baseline():
@@ -72,15 +73,17 @@ def bench_sklearn_baseline():
 
 
 def main():
-    tpu_throughput, _ = bench_tpu()
+    mesh_rate, per_chip, _ = bench_tpu()
     sk_throughput = bench_sklearn_baseline()
     print(
         json.dumps(
             {
                 "metric": "kmeans_lloyd_throughput",
-                "value": round(tpu_throughput, 1),
+                "value": round(per_chip, 1),
                 "unit": "samples/sec/chip",
-                "vs_baseline": round(tpu_throughput / sk_throughput, 2),
+                # whole-system vs whole-baseline speedup (not per-chip), so
+                # the ratio keeps its meaning across mesh sizes
+                "vs_baseline": round(mesh_rate / sk_throughput, 2),
             }
         )
     )

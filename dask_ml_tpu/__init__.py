@@ -11,16 +11,10 @@ pipeline-prefix memoization.
 Public subpackages mirror the reference API surface
 (reference: docs/source/modules/api.rst):
 
-- :mod:`dask_ml_tpu.cluster` — KMeans (k-means|| init), SpectralClustering
-- :mod:`dask_ml_tpu.decomposition` — PCA, TruncatedSVD (tsqr + randomized)
-- :mod:`dask_ml_tpu.linear_model` — LogisticRegression, LinearRegression,
-  PoissonRegression over native ADMM/L-BFGS/gradient/Newton/proximal solvers
-- :mod:`dask_ml_tpu.preprocessing` — scalers, quantile transformer, encoders
+- :mod:`dask_ml_tpu.cluster` — KMeans (k-means|| init)
 - :mod:`dask_ml_tpu.metrics` — sharded metrics + pairwise kernels + scorers
 - :mod:`dask_ml_tpu.model_selection` — ShuffleSplit/KFold/train_test_split,
   GridSearchCV/RandomizedSearchCV with work-sharing
-- :mod:`dask_ml_tpu.wrappers` — ParallelPostFit, Incremental
-- :mod:`dask_ml_tpu.naive_bayes` — GaussianNB
 - :mod:`dask_ml_tpu.datasets` — sharded data generators
 
 Internal layers:
@@ -28,20 +22,14 @@ Internal layers:
 - :mod:`dask_ml_tpu.parallel` — mesh/runtime bootstrap, sharding, collectives
 - :mod:`dask_ml_tpu.ops` — pairwise kernels, distributed linalg, reductions
 - :mod:`dask_ml_tpu.models` — pure-functional model cores (init/step/predict)
-- :mod:`dask_ml_tpu.native` — C++ host runtime (blockwise executor)
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 __all__ = [
     "cluster",
-    "decomposition",
-    "linear_model",
-    "preprocessing",
     "metrics",
     "model_selection",
-    "wrappers",
-    "naive_bayes",
     "datasets",
     "parallel",
     "ops",

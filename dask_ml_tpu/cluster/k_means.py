@@ -79,16 +79,20 @@ class KMeans(TransformerMixin, BaseEstimator):
         self.algorithm = algorithm
         self.init_max_iter = init_max_iter
 
-    def _check_params(self):
+    def _check_params(self, n_samples=None):
         if self.n_clusters < 1:
             raise ValueError("n_clusters must be >= 1")
         if self.max_iter < 1:
             raise ValueError("max_iter must be >= 1")
+        if n_samples is not None and self.n_clusters > n_samples:
+            raise ValueError(
+                f"n_clusters={self.n_clusters} must be <= n_samples={n_samples}"
+            )
 
     def fit(self, X, y=None, sample_weight=None):
-        self._check_params()
         t0 = tic()
         X = check_array(X)
+        self._check_params(n_samples=int(X.shape[0]))
         data = prepare_data(X, sample_weight=sample_weight)
         key = check_random_state(self.random_state)
 
@@ -106,9 +110,13 @@ class KMeans(TransformerMixin, BaseEstimator):
         logger.info("init (%s) finished in %.2fs", self.init, t_init - t0)
 
         tol = core.scaled_tolerance(data.X, data.weights, self.tol)
-        centers, inertia, n_iter, _ = core.lloyd_loop(
+        centers, _, n_iter, _ = core.lloyd_loop(
             data.X, data.weights, centers, tol, self.max_iter
         )
+        # Recompute cost against the *final* centers so inertia_ is consistent
+        # with cluster_centers_/labels_ and score(X) — the reference likewise
+        # re-assigns after the loop (reference: cluster/k_means.py:504-507).
+        inertia = core.compute_inertia(data.X, data.weights, centers)
         labels = core.predict_labels(data.X, centers)
         logger.info(
             "Lloyd finished in %.2fs: %d iterations, inertia %.4g",

@@ -29,14 +29,22 @@ SCORERS = {
 
 def get_scorer(scoring, compute: bool = True):
     """Resolve a scoring name or callable to a scorer
-    (reference: metrics/scorer.py:25-50)."""
+    (reference: metrics/scorer.py:25-50). Names not in our sharded registry
+    fall back to sklearn's scorer registry (single authority for the whole
+    package, incl. the search driver)."""
     if isinstance(scoring, str):
         try:
             return SCORERS[scoring]
         except KeyError:
+            pass
+        try:
+            import sklearn.metrics
+
+            return sklearn.metrics.get_scorer(scoring)
+        except ValueError:
             raise ValueError(
-                f"{scoring!r} is not a valid scoring value; "
-                f"valid options are {sorted(SCORERS)}"
+                f"{scoring!r} is not a valid scoring value; valid options "
+                f"are {sorted(SCORERS)} or any sklearn scorer name"
             )
     if callable(scoring):
         return scoring

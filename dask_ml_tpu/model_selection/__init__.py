@@ -1,0 +1,26 @@
+"""Hyper-parameter search and CV splitting
+(reference: dask_ml/model_selection/__init__.py)."""
+
+from dask_ml_tpu.model_selection._search import (
+    GridSearchCV,
+    RandomizedSearchCV,
+    TPUBaseSearchCV,
+)
+from dask_ml_tpu.model_selection._split import (
+    KFold,
+    ShuffleSplit,
+    check_cv,
+    compute_n_splits,
+    train_test_split,
+)
+
+__all__ = [
+    "GridSearchCV",
+    "RandomizedSearchCV",
+    "TPUBaseSearchCV",
+    "KFold",
+    "ShuffleSplit",
+    "check_cv",
+    "compute_n_splits",
+    "train_test_split",
+]

@@ -1,0 +1,621 @@
+"""Drop-in GridSearchCV / RandomizedSearchCV as a host-side work-sharing driver.
+
+The reference's biggest subsystem is a small query compiler: ``build_graph``
+assembles one dask dict for the whole CV search, dedupes identical
+(estimator-config, data) fits via content-addressed keys, recursively expands
+``sklearn.Pipeline`` so shared prefixes are fit once, and hands the graph to a
+pluggable scheduler (reference: model_selection/_search.py:89-160, 281-345,
+462-503, 841-852).
+
+The TPU-native shape of the same capability: there is no task graph — compute
+inside an estimator's ``fit`` is already one XLA program over the mesh — so
+the search layer becomes a **host-side thread-pool driver** with a
+future-based memo table:
+
+- work-sharing/CSE: each pipeline stage fit is keyed by
+  ``token(stage-config, upstream-token, split-id)`` and computed exactly once
+  no matter how many candidates share it (the analogue of the reference's
+  ``seen`` maps, _search.py:281-345); identical whole candidates dedupe the
+  same way.
+- parallelism: independent candidate×split fits run concurrently on host
+  threads. Heavy JAX work releases the GIL during device execution, and plain
+  sklearn estimators (the heterogeneous path) parallelize exactly as they did
+  under the reference's threaded scheduler.
+- ``error_score``/``FIT_FAILURE`` semantics, ``cv_results_`` structure, iid
+  weighting, multimetric + refit: see :mod:`.methods`.
+"""
+
+from __future__ import annotations
+
+import numbers
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Optional
+
+import numpy as np
+from sklearn.base import BaseEstimator, MetaEstimatorMixin, is_classifier
+from sklearn.model_selection import ParameterGrid, ParameterSampler
+from sklearn.pipeline import Pipeline
+
+from dask_ml_tpu.model_selection import methods
+from dask_ml_tpu.model_selection._split import check_cv
+from dask_ml_tpu.model_selection._tokenize import tokenize
+from dask_ml_tpu.model_selection.methods import FIT_FAILURE
+
+__all__ = ["GridSearchCV", "RandomizedSearchCV", "TPUBaseSearchCV"]
+
+
+# ---------------------------------------------------------------------------
+# data slicing / caching (the reference's CVCache, methods.py:67-124)
+# ---------------------------------------------------------------------------
+
+
+def _is_pairwise(est) -> bool:
+    try:
+        return bool(est.__sklearn_tags__().input_tags.pairwise)
+    except Exception:
+        return bool(getattr(est, "_pairwise", False))
+
+
+def _index(a, idx):
+    if a is None:
+        return None
+    if hasattr(a, "iloc"):
+        return a.iloc[idx]
+    return np.asarray(a)[idx]
+
+
+class CVCache:
+    """Materialized train/test slices per split, cached per search
+    (reference: methods.py:67-124). ``extract(..., pairwise=True)`` slices
+    both axes of a precomputed kernel matrix the way the reference does
+    (methods.py:110-124)."""
+
+    def __init__(self, splits, X, y, cache: bool = True):
+        self.splits = list(splits)
+        self.X = X
+        self.y = y
+        self.cache = {} if cache else None
+
+    def n_test(self, split_idx: int) -> int:
+        return len(self.splits[split_idx][1])
+
+    def extract(self, split_idx: int, train: bool, is_x: bool = True,
+                pairwise: bool = False):
+        key = (split_idx, train, is_x, pairwise)
+        if self.cache is not None and key in self.cache:
+            return self.cache[key]
+        train_idx, test_idx = self.splits[split_idx]
+        idx = train_idx if train else test_idx
+        if not is_x:
+            out = _index(self.y, idx)
+        elif pairwise:
+            X = np.asarray(self.X)
+            if X.ndim != 2 or X.shape[0] != X.shape[1]:
+                raise ValueError(
+                    "X should be a square kernel matrix for pairwise "
+                    "estimators"
+                )
+            out = X[np.ix_(idx, train_idx)]
+        else:
+            out = _index(self.X, idx)
+        if self.cache is not None:
+            self.cache[key] = out
+        return out
+
+
+# ---------------------------------------------------------------------------
+# future-based memo (the analogue of graph-key CSE)
+# ---------------------------------------------------------------------------
+
+
+class _Memo:
+    """token → Future; the first thread to claim a token computes it, every
+    other candidate sharing the token waits on the same future. This gives the
+    reference's graph-level CSE (one task per distinct key) under threads."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._futures: dict[str, Future] = {}
+
+    def get_or_run(self, key: str, fn):
+        with self._lock:
+            fut = self._futures.get(key)
+            owner = fut is None
+            if owner:
+                fut = Future()
+                self._futures[key] = fut
+        if owner:
+            try:
+                fut.set_result(fn())
+            except BaseException as e:  # error_score='raise' path
+                fut.set_exception(e)
+        return fut.result()
+
+    @property
+    def n_entries(self) -> int:
+        return len(self._futures)
+
+
+# ---------------------------------------------------------------------------
+# scoring resolution
+# ---------------------------------------------------------------------------
+
+
+def _passthrough_scorer(est, X, y):
+    return est.score(X, y)
+
+
+def _lookup_scorer(name: str):
+    from dask_ml_tpu.metrics.scorer import get_scorer
+
+    return get_scorer(name)
+
+
+def _resolve_scoring(estimator, scoring):
+    """→ (scorers: {name: callable}, multimetric: bool).
+
+    Mirrors the reference's scorer setup incl. multimetric
+    (reference: _search.py:789-818)."""
+    if scoring is None:
+        if not hasattr(estimator, "score"):
+            raise TypeError(
+                f"estimator {estimator!r} has no score method; pass scoring="
+            )
+        return {"score": _passthrough_scorer}, False
+    if isinstance(scoring, str):
+        return {"score": _lookup_scorer(scoring)}, False
+    if callable(scoring):
+        return {"score": scoring}, False
+    if isinstance(scoring, (list, tuple, set)):
+        names = list(scoring)
+        if len(set(names)) != len(names):
+            raise ValueError(f"Duplicate scorer names in {names!r}")
+        if not all(isinstance(n, str) for n in names):
+            raise ValueError(
+                "multimetric scoring as a list requires string names"
+            )
+        return {n: _lookup_scorer(n) for n in names}, True
+    if isinstance(scoring, dict):
+        return (
+            {
+                n: (_lookup_scorer(s) if isinstance(s, str) else s)
+                for n, s in scoring.items()
+            },
+            True,
+        )
+    raise ValueError(f"Invalid scoring: {scoring!r}")
+
+
+# ---------------------------------------------------------------------------
+# candidate execution with pipeline-prefix sharing
+# ---------------------------------------------------------------------------
+
+
+def _split_pipeline_params(steps, params):
+    """Partition candidate params into per-stage dicts keyed by stage name;
+    top-level (non-prefixed) params are rejected the way set_params would be."""
+    names = [name for name, _ in steps]
+    per_stage = {name: {} for name in names}
+    top = {}
+    for key, value in params.items():
+        if "__" in key:
+            stage, _, sub = key.partition("__")
+            if stage in per_stage:
+                per_stage[stage][sub] = value
+                continue
+        top[key] = value
+    return per_stage, top
+
+
+class _CandidateRunner:
+    """Executes one (candidate, split) cell with memoized stage fits."""
+
+    def __init__(self, estimator, cv_cache: CVCache, memo: _Memo, scorers,
+                 error_score, return_train_score: bool, fit_params=None):
+        self.estimator = estimator
+        self.cv_cache = cv_cache
+        self.memo = memo
+        self.scorers = scorers
+        self.error_score = error_score
+        self.return_train_score = return_train_score
+        self.fit_params = fit_params or {}
+        self._n_samples = (
+            None if cv_cache.X is None else int(np.asarray(cv_cache.X).shape[0])
+        )
+        self._fp_cache: dict[int, dict] = {}
+        self._fp_lock = threading.Lock()
+
+    def _fit_params_for(self, split_idx):
+        """Per-split fit params: array-likes aligned with the sample axis are
+        sliced by the split's train indices (sklearn's _check_method_params
+        behavior); everything else passes through whole."""
+        if not self.fit_params:
+            return {}
+        with self._fp_lock:
+            if split_idx in self._fp_cache:
+                return self._fp_cache[split_idx]
+        train_idx, _ = self.cv_cache.splits[split_idx]
+        out = {}
+        for name, value in self.fit_params.items():
+            if (
+                hasattr(value, "__len__")
+                and not isinstance(value, str)
+                and self._n_samples is not None
+                and len(value) == self._n_samples
+            ):
+                out[name] = _index(value, train_idx)
+            else:
+                out[name] = value
+        with self._fp_lock:
+            self._fp_cache[split_idx] = out
+        return out
+
+    # -- plain estimator -------------------------------------------------
+    def _fit_plain(self, params, split_idx):
+        est = self.estimator
+        pairwise = _is_pairwise(est)
+        key = tokenize("fit", type(est), est.get_params(deep=True),
+                       params, sorted(self.fit_params), split_idx, pairwise)
+
+        def run():
+            X = self.cv_cache.extract(split_idx, train=True, pairwise=pairwise)
+            y = self.cv_cache.extract(split_idx, train=True, is_x=False)
+            return methods.fit(
+                est, X, y, params=params,
+                fit_params=self._fit_params_for(split_idx),
+                error_score=self.error_score,
+            )
+
+        return self.memo.get_or_run(key, run)
+
+    # -- pipeline, stage-by-stage with prefix CSE ------------------------
+    def _fit_pipeline(self, pipe, params, split_idx):
+        per_stage, top = _split_pipeline_params(pipe.steps, params)
+        per_stage_fp, top_fp = _split_pipeline_params(
+            pipe.steps, self._fit_params_for(split_idx)
+        )
+        if top or top_fp:
+            # params targeting the Pipeline object itself (e.g. steps=...):
+            # no prefix sharing possible; fall back to a whole-object fit.
+            return self._fit_plain(params, split_idx)
+
+        upstream = tokenize("pipe-root", split_idx)
+        # a pairwise first stage (precomputed kernel) needs the two-axis
+        # root slice K[train, train], same as the plain-estimator path
+        first_real = next(
+            (s for _, s in pipe.steps if s is not None and s != "passthrough"),
+            None,
+        )
+        root_pairwise = _is_pairwise(first_real) if first_real is not None else False
+        fitted_steps = []
+        total_fit_time = 0.0
+        failed = False
+        for i, (name, stage) in enumerate(pipe.steps):
+            sparams = per_stage[name]
+            sfit = per_stage_fp.get(name) or {}
+            is_last = i == len(pipe.steps) - 1
+            if stage is None or stage == "passthrough":
+                fitted_steps.append((name, stage))
+                upstream = tokenize(upstream, "passthrough")
+                continue
+            key = tokenize("stage", upstream, type(stage),
+                           stage.get_params(deep=True), sparams,
+                           sorted(sfit), is_last)
+
+            if is_last:
+                def run_last(upstream=upstream, stage=stage, sparams=sparams,
+                             sfit=sfit):
+                    Xt = self._stage_input(upstream, split_idx, train=True,
+                                           pairwise=root_pairwise)
+                    y = self.cv_cache.extract(split_idx, train=True, is_x=False)
+                    return methods.fit(
+                        stage, Xt, y, params=sparams, fit_params=sfit,
+                        error_score=self.error_score,
+                    )
+
+                fitted, t = self.memo.get_or_run(key, run_last)
+                total_fit_time += t
+                if fitted is FIT_FAILURE:
+                    failed = True
+                fitted_steps.append((name, fitted))
+            else:
+                def run_stage(upstream=upstream, stage=stage, sparams=sparams,
+                              sfit=sfit):
+                    Xt = self._stage_input(upstream, split_idx, train=True,
+                                           pairwise=root_pairwise)
+                    y = self.cv_cache.extract(split_idx, train=True, is_x=False)
+                    return methods.fit_transform(
+                        stage, Xt, y, params=sparams, fit_params=sfit,
+                        error_score=self.error_score,
+                    )
+
+                (fitted, Xt), t = self.memo.get_or_run(key, run_stage)
+                total_fit_time += t
+                if fitted is FIT_FAILURE:
+                    failed = True
+                    fitted_steps.append((name, FIT_FAILURE))
+                    break
+                fitted_steps.append((name, fitted))
+            upstream = key
+
+        if failed:
+            return FIT_FAILURE, total_fit_time
+        out = methods.copy_estimator(pipe)
+        out.steps = fitted_steps
+        return out, total_fit_time
+
+    def _stage_input(self, upstream, split_idx, train: bool = True,
+                     pairwise: bool = False):
+        """Train-side input of a stage: the original slice at the pipeline
+        root, else the transformed output stored in the upstream stage's memo
+        entry. Safe to read here: any thread reaching stage *i+1* already
+        passed through stage *i*'s ``get_or_run`` in its own loop, so the
+        upstream future exists and resolving it cannot race."""
+        if upstream == tokenize("pipe-root", split_idx):
+            return self.cv_cache.extract(split_idx, train=train,
+                                         pairwise=pairwise)
+
+        def missing():  # pragma: no cover - ordering invariant
+            raise RuntimeError("upstream stage output missing")
+
+        (_, Xt), _t = self.memo.get_or_run(upstream, missing)
+        return Xt
+
+    # -- one cell --------------------------------------------------------
+    def run(self, params, split_idx):
+        est = self.estimator
+        if isinstance(est, Pipeline):
+            fitted, fit_time = self._fit_pipeline(est, params, split_idx)
+        else:
+            fitted, fit_time = self._fit_plain(params, split_idx)
+
+        pairwise = _is_pairwise(est)
+        X_test = self.cv_cache.extract(split_idx, train=False, pairwise=pairwise)
+        y_test = self.cv_cache.extract(split_idx, train=False, is_x=False)
+        X_train = y_train = None
+        if self.return_train_score:
+            X_train = self.cv_cache.extract(split_idx, train=True,
+                                            pairwise=pairwise)
+            y_train = self.cv_cache.extract(split_idx, train=True, is_x=False)
+        test, train, score_time = methods.score(
+            fitted, X_test, y_test, X_train, y_train, self.scorers,
+            self.error_score,
+        )
+        return test, train, fit_time, score_time
+
+
+# ---------------------------------------------------------------------------
+# the estimators
+# ---------------------------------------------------------------------------
+
+
+def _normalize_n_jobs(n_jobs):
+    """-1 → one thread per host core (reference: _search.py:659-666)."""
+    import os
+
+    if n_jobs is None:
+        return 1
+    if n_jobs == -1:
+        return os.cpu_count() or 1
+    if n_jobs < 1:
+        raise ValueError(f"n_jobs must be -1 or >= 1, got {n_jobs}")
+    return int(n_jobs)
+
+
+class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
+    """Shared driver for grid and randomized search
+    (reference: _search.py:669-894 ``DaskBaseSearchCV``)."""
+
+    def __init__(self, estimator, scoring=None, iid=True, refit=True, cv=None,
+                 error_score="raise", return_train_score=True, scheduler=None,
+                 n_jobs=-1, cache_cv=True):
+        self.estimator = estimator
+        self.scoring = scoring
+        self.iid = iid
+        self.refit = refit
+        self.cv = cv
+        self.error_score = error_score
+        self.return_train_score = return_train_score
+        # accepted for reference-signature parity; placement is the mesh's job
+        self.scheduler = scheduler
+        self.n_jobs = n_jobs
+        self.cache_cv = cache_cv
+
+    def _get_param_iterator(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    # -- fit -------------------------------------------------------------
+    def fit(self, X, y=None, groups=None, **fit_params):
+        estimator = self.estimator
+        if not (
+            isinstance(self.error_score, numbers.Number)
+            or self.error_score == "raise"
+        ):
+            raise ValueError(
+                "error_score must be the string 'raise' or a numeric value"
+            )
+        scorers, multimetric = _resolve_scoring(estimator, self.scoring)
+        refit_metric = self._check_refit(multimetric, scorers)
+
+        cv = check_cv(self.cv, y, classifier=is_classifier(estimator))
+        splits = list(cv.split(X, y, groups))
+        n_splits = len(splits)
+        cv_cache = CVCache(splits, X, y, cache=self.cache_cv)
+
+        candidate_params = list(self._get_param_iterator())
+        n_candidates = len(candidate_params)
+
+        memo = _Memo()
+        runner = _CandidateRunner(
+            estimator, cv_cache, memo, scorers,
+            self.error_score, self.return_train_score, fit_params=fit_params,
+        )
+
+        cells = [
+            (ci, si)
+            for ci in range(n_candidates)
+            for si in range(n_splits)
+        ]
+        n_workers = _normalize_n_jobs(self.n_jobs)
+        if n_workers == 1:
+            results = [
+                runner.run(candidate_params[ci], si) for ci, si in cells
+            ]
+        else:
+            with ThreadPoolExecutor(max_workers=n_workers) as pool:
+                futs = [
+                    pool.submit(runner.run, candidate_params[ci], si)
+                    for ci, si in cells
+                ]
+                results = [f.result() for f in futs]
+
+        test_weights = None
+        if self.iid:
+            test_weights = np.array(
+                [cv_cache.n_test(si) for _, si in cells], dtype=np.float64
+            )
+
+        self.cv_results_ = methods.create_cv_results(
+            results, candidate_params, n_splits, self.error_score,
+            test_weights, multimetric, self.return_train_score,
+        )
+        self.n_splits_ = n_splits
+        self.multimetric_ = multimetric
+        self.scorer_ = scorers if multimetric else scorers["score"]
+        self.n_shared_fits_ = memo.n_entries  # CSE observability
+
+        if self.refit:
+            rank_key = (
+                f"rank_test_{refit_metric}" if multimetric else "rank_test_score"
+            )
+            self.best_index_ = int(np.argmin(self.cv_results_[rank_key]))
+            mean_key = (
+                f"mean_test_{refit_metric}" if multimetric else "mean_test_score"
+            )
+            self.best_score_ = float(
+                self.cv_results_[mean_key][self.best_index_]
+            )
+            self.best_params_ = candidate_params[self.best_index_]
+            # refit always raises on failure (reference: _search.py:965-969)
+            best = methods.copy_estimator(estimator)
+            best.set_params(**self.best_params_)
+            best.fit(X, y, **fit_params)
+            self.best_estimator_ = best
+        return self
+
+    def _check_refit(self, multimetric, scorers):
+        if not multimetric:
+            return None
+        if self.refit is False:
+            return None
+        if not isinstance(self.refit, str) or self.refit not in scorers:
+            raise ValueError(
+                "For multimetric scoring, refit must be the name of the "
+                f"scorer used to find the best parameters; got {self.refit!r}"
+            )
+        return self.refit
+
+    # -- post-fit delegation (reference: _search.py:728-762) -------------
+    def _check_is_fitted(self, method_name):
+        if not self.refit:
+            raise AttributeError(
+                f"This {type(self).__name__} instance was initialized with "
+                f"refit=False; {method_name} is only available after refitting"
+            )
+        if not hasattr(self, "best_estimator_"):
+            raise AttributeError("Not fitted; call fit first")
+
+    @property
+    def classes_(self):
+        self._check_is_fitted("classes_")
+        return self.best_estimator_.classes_
+
+    def predict(self, X):
+        self._check_is_fitted("predict")
+        return self.best_estimator_.predict(X)
+
+    def predict_proba(self, X):
+        self._check_is_fitted("predict_proba")
+        return self.best_estimator_.predict_proba(X)
+
+    def predict_log_proba(self, X):
+        self._check_is_fitted("predict_log_proba")
+        return self.best_estimator_.predict_log_proba(X)
+
+    def decision_function(self, X):
+        self._check_is_fitted("decision_function")
+        return self.best_estimator_.decision_function(X)
+
+    def transform(self, X):
+        self._check_is_fitted("transform")
+        return self.best_estimator_.transform(X)
+
+    def inverse_transform(self, X):
+        self._check_is_fitted("inverse_transform")
+        return self.best_estimator_.inverse_transform(X)
+
+    def score(self, X, y=None):
+        self._check_is_fitted("score")
+        if self.multimetric_:
+            # score with the refit metric, as sklearn's BaseSearchCV does
+            if isinstance(self.refit, str):
+                return self.scorer_[self.refit](self.best_estimator_, X, y)
+            return self.best_estimator_.score(X, y)
+        return self.scorer_(self.best_estimator_, X, y)
+
+
+_DOC_NOTE = """
+    Execution model: a host-side thread pool drives candidate x split fits;
+    pipeline-prefix fits are content-addressed and computed once across
+    candidates (work-sharing), the analogue of the reference's graph CSE
+    (reference: _search.py:281-345,462-503). `n_shared_fits_` exposes how many
+    distinct fit tasks actually ran.
+"""
+
+
+class GridSearchCV(TPUBaseSearchCV):
+    __doc__ = (
+        "Exhaustive search over a parameter grid "
+        "(reference: _search.py:1141-1170).\n" + _DOC_NOTE
+    )
+
+    def __init__(self, estimator, param_grid, scoring=None, iid=True,
+                 refit=True, cv=None, error_score="raise",
+                 return_train_score=True, scheduler=None, n_jobs=-1,
+                 cache_cv=True):
+        super().__init__(
+            estimator, scoring=scoring, iid=iid, refit=refit, cv=cv,
+            error_score=error_score, return_train_score=return_train_score,
+            scheduler=scheduler, n_jobs=n_jobs, cache_cv=cache_cv,
+        )
+        self.param_grid = param_grid
+
+    def _get_param_iterator(self):
+        return ParameterGrid(self.param_grid)
+
+
+class RandomizedSearchCV(TPUBaseSearchCV):
+    __doc__ = (
+        "Sampled search over parameter distributions "
+        "(reference: _search.py:1232-1265).\n" + _DOC_NOTE
+    )
+
+    def __init__(self, estimator, param_distributions, n_iter=10, scoring=None,
+                 iid=True, refit=True, cv=None, random_state=None,
+                 error_score="raise", return_train_score=True, scheduler=None,
+                 n_jobs=-1, cache_cv=True):
+        super().__init__(
+            estimator, scoring=scoring, iid=iid, refit=refit, cv=cv,
+            error_score=error_score, return_train_score=return_train_score,
+            scheduler=scheduler, n_jobs=n_jobs, cache_cv=cache_cv,
+        )
+        self.param_distributions = param_distributions
+        self.n_iter = n_iter
+        self.random_state = random_state
+
+    def _get_param_iterator(self):
+        return ParameterSampler(
+            self.param_distributions, self.n_iter,
+            random_state=self.random_state,
+        )

@@ -1,0 +1,269 @@
+"""Cross-validation splitting for sharded sample-axis data.
+
+TPU-native rebuild of the reference's blockwise splitters
+(reference: model_selection/_split.py). The reference splits each dask chunk
+*locally* — per-chunk seeded permutations, offset-concatenated into global
+index arrays — so a split never moves rows between workers
+(reference: _split.py:144-173 ``_generate_idx``/offset logic). We keep exactly
+that algorithm, with "chunk" = "mesh data shard": indices are generated
+per block with per-block seeds and offset into global row ids, so the train
+and test selections of every split stay shard-local under the data-axis
+sharding and the later gather is a shard-local ``jnp.take``.
+
+Index generation happens on the host (it is O(n) integer work and happens once
+per search); the expensive part — slicing X rows and staging them onto the
+mesh — is done by the consumer (`train_test_split` here, or the search driver)
+per split.
+"""
+
+from __future__ import annotations
+
+import numbers
+from typing import Optional
+
+import numpy as np
+import sklearn.model_selection as sk_ms
+from sklearn.model_selection._split import BaseCrossValidator
+
+from dask_ml_tpu.parallel import mesh as mesh_lib
+
+
+def _check_blockwise_sizes(test_size, train_size):
+    """The reference restricts blockwise splits to float fractions
+    (reference: _split.py:27-55): integer sizes cannot be honored exactly when
+    each block is split locally."""
+    if test_size is None and train_size is None:
+        test_size = 0.1
+    for name, value in (("test_size", test_size), ("train_size", train_size)):
+        if value is not None and not isinstance(value, numbers.Real):
+            raise ValueError(f"{name} must be a float fraction, got {value!r}")
+        if value is not None and isinstance(value, numbers.Integral):
+            raise ValueError(
+                f"{name} must be a float fraction for blockwise splits "
+                f"(reference restriction, _split.py:27-55); got int {value!r}"
+            )
+        if value is not None and not 0 < value < 1:
+            raise ValueError(f"{name} must be in (0, 1), got {value!r}")
+    if test_size is None:
+        test_size = 1.0 - train_size
+    if train_size is None:
+        train_size = 1.0 - test_size
+    if test_size + train_size > 1 + 1e-9:
+        raise ValueError(
+            f"test_size + train_size = {test_size + train_size} > 1"
+        )
+    return float(test_size), float(train_size)
+
+
+def _block_sizes(n: int, n_blocks: int) -> list[int]:
+    """Split ``n`` rows into ``n_blocks`` near-equal contiguous blocks — the
+    analogue of the dataset's shard layout (ceil-sized shards then remainder,
+    matching the padded-shard row distribution)."""
+    n_blocks = max(1, min(n_blocks, n))
+    base, extra = divmod(n, n_blocks)
+    return [base + (1 if i < extra else 0) for i in range(n_blocks)]
+
+
+def _generate_idx(n: int, seed: int, n_train: int, n_test: int):
+    """Permute ``arange(n)``; first ``n_train`` are train, last ``n_test`` are
+    test — same per-block scheme as the reference (_split.py:144-160)."""
+    idx = np.random.RandomState(seed).permutation(n)
+    return idx[:n_train], idx[n - n_test:]
+
+
+class ShuffleSplit(BaseCrossValidator):
+    """Random-permutation CV that splits each data block locally
+    (reference: model_selection/_split.py:82-180).
+
+    Parameters
+    ----------
+    n_splits : int, default 10
+    test_size, train_size : float fractions (blockwise restriction, as in the
+        reference)
+    blockwise : bool, default True
+        Permute within blocks (shard-local, no cross-shard data motion). The
+        reference raises NotImplementedError for ``blockwise=False``
+        (_split.py:175-177); we implement it as a global permutation since on
+        host index arrays it is trivial.
+    n_blocks : int or None
+        Number of blocks; default = the active mesh's data-shard count.
+    random_state : int or None
+    """
+
+    def __init__(
+        self,
+        n_splits: int = 10,
+        test_size=None,
+        train_size=None,
+        blockwise: bool = True,
+        n_blocks: Optional[int] = None,
+        random_state=None,
+    ):
+        self.n_splits = n_splits
+        self.test_size = test_size
+        self.train_size = train_size
+        self.blockwise = blockwise
+        self.n_blocks = n_blocks
+        self.random_state = random_state
+
+    def get_n_splits(self, X=None, y=None, groups=None):
+        return self.n_splits
+
+    def _iter_test_masks(self, X=None, y=None, groups=None):  # pragma: no cover
+        raise NotImplementedError  # split() is overridden wholesale
+
+    def split(self, X, y=None, groups=None):
+        n = int(X.shape[0])
+        test_size, train_size = _check_blockwise_sizes(
+            self.test_size, self.train_size
+        )
+        rng = np.random.RandomState(self.random_state)
+        for _ in range(self.n_splits):
+            if self.blockwise:
+                yield self._split_blockwise(n, test_size, train_size, rng)
+            else:
+                yield self._split_global(n, test_size, train_size, rng)
+
+    def _split_blockwise(self, n, test_size, train_size, rng):
+        n_blocks = self.n_blocks or mesh_lib.n_data_shards()
+        sizes = _block_sizes(n, n_blocks)
+        seeds = rng.randint(0, 2**31 - 1, size=len(sizes))
+        trains, tests = [], []
+        offset = 0
+        for size, seed in zip(sizes, seeds):
+            n_test = int(size * test_size)
+            n_train = int(size * train_size)
+            tr, te = _generate_idx(size, int(seed), n_train, n_test)
+            trains.append(offset + np.sort(tr))
+            tests.append(offset + np.sort(te))
+            offset += size
+        return np.concatenate(trains), np.concatenate(tests)
+
+    def _split_global(self, n, test_size, train_size, rng):
+        n_test = int(n * test_size)
+        n_train = int(n * train_size)
+        tr, te = _generate_idx(n, int(rng.randint(0, 2**31 - 1)), n_train, n_test)
+        return np.sort(tr), np.sort(te)
+
+
+class KFold(BaseCrossValidator):
+    """K contiguous folds over the sample axis.
+
+    Contiguous (unshuffled) folds keep every fold's rows contiguous in the
+    shard layout, so the train/test gathers of a split touch at most
+    ``ceil(S/k)+1`` shard boundaries. With ``shuffle=True`` row order is
+    permuted globally first (host index work only).
+    """
+
+    def __init__(self, n_splits: int = 5, shuffle: bool = False, random_state=None):
+        self.n_splits = n_splits
+        self.shuffle = shuffle
+        self.random_state = random_state
+
+    def get_n_splits(self, X=None, y=None, groups=None):
+        return self.n_splits
+
+    def _iter_test_masks(self, X=None, y=None, groups=None):  # pragma: no cover
+        raise NotImplementedError
+
+    def split(self, X, y=None, groups=None):
+        n = int(X.shape[0])
+        if self.n_splits < 2:
+            raise ValueError("n_splits must be >= 2")
+        if self.n_splits > n:
+            raise ValueError(
+                f"n_splits={self.n_splits} greater than n_samples={n}"
+            )
+        if self.shuffle:
+            order = np.random.RandomState(self.random_state).permutation(n)
+        else:
+            order = np.arange(n)
+        sizes = _block_sizes(n, self.n_splits)
+        offset = 0
+        for size in sizes:
+            test = order[offset:offset + size]
+            train = np.concatenate([order[:offset], order[offset + size:]])
+            yield np.sort(train), np.sort(test)
+            offset += size
+
+
+def check_cv(cv=None, y=None, classifier: bool = False):
+    """Resolve ``cv`` into a splitter object (reference: _search.py:600-618).
+
+    int/None → our :class:`KFold`, or sklearn ``StratifiedKFold`` when
+    ``classifier`` and ``y`` looks categorical (binary/multiclass) — the same
+    dispatch rule as sklearn/the reference; splitter instances pass through.
+    """
+    if cv is None:
+        cv = 5
+    if isinstance(cv, numbers.Integral):
+        if classifier and y is not None:
+            from sklearn.utils.multiclass import type_of_target
+
+            if type_of_target(np.asarray(y)) in ("binary", "multiclass"):
+                return sk_ms.StratifiedKFold(n_splits=int(cv))
+        return KFold(n_splits=int(cv))
+    if hasattr(cv, "split") and hasattr(cv, "get_n_splits"):
+        return cv
+    if hasattr(cv, "__iter__"):
+        # explicit (train_idx, test_idx) pairs, as sklearn accepts
+        return sk_ms.check_cv(list(cv))
+    raise ValueError(f"Cannot interpret cv={cv!r}")
+
+
+def compute_n_splits(cv, X=None, y=None, groups=None) -> int:
+    """Number of splits (reference: _search.py:621-656 avoids materializing
+    lazy inputs; here inputs are host arrays so this is a plain delegation)."""
+    return cv.get_n_splits(X, y, groups)
+
+
+def train_test_split(
+    *arrays,
+    test_size=None,
+    train_size=None,
+    random_state=None,
+    shuffle: bool = True,
+    blockwise: bool = True,
+    **options,
+):
+    """Split arrays into random train and test subsets
+    (reference: model_selection/_split.py:220-289).
+
+    All arrays must share axis-0 length. Index generation is blockwise (see
+    :class:`ShuffleSplit`); slicing happens on the host and the caller stages
+    the result onto the mesh (estimators do this internally).
+    """
+    if not arrays:
+        raise ValueError("At least one array required as input")
+    if options:
+        raise TypeError(f"Unexpected options {sorted(options)}")
+    if not shuffle:
+        raise NotImplementedError(
+            "shuffle=False is not implemented (the reference has the same "
+            "restriction, _split.py:248-251)"
+        )
+    n = arrays[0].shape[0]
+    for a in arrays:
+        if a.shape[0] != n:
+            raise ValueError(
+                f"Input arrays have inconsistent lengths: {a.shape[0]} != {n}"
+            )
+    splitter = ShuffleSplit(
+        n_splits=1,
+        test_size=test_size,
+        train_size=train_size,
+        blockwise=blockwise,
+        random_state=random_state,
+    )
+    train_idx, test_idx = next(splitter.split(arrays[0]))
+    out = []
+    for a in arrays:
+        # keep pandas objects intact (positional slicing), arrays as arrays
+        if hasattr(a, "iloc"):
+            out.append(a.iloc[train_idx])
+            out.append(a.iloc[test_idx])
+        else:
+            a = np.asarray(a)
+            out.append(a[train_idx])
+            out.append(a[test_idx])
+    return out

@@ -1,0 +1,67 @@
+"""Content-addressed tokens for work-sharing in the search driver.
+
+The reference registers ``dask.base.normalize_token`` rules for estimators and
+CV splitters so that graph keys are content-addressed and identical
+(estimator-config, data) fits collapse to one task
+(reference: model_selection/_normalize.py:17-62, used by the ``seen`` maps in
+_search.py:281-345). Our driver's memoization needs the same property but only
+*within one search call*, so data identity can be a (split-id, role) pair and
+only estimator configurations need content hashing.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+
+def _update(h, s: str):
+    h.update(s.encode())
+
+
+def _normalize(obj, h):
+    """Feed a stable representation of ``obj`` into hash ``h``.
+
+    Estimators normalize to (qualified class name, sorted shallow params) with
+    nested estimators/arrays recursed — the same rule as the reference's
+    ``normalize_estimator`` (reference: _normalize.py:17-23).
+    """
+    if isinstance(obj, type):
+        _update(h, f"type:{obj.__module__}.{obj.__qualname__}")
+    elif hasattr(obj, "get_params") and hasattr(obj, "set_params"):
+        _update(h, f"est:{type(obj).__module__}.{type(obj).__qualname__}(")
+        for k, v in sorted(obj.get_params(deep=False).items()):
+            _update(h, f"{k}=")
+            _normalize(v, h)
+            _update(h, ",")
+        _update(h, ")")
+    elif isinstance(obj, np.ndarray):
+        _update(h, f"nd:{obj.shape}:{obj.dtype}:")
+        h.update(np.ascontiguousarray(obj).tobytes())
+    elif isinstance(obj, (list, tuple)):
+        _update(h, f"{type(obj).__name__}[")
+        for v in obj:
+            _normalize(v, h)
+            _update(h, ",")
+        _update(h, "]")
+    elif isinstance(obj, dict):
+        _update(h, "dict{")
+        for k in sorted(obj, key=repr):
+            _update(h, f"{k!r}:")
+            _normalize(obj[k], h)
+            _update(h, ",")
+        _update(h, "}")
+    elif callable(obj):
+        _update(h, f"fn:{getattr(obj, '__module__', '')}."
+                   f"{getattr(obj, '__qualname__', repr(obj))}")
+    else:
+        _update(h, f"{type(obj).__name__}:{obj!r}")
+
+
+def tokenize(*args) -> str:
+    h = hashlib.sha256()
+    for a in args:
+        _normalize(a, h)
+        _update(h, ";")
+    return h.hexdigest()[:32]

@@ -1,0 +1,110 @@
+"""Test doubles for the search driver
+(reference: model_selection/utils_test.py).
+
+These are behavioral probes, not models: ``FailingClassifier`` drives the
+``error_score``/FIT_FAILURE tests (reference: utils_test.py:76-93),
+``MockClassifier`` is a minimal duck-typed estimator, ``ScalingTransformer``
+a trivial pipeline stage, ``CheckXClassifier`` asserts what data actually
+reaches ``fit``, and ``CountingTransformer`` counts real (non-memoized) fit
+executions so work-sharing/CSE is directly testable.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+from sklearn.base import BaseEstimator, ClassifierMixin, TransformerMixin
+
+
+class MockClassifier(BaseEstimator, ClassifierMixin):
+    """Trivial classifier recording what it saw
+    (reference: utils_test.py:12-45)."""
+
+    def __init__(self, foo_param=0):
+        self.foo_param = foo_param
+
+    def fit(self, X, y=None):
+        self.classes_ = np.unique(np.asarray(y)) if y is not None else None
+        self.n_features_in_ = np.asarray(X).shape[1]
+        return self
+
+    def predict(self, X):
+        return np.zeros(np.asarray(X).shape[0], dtype=np.int64)
+
+    def score(self, X=None, y=None):
+        return 1.0 if self.foo_param > 1 else 0.0
+
+
+class ScalingTransformer(BaseEstimator, TransformerMixin):
+    """Multiply by a factor (reference: utils_test.py:48-56)."""
+
+    def __init__(self, factor=1.0):
+        self.factor = factor
+
+    def fit(self, X, y=None):
+        self.factor_ = self.factor
+        return self
+
+    def transform(self, X):
+        return np.asarray(X) * self.factor_
+
+
+class CountingTransformer(ScalingTransformer):
+    """ScalingTransformer that counts actual fit executions across threads —
+    the probe for prefix-sharing (one fit per distinct config, not per
+    candidate)."""
+
+    _lock = threading.Lock()
+    n_fits = 0  # class-level: survives the driver's deepcopies
+
+    def fit(self, X, y=None):
+        with CountingTransformer._lock:
+            CountingTransformer.n_fits += 1
+        return super().fit(X, y)
+
+    @classmethod
+    def reset(cls):
+        with cls._lock:
+            cls.n_fits = 0
+
+
+class FailingClassifier(BaseEstimator, ClassifierMixin):
+    """Raises inside fit when parameter == FAILING_PARAMETER
+    (reference: utils_test.py:76-93)."""
+
+    FAILING_PARAMETER = 2
+
+    def __init__(self, parameter=None):
+        self.parameter = parameter
+
+    def fit(self, X, y=None):
+        if self.parameter == FailingClassifier.FAILING_PARAMETER:
+            raise ValueError("Failing classifier failed as required")
+        self.classes_ = np.unique(np.asarray(y)) if y is not None else None
+        return self
+
+    def predict(self, X):
+        return np.zeros(np.asarray(X).shape[0], dtype=np.int64)
+
+    def score(self, X=None, y=None):
+        return 0.0
+
+
+class CheckXClassifier(BaseEstimator, ClassifierMixin):
+    """Asserts the X it receives equals ``expected_X``
+    (reference: utils_test.py:59-73)."""
+
+    def __init__(self, expected_X=None):
+        self.expected_X = expected_X
+
+    def fit(self, X, y=None):
+        assert np.array_equal(np.asarray(X), np.asarray(self.expected_X))
+        self.classes_ = np.unique(np.asarray(y))
+        return self
+
+    def predict(self, X):
+        return np.zeros(np.asarray(X).shape[0], dtype=np.int64)
+
+    def score(self, X=None, y=None):
+        return 1.0

@@ -60,9 +60,10 @@ def _m_step(X, w, labels, centers):
     onehot = jax.nn.one_hot(labels, k, dtype=X.dtype) * w[:, None]
     sums = onehot.T @ X  # (k, d): contraction over the sharded axis → psum
     counts = jnp.sum(onehot, axis=0)
-    new_centers = jnp.where(
-        counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], centers
-    )
+    # counts are *weighted* sums and may legitimately be in (0, 1); clamp only
+    # exact zeros (empty clusters keep their old center).
+    safe = jnp.where(counts > 0, counts, 1.0)
+    new_centers = jnp.where(counts[:, None] > 0, sums / safe[:, None], centers)
     return new_centers, counts
 
 

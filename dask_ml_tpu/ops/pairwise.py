@@ -34,10 +34,13 @@ def euclidean_distances(X: jax.Array, Y: jax.Array | None = None) -> jax.Array:
     if Y is None:
         # X-vs-X: force an exactly-zero diagonal; the ‖x‖²+‖y‖²−2x·y form
         # leaves ~1e-3 of f32 cancellation error there (sklearn does the same
-        # zeroing in its euclidean_distances).
+        # zeroing in its euclidean_distances). Iota comparison fuses into the
+        # epilogue without materializing an n×n identity.
         d2 = sq_euclidean(X, X)
         n = d2.shape[0]
-        d2 = d2 * (1.0 - jnp.eye(n, dtype=d2.dtype))
+        rows = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
+        d2 = jnp.where(rows == cols, 0.0, d2)
         return jnp.sqrt(d2)
     return jnp.sqrt(sq_euclidean(X, Y))
 

@@ -1,0 +1,388 @@
+"""Search driver tests — structure parity vs sklearn's GridSearchCV, the
+error_score semantics the reference's suite pins down, and work-sharing
+(reference: tests/model_selection/dask_searchcv/test_model_selection.py and
+test_model_selection_sklearn.py)."""
+
+import pickle
+
+import numpy as np
+import pytest
+from sklearn.cluster import KMeans as SKKMeans
+from sklearn.datasets import make_blobs, make_classification
+from sklearn.decomposition import PCA as SKPCA
+from sklearn.exceptions import FitFailedWarning
+from sklearn.linear_model import LogisticRegression as SKLogisticRegression
+from sklearn.model_selection import GridSearchCV as SkGridSearchCV
+from sklearn.pipeline import Pipeline
+from sklearn.preprocessing import StandardScaler as SKStandardScaler
+from sklearn.svm import SVC
+
+from dask_ml_tpu.model_selection import (
+    GridSearchCV,
+    KFold,
+    RandomizedSearchCV,
+)
+from dask_ml_tpu.model_selection.utils_test import (
+    CheckXClassifier,
+    CountingTransformer,
+    FailingClassifier,
+    MockClassifier,
+    ScalingTransformer,
+)
+
+
+@pytest.fixture
+def clf_data():
+    return make_classification(
+        n_samples=120, n_features=5, random_state=0, n_informative=3
+    )
+
+
+def test_grid_search_basic(clf_data):
+    X, y = clf_data
+    grid = {"C": [0.1, 1.0, 10.0]}
+    gs = GridSearchCV(SKLogisticRegression(), grid, cv=3, iid=False)
+    gs.fit(X, y)
+    assert hasattr(gs, "cv_results_")
+    assert gs.best_index_ in range(3)
+    assert gs.best_params_ in [{"C": c} for c in grid["C"]]
+    assert 0.0 <= gs.best_score_ <= 1.0
+    # delegated post-fit methods
+    assert gs.predict(X).shape == (120,)
+    assert gs.predict_proba(X).shape == (120, 2)
+    assert gs.score(X, y) > 0.5
+    assert set(gs.classes_) == {0, 1}
+
+
+def test_cv_results_structure_matches_sklearn(clf_data):
+    """Same keys and same mean scores as sklearn's GridSearchCV on identical
+    deterministic splits (the reference ports sklearn's suite the same way)."""
+    X, y = clf_data
+    grid = {"C": [0.1, 1.0], "fit_intercept": [True, False]}
+    cv = KFold(n_splits=3)
+    splits = list(cv.split(X, y))
+
+    ours = GridSearchCV(
+        SKLogisticRegression(), grid, cv=splits, iid=False,
+        return_train_score=True,
+    ).fit(X, y)
+    theirs = SkGridSearchCV(
+        SKLogisticRegression(), grid, cv=iter(splits),
+        return_train_score=True,
+    ).fit(X, y)
+
+    assert set(theirs.cv_results_) <= set(ours.cv_results_)
+    np.testing.assert_allclose(
+        ours.cv_results_["mean_test_score"],
+        theirs.cv_results_["mean_test_score"],
+        rtol=1e-6,
+    )
+    np.testing.assert_array_equal(
+        ours.cv_results_["rank_test_score"],
+        theirs.cv_results_["rank_test_score"],
+    )
+    assert ours.best_index_ == theirs.best_index_
+    for key in ("param_C", "param_fit_intercept"):
+        np.testing.assert_array_equal(
+            ours.cv_results_[key].data, theirs.cv_results_[key].data
+        )
+
+
+def test_iid_weighting(clf_data):
+    X, y = clf_data
+    # uneven splits → iid weighting must change the mean
+    splits = [
+        (np.arange(60), np.arange(60, 70)),
+        (np.arange(40), np.arange(40, 120)),
+    ]
+    g_iid = GridSearchCV(
+        SKLogisticRegression(), {"C": [1.0]}, cv=splits, iid=True, refit=False
+    ).fit(X, y)
+    g_flat = GridSearchCV(
+        SKLogisticRegression(), {"C": [1.0]}, cv=splits, iid=False, refit=False
+    ).fit(X, y)
+    s0 = g_iid.cv_results_["split0_test_score"][0]
+    s1 = g_iid.cv_results_["split1_test_score"][0]
+    expected = (10 * s0 + 80 * s1) / 90
+    np.testing.assert_allclose(
+        g_iid.cv_results_["mean_test_score"][0], expected, rtol=1e-12
+    )
+    np.testing.assert_allclose(
+        g_flat.cv_results_["mean_test_score"][0], (s0 + s1) / 2, rtol=1e-12
+    )
+
+
+def test_error_score_numeric():
+    X = np.random.RandomState(0).randn(60, 3)
+    y = np.r_[np.zeros(30), np.ones(30)].astype(int)
+    grid = {"parameter": [0, 1, FailingClassifier.FAILING_PARAMETER]}
+    gs = GridSearchCV(
+        FailingClassifier(), grid, cv=3, error_score=-999.0, refit=False,
+        return_train_score=True,
+    )
+    with pytest.warns(FitFailedWarning):
+        gs.fit(X, y)
+    res = gs.cv_results_
+    for i in range(3):
+        assert res[f"split{i}_test_score"][2] == -999.0
+        assert res[f"split{i}_train_score"][2] == -999.0
+    assert res["mean_test_score"][2] == -999.0
+    # non-failing candidates unaffected
+    assert (res["mean_test_score"][:2] != -999.0).all()
+
+
+def test_error_score_raise():
+    X = np.random.RandomState(0).randn(60, 3)
+    y = np.r_[np.zeros(30), np.ones(30)].astype(int)
+    gs = GridSearchCV(
+        FailingClassifier(),
+        {"parameter": [FailingClassifier.FAILING_PARAMETER]},
+        cv=3,
+        error_score="raise",
+        refit=False,
+    )
+    with pytest.raises(ValueError, match="Failing classifier failed"):
+        gs.fit(X, y)
+
+
+def test_error_score_in_pipeline():
+    """FIT_FAILURE flows through pipeline reassembly
+    (reference: methods.py:158-180, test_model_selection.py:466-537)."""
+    X = np.random.RandomState(0).randn(60, 3)
+    y = np.r_[np.zeros(30), np.ones(30)].astype(int)
+    pipe = Pipeline([
+        ("scale", ScalingTransformer()),
+        ("clf", FailingClassifier()),
+    ])
+    grid = {"clf__parameter": [0, FailingClassifier.FAILING_PARAMETER]}
+    gs = GridSearchCV(pipe, grid, cv=3, error_score=-1.0, refit=False)
+    with pytest.warns(FitFailedWarning):
+        gs.fit(X, y)
+    assert gs.cv_results_["mean_test_score"][1] == -1.0
+
+
+def test_error_score_invalid():
+    with pytest.raises(ValueError, match="error_score"):
+        GridSearchCV(
+            MockClassifier(), {"foo_param": [1]}, error_score="nope"
+        ).fit(np.zeros((10, 2)), np.zeros(10))
+
+
+def test_pipeline_prefix_cse():
+    """A shared pipeline prefix is fit once per split, not once per candidate
+    (reference: _search.py:462-503 + docs/source/hyper-parameter-search.rst)."""
+    X, y = make_classification(n_samples=60, n_features=5, random_state=0)
+    CountingTransformer.reset()
+    pipe = Pipeline([
+        ("tf", CountingTransformer(factor=2.0)),
+        ("clf", SKLogisticRegression()),
+    ])
+    grid = {"clf__C": [0.1, 1.0, 10.0, 100.0]}
+    gs = GridSearchCV(pipe, grid, cv=3, refit=False, n_jobs=4)
+    gs.fit(X, y)
+    # 4 candidates share one transformer config: 3 fits (one per split),
+    # not 12.
+    assert CountingTransformer.n_fits == 3
+    # and with two transformer configs: 6
+    CountingTransformer.reset()
+    grid2 = {"tf__factor": [1.0, 2.0], "clf__C": [0.1, 1.0]}
+    GridSearchCV(pipe, grid2, cv=3, refit=False, n_jobs=4).fit(X, y)
+    assert CountingTransformer.n_fits == 6
+
+
+def test_duplicate_candidates_deduped():
+    X, y = make_classification(n_samples=60, n_features=5, random_state=0)
+    CountingTransformer.reset()
+    gs = GridSearchCV(
+        CountingTransformer(),
+        {"factor": [2.0, 2.0]},  # identical candidates
+        cv=2,
+        refit=False,
+        scoring="accuracy",
+    )
+    # CountingTransformer has no score; give a trivial scorer
+    gs.scoring = lambda est, X, y: 0.0
+    gs.fit(X, y)
+    assert CountingTransformer.n_fits == 2  # one per split, not per candidate
+
+
+def test_multimetric(clf_data):
+    X, y = clf_data
+    gs = GridSearchCV(
+        SKLogisticRegression(),
+        {"C": [0.1, 1.0]},
+        cv=3,
+        scoring=["accuracy", "neg_log_loss"],
+        refit="accuracy",
+        iid=False,
+    )
+    gs.fit(X, y)
+    res = gs.cv_results_
+    for m in ("accuracy", "neg_log_loss"):
+        assert f"mean_test_{m}" in res
+        assert f"rank_test_{m}" in res
+        assert f"split0_test_{m}" in res
+    assert gs.multimetric_
+    assert hasattr(gs, "best_estimator_")
+
+    with pytest.raises(ValueError, match="refit"):
+        GridSearchCV(
+            SKLogisticRegression(), {"C": [1.0]}, cv=3,
+            scoring=["accuracy", "r2"], refit=True,
+        ).fit(X, y)
+
+
+def test_scoring_from_our_registry(clf_data):
+    X, y = clf_data
+    gs = GridSearchCV(
+        SKLogisticRegression(), {"C": [1.0]}, cv=3, scoring="accuracy",
+        refit=False, iid=False,
+    ).fit(X, y)
+    sk = SkGridSearchCV(
+        SKLogisticRegression(), {"C": [1.0]}, cv=3, scoring="accuracy",
+        refit=False,
+    ).fit(X, y)
+    np.testing.assert_allclose(
+        gs.cv_results_["mean_test_score"], sk.cv_results_["mean_test_score"],
+        rtol=1e-6,
+    )
+
+
+def test_randomized_search(clf_data):
+    X, y = clf_data
+    import scipy.stats
+
+    rs = RandomizedSearchCV(
+        SKLogisticRegression(),
+        {"C": scipy.stats.uniform(0.1, 10)},
+        n_iter=5,
+        cv=3,
+        random_state=0,
+        iid=False,
+    )
+    rs.fit(X, y)
+    assert len(rs.cv_results_["params"]) == 5
+    assert hasattr(rs, "best_estimator_")
+    # deterministic under the same seed
+    rs2 = RandomizedSearchCV(
+        SKLogisticRegression(), {"C": scipy.stats.uniform(0.1, 10)},
+        n_iter=5, cv=3, random_state=0, iid=False,
+    ).fit(X, y)
+    assert rs.cv_results_["params"] == rs2.cv_results_["params"]
+
+
+def test_refit_false_blocks_delegation(clf_data):
+    X, y = clf_data
+    gs = GridSearchCV(
+        SKLogisticRegression(), {"C": [1.0]}, cv=3, refit=False
+    ).fit(X, y)
+    assert not hasattr(gs, "best_estimator_")
+    with pytest.raises(AttributeError, match="refit=False"):
+        gs.predict(X)
+
+
+def test_check_x_reaches_fit():
+    """The exact training slice reaches fit (reference: utils_test.py:59-73)."""
+    X = np.arange(40, dtype=np.float64).reshape(20, 2)
+    y = np.r_[np.zeros(10), np.ones(10)].astype(int)
+    splits = [(np.arange(10), np.arange(10, 20))]
+    gs = GridSearchCV(
+        CheckXClassifier(expected_X=X[:10]), {}, cv=splits, refit=False
+    )
+    gs.fit(X, y)
+    assert gs.cv_results_["mean_test_score"][0] == 1.0
+
+
+def test_pairwise_kernel_slicing():
+    """Precomputed kernels are sliced on both axes
+    (reference: methods.py:110-124)."""
+    X, y = make_classification(n_samples=60, n_features=4, random_state=0)
+    K = X @ X.T
+    gs = GridSearchCV(
+        SVC(kernel="precomputed"), {"C": [0.5, 1.0]}, cv=3, iid=False,
+        refit=False,
+    )
+    gs.fit(K, y)
+    sk = SkGridSearchCV(
+        SVC(kernel="precomputed"), {"C": [0.5, 1.0]}, cv=3, refit=False
+    ).fit(K, y)
+    np.testing.assert_allclose(
+        gs.cv_results_["mean_test_score"], sk.cv_results_["mean_test_score"],
+        rtol=1e-6,
+    )
+
+
+def test_search_over_tpu_kmeans():
+    """A search over this framework's own estimators runs on the mesh."""
+    from dask_ml_tpu.cluster import KMeans
+
+    X, _ = make_blobs(n_samples=200, centers=3, n_features=4, random_state=0)
+    X = X.astype(np.float32)
+    gs = GridSearchCV(
+        KMeans(init="random", random_state=0, max_iter=20),
+        {"n_clusters": [2, 3, 4]},
+        cv=2,
+        refit=True,
+        iid=False,
+    )
+    gs.fit(X)
+    assert gs.best_params_["n_clusters"] in (2, 3, 4)
+    assert gs.predict(X).shape == (200,)
+
+
+def test_fit_params_reach_cv_fits(clf_data):
+    """fit_params must be threaded into every candidate x split fit, not just
+    the refit (reference passes fit_params into every graph fit task)."""
+    X, y = clf_data
+    w = np.where(y == 0, 25.0, 1.0)  # heavily favor class 0 → shifted boundary
+    gs_w = GridSearchCV(
+        SKLogisticRegression(), {"C": [1.0]}, cv=3, iid=False, refit=False
+    ).fit(X, y, sample_weight=w)
+    gs_u = GridSearchCV(
+        SKLogisticRegression(), {"C": [1.0]}, cv=3, iid=False, refit=False
+    ).fit(X, y)
+    assert not np.allclose(
+        gs_w.cv_results_["mean_test_score"], gs_u.cv_results_["mean_test_score"]
+    )
+
+
+def test_fit_params_pipeline_routing(clf_data):
+    """Step-prefixed fit params route to the right pipeline stage."""
+    X, y = clf_data
+    w = np.ones(len(y))
+    pipe = Pipeline([
+        ("scale", SKStandardScaler()),
+        ("clf", SKLogisticRegression()),
+    ])
+    gs = GridSearchCV(pipe, {"clf__C": [1.0]}, cv=3, iid=False, refit=False)
+    gs.fit(X, y, clf__sample_weight=w)  # would raise if routed to the scaler
+    assert np.isfinite(gs.cv_results_["mean_test_score"]).all()
+
+
+def test_grid_search_pickles(clf_data):
+    X, y = clf_data
+    gs = GridSearchCV(
+        SKLogisticRegression(), {"C": [1.0]}, cv=3, iid=False
+    ).fit(X, y)
+    gs2 = pickle.loads(pickle.dumps(gs))
+    np.testing.assert_array_equal(gs2.predict(X), gs.predict(X))
+
+
+def test_full_pipeline_grid_matches_sklearn(clf_data):
+    """3-stage pipeline grid, parity with sklearn over shared splits — the
+    worked example of docs/source/hyper-parameter-search.rst:78-135."""
+    X, y = clf_data
+    pipe = Pipeline([
+        ("scale", SKStandardScaler()),
+        ("pca", SKPCA(n_components=3, random_state=0)),
+        ("clf", SKLogisticRegression()),
+    ])
+    grid = {"pca__n_components": [2, 3], "clf__C": [0.1, 1.0]}
+    splits = list(KFold(n_splits=3).split(X, y))
+    ours = GridSearchCV(pipe, grid, cv=splits, iid=False, refit=False).fit(X, y)
+    theirs = SkGridSearchCV(pipe, grid, cv=iter(splits), refit=False).fit(X, y)
+    np.testing.assert_allclose(
+        ours.cv_results_["mean_test_score"],
+        theirs.cv_results_["mean_test_score"],
+        rtol=1e-6,
+    )

@@ -1,0 +1,112 @@
+"""Splitter tests (reference: tests/model_selection/dask_searchcv tests and
+tests/test_train_test_split.py semantics)."""
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu.model_selection import (
+    KFold,
+    ShuffleSplit,
+    check_cv,
+    compute_n_splits,
+    train_test_split,
+)
+
+
+def test_shuffle_split_basic():
+    X = np.arange(1000).reshape(100, 10)
+    ss = ShuffleSplit(n_splits=3, test_size=0.2, random_state=0)
+    splits = list(ss.split(X))
+    assert len(splits) == 3
+    for train, test in splits:
+        assert len(np.intersect1d(train, test)) == 0
+        assert len(test) == pytest.approx(20, abs=8)  # blockwise rounding
+        assert train.max() < 100 and test.max() < 100
+        # sorted indices → shard-local gathers stay ordered
+        assert (np.diff(train) > 0).all()
+
+
+def test_shuffle_split_deterministic():
+    X = np.zeros((64, 2))
+    a = list(ShuffleSplit(n_splits=2, test_size=0.25, random_state=7).split(X))
+    b = list(ShuffleSplit(n_splits=2, test_size=0.25, random_state=7).split(X))
+    for (tr1, te1), (tr2, te2) in zip(a, b):
+        np.testing.assert_array_equal(tr1, tr2)
+        np.testing.assert_array_equal(te1, te2)
+
+
+def test_shuffle_split_blockwise_is_shard_local():
+    # With n_blocks=4 over 100 rows, each block of 25 contributes its own
+    # train/test rows (the reference's per-chunk split, _split.py:144-173).
+    X = np.zeros((100, 2))
+    ss = ShuffleSplit(n_splits=1, test_size=0.2, n_blocks=4, random_state=0)
+    train, test = next(ss.split(X))
+    for lo in range(0, 100, 25):
+        n_test_blk = ((test >= lo) & (test < lo + 25)).sum()
+        assert n_test_blk == 5  # int(25 * 0.2) per block
+
+
+def test_shuffle_split_int_sizes_rejected():
+    with pytest.raises(ValueError, match="float fraction"):
+        next(ShuffleSplit(n_splits=1, test_size=10).split(np.zeros((100, 2))))
+
+
+def test_kfold():
+    X = np.zeros((10, 2))
+    kf = KFold(n_splits=5)
+    splits = list(kf.split(X))
+    assert len(splits) == 5
+    all_test = np.concatenate([te for _, te in splits])
+    np.testing.assert_array_equal(np.sort(all_test), np.arange(10))
+    for train, test in splits:
+        assert len(train) == 8 and len(test) == 2
+        assert len(np.intersect1d(train, test)) == 0
+
+
+def test_kfold_uneven():
+    X = np.zeros((11, 2))
+    sizes = [len(te) for _, te in KFold(n_splits=3).split(X)]
+    assert sorted(sizes) == [3, 4, 4]
+
+
+def test_check_cv():
+    assert isinstance(check_cv(None), KFold)
+    assert check_cv(None).n_splits == 5
+    assert isinstance(check_cv(3), KFold)
+    # classifier + categorical y → stratified
+    import sklearn.model_selection as sk_ms
+
+    y = np.array([0, 1] * 10)
+    cv = check_cv(3, y, classifier=True)
+    assert isinstance(cv, sk_ms.StratifiedKFold)
+    # pass-through of splitter instances
+    ss = ShuffleSplit(n_splits=2)
+    assert check_cv(ss) is ss
+    assert compute_n_splits(ss, np.zeros((10, 2))) == 2
+
+
+def test_train_test_split():
+    X = np.arange(200).reshape(100, 2)
+    y = np.arange(100)
+    X_train, X_test, y_train, y_test = train_test_split(
+        X, y, test_size=0.2, random_state=0
+    )
+    assert len(X_train) + len(X_test) == pytest.approx(100, abs=8)
+    assert len(X_train) == len(y_train)
+    assert len(X_test) == len(y_test)
+    # rows stay intact and aligned
+    np.testing.assert_array_equal(X_train[:, 0] // 2, y_train)
+    np.testing.assert_array_equal(X_test[:, 0] // 2, y_test)
+    # no leakage
+    assert len(np.intersect1d(y_train, y_test)) == 0
+
+
+def test_train_test_split_validation():
+    with pytest.raises(ValueError, match="At least one array"):
+        train_test_split()
+    with pytest.raises(ValueError, match="inconsistent"):
+        train_test_split(np.zeros((10, 2)), np.zeros(11))
+    with pytest.raises(NotImplementedError):
+        train_test_split(np.zeros((10, 2)), shuffle=False)
+    with pytest.raises(TypeError, match="Unexpected options"):
+        train_test_split(np.zeros((10, 2)), bogus=1)
