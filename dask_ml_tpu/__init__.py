@@ -12,6 +12,8 @@ Public subpackages mirror the reference API surface
 (reference: docs/source/modules/api.rst):
 
 - :mod:`dask_ml_tpu.cluster` — KMeans (k-means|| init)
+- :mod:`dask_ml_tpu.linear_model` — GLMs (Logistic/Linear/Poisson) over the
+  native solver suite (ADMM, L-BFGS, Newton, gradient/proximal descent)
 - :mod:`dask_ml_tpu.metrics` — sharded metrics + pairwise kernels + scorers
 - :mod:`dask_ml_tpu.model_selection` — ShuffleSplit/KFold/train_test_split,
   GridSearchCV/RandomizedSearchCV with work-sharing
@@ -28,6 +30,7 @@ __version__ = "0.2.0"
 
 __all__ = [
     "cluster",
+    "linear_model",
     "metrics",
     "model_selection",
     "datasets",

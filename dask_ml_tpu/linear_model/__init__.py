@@ -1,0 +1,10 @@
+"""Generalized Linear Models with native TPU solvers
+(reference: linear_model/glm.py; solver suite reference: SURVEY §2.4)."""
+
+from dask_ml_tpu.linear_model.glm import (  # noqa: F401
+    LinearRegression,
+    LogisticRegression,
+    PoissonRegression,
+)
+
+__all__ = ["LogisticRegression", "LinearRegression", "PoissonRegression"]

@@ -1,0 +1,545 @@
+"""GLM functional core: families, regularizers, and five native solvers.
+
+The reference delegates every GLM fit to the external ``dask-glm`` package
+(reference: linear_model/glm.py:6,157 — ``dask_glm.algorithms._solvers``); the
+survey assigns the solver suite itself to this build (SURVEY §2.4, §7.2-5).
+This module is that replacement, designed TPU-first:
+
+- A solver iteration is ONE fused XLA program over the sharded data: the
+  linear predictor ``X @ beta`` and the gradient pullback ``X.T @ r`` are
+  matmuls whose contraction over the sharded sample axis makes XLA insert a
+  ``psum`` over the ICI automatically. No per-iteration driver round-trip —
+  each solver's full optimization loop is a ``lax.while_loop`` on device
+  (the reference pays a dask-graph barrier per iteration; see the same design
+  move in :mod:`dask_ml_tpu.models.kmeans`).
+- ADMM is the one genuinely per-shard-state algorithm (each data block keeps
+  its own primal/dual variables), so it is written with ``jax.shard_map``:
+  local Newton prox-solves per shard, consensus z-update via ``psum``
+  — the TPU-native analogue of dask-glm's per-chunk ``local_update`` +
+  driver-side consensus reduction.
+- Gradients and values come from ``jax.value_and_grad`` on the weighted
+  objective — no hand-derived gradient code to drift out of sync; curvature
+  (Newton / local ADMM Hessians) uses the standard GLM weights
+  ``X.T @ diag(w·h(eta)) @ X`` which keeps the FLOPs on the MXU.
+
+Objective convention: with per-row weights ``w`` (0 on padding rows) and
+``SW = Σ w``, all solvers minimize
+
+    f(beta) = (1/SW)·Σ w_i·ℓ(x_i·beta, y_i) + (lamduh/SW)·P(beta ⊙ mask)
+
+which has the same minimizer as the reference's sum-loss parameterization
+(``lamduh = 1/C``, reference: linear_model/glm.py:118) but is better
+conditioned at large n. ``mask`` excludes the intercept column from the
+penalty (deliberate deviation from dask-glm, which penalizes the appended
+intercept column; unpenalized intercepts match sklearn and are what the
+differential tests check).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from dask_ml_tpu.parallel.mesh import DATA_AXIS
+
+# ---------------------------------------------------------------------------
+# Families: pointwise loss ℓ(eta, y) and curvature h(eta, y) = ∂²ℓ/∂eta²
+# (reference counterpart: dask_glm.families used at linear_model/glm.py:86-112)
+# ---------------------------------------------------------------------------
+
+_ETA_MAX = 30.0  # clip for exp() links; exp(30) ~ 1e13 stays finite in f32
+
+
+def _logistic_loss(eta, y):
+    # softplus(eta) - y*eta is the numerically stable negative log-likelihood
+    return jax.nn.softplus(eta) - y * eta
+
+
+def _logistic_hess(eta, y):
+    p = jax.nn.sigmoid(eta)
+    return p * (1.0 - p)
+
+
+def _normal_loss(eta, y):
+    return 0.5 * (eta - y) ** 2
+
+
+def _normal_hess(eta, y):
+    return jnp.ones_like(eta)
+
+
+def _poisson_loss(eta, y):
+    eta = jnp.clip(eta, -_ETA_MAX, _ETA_MAX)
+    return jnp.exp(eta) - y * eta
+
+
+def _poisson_hess(eta, y):
+    return jnp.exp(jnp.clip(eta, -_ETA_MAX, _ETA_MAX))
+
+
+FAMILIES = {
+    "logistic": (_logistic_loss, _logistic_hess),
+    "normal": (_normal_loss, _normal_hess),
+    "poisson": (_poisson_loss, _poisson_hess),
+}
+
+
+# ---------------------------------------------------------------------------
+# Regularizers: value P(b) and prox_{t·P}(v)
+# (reference counterpart: dask_glm.regularizers selected at glm.py:117-125)
+# ---------------------------------------------------------------------------
+
+
+def _l2_value(b):
+    return 0.5 * jnp.sum(b * b)
+
+
+def _l2_prox(v, t):
+    return v / (1.0 + t)
+
+
+def _l1_value(b):
+    return jnp.sum(jnp.abs(b))
+
+
+def _soft_threshold(v, t):
+    return jnp.sign(v) * jnp.maximum(jnp.abs(v) - t, 0.0)
+
+
+def _en_value(b, weight=0.5):
+    return weight * _l1_value(b) + (1.0 - weight) * _l2_value(b)
+
+
+def _en_prox(v, t, weight=0.5):
+    return _soft_threshold(v, weight * t) / (1.0 + (1.0 - weight) * t)
+
+
+REGULARIZERS = {
+    "l2": (_l2_value, _l2_prox),
+    "l1": (_l1_value, _soft_threshold),
+    "elastic_net": (_en_value, _en_prox),
+}
+
+
+def _penalty(regularizer):
+    if regularizer not in REGULARIZERS:
+        raise ValueError(
+            f"regularizer must be one of {sorted(REGULARIZERS)}, "
+            f"got {regularizer!r}"
+        )
+    return REGULARIZERS[regularizer]
+
+
+def _make_objective(family, regularizer, smooth_penalty: bool):
+    """Weighted-mean objective ``f(beta, X, y, w, lam_eff, mask)``.
+
+    ``smooth_penalty=True`` folds lam·P into the differentiated objective
+    (GD/Newton/L-BFGS path); ``False`` leaves P to a prox step (ISTA/ADMM).
+    """
+    loss_fn, _ = FAMILIES[family]
+    pen_value, _ = _penalty(regularizer)
+
+    def objective(beta, X, y, w, lam_eff, mask):
+        eta = X @ beta
+        f = jnp.sum(w * loss_fn(eta, y))
+        if smooth_penalty:
+            f = f + lam_eff * pen_value(beta * mask)
+        return f
+
+    return objective
+
+
+# ---------------------------------------------------------------------------
+# Shared line search: Armijo backtracking as an on-device while_loop
+# ---------------------------------------------------------------------------
+
+
+def _backtrack(obj, beta, f0, g, direction, t0, c=1e-4, shrink=0.5,
+               max_back=30):
+    """Backtracking line search. Returns (t, f_new, n_backtracks)."""
+    gd = jnp.dot(g, direction)
+
+    def cond(state):
+        t, f_new, j = state
+        insufficient = f_new > f0 + c * t * gd
+        return jnp.logical_and(j < max_back,
+                               jnp.logical_or(insufficient,
+                                              ~jnp.isfinite(f_new)))
+
+    def body(state):
+        t, _, j = state
+        t = t * shrink
+        return t, obj(beta + t * direction), j + 1
+
+    t, f_new, j = lax.while_loop(cond, body,
+                                 (t0, obj(beta + t0 * direction), 0))
+    return t, f_new, j
+
+
+# ---------------------------------------------------------------------------
+# Solvers
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("family", "regularizer", "max_iter"))
+def gradient_descent(X, y, w, beta0, mask, *, family="logistic",
+                     regularizer="l2", lamduh=0.0, max_iter=100, tol=1e-4):
+    """Batch gradient descent with Armijo backtracking and step growth
+    (the dask-glm ``gradient_descent`` analogue; the reference strips the
+    regularizer for this solver, linear_model/glm.py:120-122, so the facade
+    passes ``lamduh=0``). Whole optimization is one ``lax.while_loop``."""
+    obj_full = _make_objective(family, regularizer, smooth_penalty=True)
+    sw = jnp.maximum(jnp.sum(w), 1.0)
+    lam_eff = jnp.asarray(lamduh, X.dtype)
+
+    def obj(b):
+        return obj_full(b, X, y, w, lam_eff, mask) / sw
+
+    value_and_grad = jax.value_and_grad(obj)
+
+    def cond(state):
+        _, _, _, it, done = state
+        return jnp.logical_and(it < max_iter, ~done)
+
+    def body(state):
+        beta, f, t_prev, it, _ = state
+        f0, g = value_and_grad(beta)
+        t, f_new, _ = _backtrack(obj, beta, f0, g, -g, t_prev)
+        beta_new = beta - t * g
+        # Relative-improvement stopping rule, like dask-glm's GD.
+        done = jnp.abs(f0 - f_new) <= tol * jnp.maximum(jnp.abs(f0), 1e-10)
+        return beta_new, f_new, jnp.minimum(t * 4.0, 1e3), it + 1, done
+
+    init = (beta0, jnp.asarray(jnp.inf, X.dtype),
+            jnp.asarray(1.0, X.dtype), jnp.asarray(0, jnp.int32),
+            jnp.asarray(False))
+    beta, f, _, n_iter, _ = lax.while_loop(cond, body, init)
+    return beta, n_iter
+
+
+@partial(jax.jit, static_argnames=("family", "regularizer", "max_iter"))
+def newton(X, y, w, beta0, mask, *, family="logistic", regularizer="l2",
+           lamduh=0.0, max_iter=50, tol=1e-4):
+    """Damped Newton: GLM Hessian ``X.T @ (w·h · X) / SW`` (a d×d matmul on
+    the MXU, psum over the sharded axis), dense solve, Armijo backtracking.
+    Reference facade strips the regularizer here too (glm.py:120-122)."""
+    loss_fn, hess_fn = FAMILIES[family]
+    obj_full = _make_objective(family, regularizer, smooth_penalty=True)
+    sw = jnp.maximum(jnp.sum(w), 1.0)
+    lam_eff = jnp.asarray(lamduh, X.dtype)
+    d = X.shape[1]
+
+    def obj(b):
+        return obj_full(b, X, y, w, lam_eff, mask) / sw
+
+    grad = jax.grad(obj)
+
+    def cond(state):
+        _, it, done = state
+        return jnp.logical_and(it < max_iter, ~done)
+
+    def body(state):
+        beta, it, _ = state
+        eta = X @ beta
+        g = grad(beta)
+        h = w * hess_fn(eta, y)
+        H = (X.T @ (h[:, None] * X)) / sw
+        # Smooth-l2 curvature for the penalized coords + a tiny ridge so the
+        # solve never blows up on collinear features.
+        H = H + jnp.diag(lam_eff / sw * mask + 1e-8)
+        direction = -jnp.linalg.solve(H, g)
+        f0 = obj(beta)
+        t, _, _ = _backtrack(obj, beta, f0, g, direction, jnp.asarray(1.0, X.dtype))
+        step = t * direction
+        beta_new = beta + step
+        # Stop on step size OR gradient norm: on rank-deficient designs the
+        # minimizer is a flat manifold, the gradient hits the f32 noise floor
+        # and Newton would otherwise wander in the Hessian's null space.
+        done = jnp.logical_or(jnp.sqrt(jnp.sum(step * step)) < tol,
+                              jnp.max(jnp.abs(g)) < tol)
+        return beta_new, it + 1, done
+
+    init = (beta0, jnp.asarray(0, jnp.int32), jnp.asarray(False))
+    beta, n_iter, _ = lax.while_loop(cond, body, init)
+    return beta, n_iter
+
+
+def _lbfgs_direction(g, S, Y, rho, count, head, m):
+    """Two-loop recursion over fixed-size circular history buffers —
+    fixed shapes so the whole solver stays inside one compiled program."""
+
+    def bwd(i, carry):
+        q, alpha = carry
+        idx = (head - 1 - i) % m
+        valid = i < count
+        a = jnp.where(valid, rho[idx] * jnp.dot(S[idx], q), 0.0)
+        q = q - a * Y[idx]
+        return q, alpha.at[idx].set(a)
+
+    q, alpha = lax.fori_loop(0, m, bwd, (g, jnp.zeros((m,), g.dtype)))
+    newest = (head - 1) % m
+    ys = jnp.dot(S[newest], Y[newest])
+    yy = jnp.dot(Y[newest], Y[newest])
+    gamma = jnp.where(count > 0, ys / jnp.maximum(yy, 1e-30), 1.0)
+    r = gamma * q
+
+    def fwd(i, r):
+        idx = (head - count + i) % m
+        valid = i < count
+        b = rho[idx] * jnp.dot(Y[idx], r)
+        return r + jnp.where(valid, alpha[idx] - b, 0.0) * S[idx]
+
+    return lax.fori_loop(0, m, fwd, r)
+
+
+@partial(jax.jit, static_argnames=("family", "regularizer", "max_iter", "m"))
+def lbfgs(X, y, w, beta0, mask, *, family="logistic", regularizer="l2",
+          lamduh=0.0, max_iter=100, tol=1e-4, m=10):
+    """L-BFGS with an m-pair circular history, entirely on device.
+
+    The reference shells out to scipy's Fortran L-BFGS-B via dask-glm; here
+    the two-loop recursion runs over fixed-shape (m, d) buffers inside the
+    same ``lax.while_loop`` as the data passes, so multi-chip meshes never
+    sync with the host mid-solve. Like dask-glm, an l1 penalty here is
+    handled by subgradient (prefer ``proximal_grad``/``admm`` for sparsity).
+    """
+    obj_full = _make_objective(family, regularizer, smooth_penalty=True)
+    sw = jnp.maximum(jnp.sum(w), 1.0)
+    lam_eff = jnp.asarray(lamduh, X.dtype)
+    d = X.shape[1]
+
+    def obj(b):
+        return obj_full(b, X, y, w, lam_eff, mask) / sw
+
+    value_and_grad = jax.value_and_grad(obj)
+
+    def cond(state):
+        _, g, *_rest, it, done = state
+        return jnp.logical_and(it < max_iter, ~done)
+
+    def body(state):
+        beta, g, f, S, Y, rho, count, head, it, _ = state
+        direction = _lbfgs_direction(g, S, Y, rho, count, head, m)
+        direction = -direction
+        # Safeguard: fall back to steepest descent if the history produced a
+        # non-descent direction (can happen right after a skipped update).
+        descent = jnp.dot(g, direction) < 0
+        direction = jnp.where(descent, direction, -g)
+        t0 = jnp.where(count > 0, 1.0, 1.0 / jnp.maximum(jnp.linalg.norm(g), 1.0))
+        t, f_new, _ = _backtrack(obj, beta, f, g, direction, t0)
+        beta_new = beta + t * direction
+        f_new, g_new = value_and_grad(beta_new)
+        s = beta_new - beta
+        yv = g_new - g
+        sy = jnp.dot(s, yv)
+        ok = sy > 1e-10
+        S = jnp.where(ok, S.at[head].set(s), S)
+        Y = jnp.where(ok, Y.at[head].set(yv), Y)
+        rho = jnp.where(ok, rho.at[head].set(1.0 / jnp.maximum(sy, 1e-30)), rho)
+        head = jnp.where(ok, (head + 1) % m, head)
+        count = jnp.where(ok, jnp.minimum(count + 1, m), count)
+        gnorm = jnp.max(jnp.abs(g_new))
+        rel = jnp.abs(f - f_new) <= tol * jnp.maximum(jnp.abs(f_new), 1e-10)
+        done = jnp.logical_or(gnorm < tol, rel)
+        return beta_new, g_new, f_new, S, Y, rho, count, head, it + 1, done
+
+    f0, g0 = value_and_grad(beta0)
+    init = (beta0, g0, f0,
+            jnp.zeros((m, d), X.dtype), jnp.zeros((m, d), X.dtype),
+            jnp.zeros((m,), X.dtype), jnp.asarray(0, jnp.int32),
+            jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
+            jnp.asarray(False))
+    out = lax.while_loop(cond, body, init)
+    return out[0], out[8]
+
+
+@partial(jax.jit, static_argnames=("family", "regularizer", "max_iter"))
+def proximal_grad(X, y, w, beta0, mask, *, family="logistic",
+                  regularizer="l1", lamduh=0.0, max_iter=100, tol=1e-4):
+    """Proximal gradient (ISTA) with backtracking on the quadratic model —
+    the dask-glm ``proximal_grad`` analogue. Prox is applied only to the
+    penalized coords (``mask``)."""
+    obj_smooth = _make_objective(family, regularizer, smooth_penalty=False)
+    _, pen_prox = _penalty(regularizer)
+    sw = jnp.maximum(jnp.sum(w), 1.0)
+    lam_eff = jnp.asarray(lamduh, X.dtype) / sw
+
+    def fsmooth(b):
+        return obj_smooth(b, X, y, w, 0.0, mask) / sw
+
+    value_and_grad = jax.value_and_grad(fsmooth)
+
+    def prox(v, t):
+        return jnp.where(mask > 0, pen_prox(v, t * lam_eff), v)
+
+    def cond(state):
+        _, _, _, it, done = state
+        return jnp.logical_and(it < max_iter, ~done)
+
+    def body(state):
+        beta, f, t, it, _ = state
+        f0, g = value_and_grad(beta)
+
+        def bt_cond(s):
+            tt, j = s
+            z = prox(beta - tt * g, tt)
+            dz = z - beta
+            quad = f0 + jnp.dot(g, dz) + jnp.sum(dz * dz) / (2.0 * tt)
+            return jnp.logical_and(j < 30, fsmooth(z) > quad + 1e-12)
+
+        def bt_body(s):
+            tt, j = s
+            return tt * 0.5, j + 1
+
+        t, _ = lax.while_loop(bt_cond, bt_body, (t, 0))
+        beta_new = prox(beta - t * g, t)
+        f_new = fsmooth(beta_new)
+        step = jnp.max(jnp.abs(beta_new - beta))
+        done = step <= tol * jnp.maximum(jnp.max(jnp.abs(beta)), 1e-10)
+        return beta_new, f_new, jnp.minimum(t * 2.0, 1e3), it + 1, done
+
+    init = (beta0, jnp.asarray(jnp.inf, X.dtype), jnp.asarray(1.0, X.dtype),
+            jnp.asarray(0, jnp.int32), jnp.asarray(False))
+    beta, _, _, n_iter, _ = lax.while_loop(cond, body, init)
+    return beta, n_iter
+
+
+@partial(jax.jit, static_argnames=("mesh", "family", "regularizer",
+                                   "max_iter", "inner_max_iter"))
+def _admm_impl(X, y, w, beta0, mask, lamduh, rho, abstol, reltol, inner_tol,
+               *, mesh, family, regularizer, max_iter, inner_max_iter):
+    """Jitted ADMM body: the hyperparameter scalars are traced arguments so
+    repeated fits with the same shapes/mesh hit the compile cache (the other
+    four solvers get this via module-level ``@jax.jit``)."""
+    loss_fn, hess_fn = FAMILIES[family]
+    _, pen_prox = _penalty(regularizer)
+    n_shards = mesh.shape[DATA_AXIS]
+    d = X.shape[1]
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
+                  P(), P(), P(), P(), P(), P(), P()),
+        out_specs=(P(), P()),
+    )
+    def run(X_loc, y_loc, w_loc, z0, mask_, lamduh, rho, abstol, reltol,
+            inner_tol):
+        sw = jnp.maximum(lax.psum(jnp.sum(w_loc), DATA_AXIS), 1.0)
+        lam_eff = lamduh / sw
+
+        # Pointwise dℓ/deta via jax.grad of the summed loss (elementwise, so
+        # the gradient of the sum IS the pointwise derivative vector).
+        dloss = jax.grad(lambda e: jnp.sum(loss_fn(e, y_loc)))
+
+        def local_newton(x, z, u):
+            # argmin_x f_i(x) + (rho/2)||x - z + u||²; f_i = Σ_loc w·ℓ / SW
+            def local_grad(xx):
+                eta = X_loc @ xx
+                return X_loc.T @ (w_loc * dloss(eta)) / sw + rho * (xx - z + u)
+
+            def nt_cond(s):
+                xx, it = s
+                return jnp.logical_and(it < inner_max_iter,
+                                       jnp.max(jnp.abs(local_grad(xx))) > inner_tol)
+
+            def nt_body(s):
+                xx, it = s
+                eta = X_loc @ xx
+                g = local_grad(xx)
+                h = w_loc * hess_fn(eta, y_loc)
+                H = (X_loc.T @ (h[:, None] * X_loc)) / sw
+                H = H + rho * jnp.eye(d, dtype=X_loc.dtype)
+                return xx - jnp.linalg.solve(H, g), it + 1
+
+            xx, _ = lax.while_loop(nt_cond, nt_body,
+                                   (x, jnp.asarray(0, jnp.int32)))
+            return xx
+
+        def cond(state):
+            _, _, _, it, done = state
+            return jnp.logical_and(it < max_iter, ~done)
+
+        def body(state):
+            z, x, u, it, _ = state
+            x = local_newton(x, z, u)
+            zbar = lax.psum(x + u, DATA_AXIS) / n_shards
+            t = lam_eff / (rho * n_shards)
+            z_new = jnp.where(mask_ > 0, pen_prox(zbar, t), zbar)
+            u = u + x - z_new
+            # Boyd stopping: primal/dual residuals vs abs+rel tolerances.
+            pri2 = lax.psum(jnp.sum((x - z_new) ** 2), DATA_AXIS)
+            dual = rho * jnp.sqrt(float(n_shards)) * jnp.linalg.norm(z_new - z)
+            xnorm2 = lax.psum(jnp.sum(x * x), DATA_AXIS)
+            unorm2 = lax.psum(jnp.sum(u * u), DATA_AXIS)
+            eps_pri = (jnp.sqrt(float(n_shards * d)) * abstol
+                       + reltol * jnp.maximum(jnp.sqrt(xnorm2),
+                                              jnp.sqrt(float(n_shards))
+                                              * jnp.linalg.norm(z_new)))
+            eps_dual = (jnp.sqrt(float(n_shards * d)) * abstol
+                        + reltol * rho * jnp.sqrt(unorm2))
+            done = jnp.logical_and(jnp.sqrt(pri2) < eps_pri, dual < eps_dual)
+            return z_new, x, u, it + 1, done
+
+        # x and u are per-shard state: mark them varying over the data axis
+        # so the while_loop carry types line up under shard_map's vma checks.
+        x0 = lax.pcast(z0, (DATA_AXIS,), to="varying")
+        u0 = lax.pcast(jnp.zeros((d,), X_loc.dtype), (DATA_AXIS,), to="varying")
+        init = (z0, x0, u0,
+                jnp.asarray(0, jnp.int32), jnp.asarray(False))
+        z, _, _, n_iter, _ = lax.while_loop(cond, body, init)
+        return z, n_iter
+
+    return run(X, y, w, beta0, mask, lamduh, rho, abstol, reltol, inner_tol)
+
+
+def admm(X, y, w, beta0, mask, mesh, *, family="logistic", regularizer="l2",
+         lamduh=0.0, rho=1.0, max_iter=250, abstol=1e-4, reltol=1e-2,
+         inner_max_iter=20, inner_tol=1e-8):
+    """Consensus ADMM over the data mesh (Boyd et al. §7.1.1).
+
+    The genuinely distributed solver: each shard keeps local primal/dual
+    state (x_i, u_i) and solves its prox subproblem with damped Newton on
+    its OWN rows — written with ``jax.shard_map`` so the local d×d Hessian
+    solves never leave the shard; only the z-consensus and the stopping
+    residuals cross the ICI, as ``psum``s. This replaces dask-glm's
+    per-chunk ``local_update`` (scipy L-BFGS per block on workers) +
+    driver-side soft-threshold consensus.
+
+    The z-update prox uses t = lamduh_eff/(rho·N); padding rows have w=0 and
+    drop out of every local sum. Defaults mirror dask-glm's admm
+    (rho=1, abstol=1e-4, reltol=1e-2, max_iter=250).
+    """
+    dt = X.dtype
+    scalars = [jnp.asarray(v, dt) for v in (lamduh, rho, abstol, reltol,
+                                            inner_tol)]
+    return _admm_impl(X, y, w, beta0, mask, *scalars, mesh=mesh,
+                      family=family, regularizer=regularizer,
+                      max_iter=int(max_iter), inner_max_iter=int(inner_max_iter))
+
+
+SOLVERS = ("admm", "gradient_descent", "newton", "lbfgs", "proximal_grad")
+
+
+def solve(solver, X, y, w, beta0, mask, mesh=None, **kwargs):
+    """Solver dispatch — the analogue of ``dask_glm.algorithms._solvers``
+    (reference: linear_model/glm.py:157)."""
+    if solver not in SOLVERS:
+        raise ValueError(
+            f"'solver' must be one of {set(SOLVERS)}. Got {solver!r} instead"
+        )
+    if solver == "admm":
+        if mesh is None:
+            raise ValueError("admm requires a mesh")
+        return admm(X, y, w, beta0, mask, mesh, **kwargs)
+    table = {
+        "gradient_descent": gradient_descent,
+        "newton": newton,
+        "lbfgs": lbfgs,
+        "proximal_grad": proximal_grad,
+    }
+    return table[solver](X, y, w, beta0, mask, **kwargs)
