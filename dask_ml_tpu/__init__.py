@@ -12,6 +12,8 @@ Public subpackages mirror the reference API surface
 (reference: docs/source/modules/api.rst):
 
 - :mod:`dask_ml_tpu.cluster` — KMeans (k-means|| init)
+- :mod:`dask_ml_tpu.decomposition` — PCA / TruncatedSVD over native
+  distributed tsqr + randomized SVD
 - :mod:`dask_ml_tpu.linear_model` — GLMs (Logistic/Linear/Poisson) over the
   native solver suite (ADMM, L-BFGS, Newton, gradient/proximal descent)
 - :mod:`dask_ml_tpu.metrics` — sharded metrics + pairwise kernels + scorers
@@ -30,6 +32,7 @@ __version__ = "0.2.0"
 
 __all__ = [
     "cluster",
+    "decomposition",
     "linear_model",
     "metrics",
     "model_selection",

@@ -1,0 +1,7 @@
+"""Matrix decompositions on sharded tall-skinny data
+(reference: decomposition/ — PCA pca.py, TruncatedSVD truncated_svd.py)."""
+
+from dask_ml_tpu.decomposition.pca import PCA  # noqa: F401
+from dask_ml_tpu.decomposition.truncated_svd import TruncatedSVD  # noqa: F401
+
+__all__ = ["PCA", "TruncatedSVD"]

@@ -1,0 +1,87 @@
+"""Truncated SVD (LSA) on row-sharded arrays, no centering
+(reference: decomposition/truncated_svd.py:142-224)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from sklearn.base import BaseEstimator, TransformerMixin
+
+from dask_ml_tpu.ops import linalg
+from dask_ml_tpu.parallel import mesh as mesh_lib
+from dask_ml_tpu.parallel.sharding import prepare_data, shard_rows, unpad_rows
+from dask_ml_tpu.utils.validation import check_array, check_random_state
+
+
+class TruncatedSVD(BaseEstimator, TransformerMixin):
+    """Dimensionality reduction via truncated SVD without centering.
+
+    ``algorithm``: 'tsqr' (exact distributed QR-SVD then truncate —
+    reference: truncated_svd.py:163-167) or 'randomized' (compressed SVD with
+    ``n_iter`` power iterations — reference: truncated_svd.py:168-171).
+    """
+
+    def __init__(self, n_components=2, algorithm="tsqr", n_iter=5,
+                 random_state=None, tol=0.0):
+        self.algorithm = algorithm
+        self.n_components = n_components
+        self.n_iter = n_iter
+        self.random_state = random_state
+        self.tol = tol
+
+    def _check_array(self, X):
+        X = check_array(X)
+        if self.n_components >= X.shape[1]:
+            raise ValueError(
+                "n_components must be < n_features; "
+                f"got {self.n_components} >= {X.shape[1]}"
+            )
+        return X
+
+    def fit(self, X, y=None):
+        self.fit_transform(X)
+        return self
+
+    def fit_transform(self, X, y=None):
+        X = self._check_array(X)
+        if self.algorithm not in {"tsqr", "randomized"}:
+            raise ValueError(
+                f"algorithm must be 'tsqr' or 'randomized', "
+                f"got {self.algorithm!r}"
+            )
+        k = int(self.n_components)
+        mesh = mesh_lib.default_mesh()
+        data = prepare_data(X, mesh=mesh)
+        if self.algorithm == "tsqr":
+            u, s, v = linalg.tsvd(data.X, mesh=mesh)
+            u, s, v = u[:, :k], s[:k], v[:k]
+        else:
+            key = check_random_state(self.random_state)
+            u, s, v = linalg.svd_compressed(
+                data.X, k, n_power_iter=int(self.n_iter), key=key, mesh=mesh)
+        u, v = linalg.svd_flip(u, v)
+
+        X_transformed = u * s
+        # Variance bookkeeping on the *valid* rows (reference:
+        # truncated_svd.py:174-177 computes both with X.var/ddof=0).
+        Xt_valid = unpad_rows(X_transformed, data.n)
+        explained_var = np.asarray(jnp.var(Xt_valid, axis=0))
+        full_var = float(
+            jnp.var(unpad_rows(data.X, data.n), axis=0).sum())
+        self.components_ = np.asarray(v)
+        self.explained_variance_ = explained_var
+        self.explained_variance_ratio_ = explained_var / full_var
+        self.singular_values_ = np.asarray(s)
+        return np.asarray(Xt_valid)
+
+    def transform(self, X, y=None):
+        X = check_array(X)
+        Xs, n = shard_rows(X)
+        out = Xs @ jnp.asarray(self.components_).T
+        return np.asarray(unpad_rows(out, n))
+
+    def inverse_transform(self, X):
+        X = check_array(X)
+        Xs, n = shard_rows(X)
+        out = Xs @ jnp.asarray(self.components_)
+        return np.asarray(unpad_rows(out, n))

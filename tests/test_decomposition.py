@@ -1,0 +1,166 @@
+"""Differential tests for PCA / TruncatedSVD vs scikit-learn
+(strategy of reference: tests/test_pca.py — fit both on the same data,
+compare learned attributes; tests/test_truncated_svd.py:30-68)."""
+
+import numpy as np
+import pytest
+from sklearn.decomposition import PCA as SKPCA
+from sklearn.decomposition import TruncatedSVD as SKTSVD
+
+from dask_ml_tpu.decomposition import PCA, TruncatedSVD
+
+
+@pytest.fixture
+def data(rng):
+    # Tall-skinny with decaying spectrum so truncation is well-conditioned.
+    base = rng.randn(300, 12) @ np.diag(np.linspace(3, 0.3, 12))
+    return (base + 0.05 * rng.randn(300, 12)).astype(np.float32)
+
+
+@pytest.mark.parametrize("solver", ["full", "tsqr", "randomized", "auto"])
+def test_pca_matches_sklearn(solver, data, any_mesh):
+    k = 4
+    kwargs = {"iterated_power": 4} if solver == "randomized" else {}
+    pca = PCA(n_components=k, svd_solver=solver, random_state=0, **kwargs)
+    pca.fit(data)
+    sk = SKPCA(n_components=k, svd_solver="full").fit(data)
+    np.testing.assert_allclose(pca.mean_, sk.mean_, atol=1e-5)
+    np.testing.assert_allclose(
+        np.abs(pca.components_), np.abs(sk.components_), atol=2e-3)
+    np.testing.assert_allclose(
+        pca.explained_variance_, sk.explained_variance_, rtol=2e-3)
+    np.testing.assert_allclose(
+        pca.explained_variance_ratio_, sk.explained_variance_ratio_,
+        rtol=3e-3)
+    np.testing.assert_allclose(
+        pca.singular_values_, sk.singular_values_, rtol=2e-3)
+    assert pca.noise_variance_ == pytest.approx(sk.noise_variance_, rel=0.05)
+    assert pca.n_components_ == k and pca.n_features_ == 12
+    assert pca.n_samples_ == 300
+
+
+def test_pca_svd_flip_determinism(data, mesh8):
+    """Signs are deterministic (svd_flip), so components_ match sklearn's
+    exactly, not just in absolute value (reference relies on utils.svd_flip
+    for this, pca.py:242)."""
+    pca = PCA(n_components=3, svd_solver="tsqr").fit(data)
+    sk = SKPCA(n_components=3, svd_solver="full").fit(data)
+    np.testing.assert_allclose(pca.components_, sk.components_, atol=2e-3)
+
+
+def test_pca_transform_roundtrip(data, mesh8):
+    pca = PCA(n_components=4, svd_solver="tsqr").fit(data)
+    sk = SKPCA(n_components=4, svd_solver="full").fit(data)
+    np.testing.assert_allclose(
+        pca.transform(data), sk.transform(data), atol=5e-3)
+    # fit_transform agrees with transform-after-fit
+    ft = PCA(n_components=4, svd_solver="tsqr").fit_transform(data)
+    np.testing.assert_allclose(ft, pca.transform(data), atol=5e-3)
+    # inverse_transform round-trips
+    back = pca.inverse_transform(pca.transform(data))
+    np.testing.assert_allclose(back, sk.inverse_transform(sk.transform(data)),
+                               atol=5e-3)
+
+
+def test_pca_whiten(data, mesh8):
+    pca = PCA(n_components=4, whiten=True, svd_solver="tsqr").fit(data)
+    sk = SKPCA(n_components=4, whiten=True, svd_solver="full").fit(data)
+    np.testing.assert_allclose(pca.transform(data), sk.transform(data),
+                               atol=5e-3)
+    ft = PCA(n_components=4, whiten=True, svd_solver="tsqr").fit_transform(data)
+    np.testing.assert_allclose(ft, pca.transform(data), atol=5e-3)
+    # Whitened components have unit variance
+    assert np.allclose(pca.transform(data).var(axis=0, ddof=1), 1.0,
+                       atol=2e-2)
+
+
+def test_pca_score_samples(data, mesh8):
+    """PPCA log-likelihood path (reference: pca.py:387-434)."""
+    pca = PCA(n_components=3, svd_solver="tsqr").fit(data)
+    sk = SKPCA(n_components=3, svd_solver="full").fit(data)
+    np.testing.assert_allclose(pca.score_samples(data),
+                               sk.score_samples(data), rtol=1e-3, atol=5e-2)
+    assert pca.score(data) == pytest.approx(sk.score(data), rel=1e-3)
+    np.testing.assert_allclose(pca.get_covariance(), sk.get_covariance(),
+                               atol=1e-3)
+    np.testing.assert_allclose(pca.get_precision(), sk.get_precision(),
+                               rtol=5e-3, atol=1e-3)
+
+
+def test_pca_n_components_none(data, mesh8):
+    pca = PCA().fit(data)
+    assert pca.n_components_ == 12
+
+
+def test_pca_validation(data, mesh8):
+    with pytest.raises(ValueError, match="Invalid solver"):
+        PCA(svd_solver="bogus").fit(data)
+    with pytest.raises(ValueError, match="n_components"):
+        PCA(n_components=50, svd_solver="tsqr").fit(data)
+    with pytest.raises(NotImplementedError):
+        PCA(n_components=0.5).fit(data)
+
+
+@pytest.mark.parametrize("algorithm", ["tsqr", "randomized"])
+def test_truncated_svd_matches_sklearn(algorithm, data, any_mesh):
+    k = 4
+    tsvd = TruncatedSVD(n_components=k, algorithm=algorithm, n_iter=4,
+                        random_state=0)
+    Xt = tsvd.fit_transform(data)
+    sk = SKTSVD(n_components=k, algorithm="arpack", random_state=0)
+    Xt_sk = sk.fit_transform(data.astype(np.float64))
+    assert Xt.shape == (300, k)
+    np.testing.assert_allclose(tsvd.singular_values_, sk.singular_values_,
+                               rtol=2e-3)
+    np.testing.assert_allclose(np.abs(tsvd.components_),
+                               np.abs(sk.components_), atol=2e-3)
+    np.testing.assert_allclose(tsvd.explained_variance_,
+                               sk.explained_variance_, rtol=5e-3)
+    np.testing.assert_allclose(tsvd.explained_variance_ratio_,
+                               sk.explained_variance_ratio_, rtol=5e-3)
+    np.testing.assert_allclose(np.abs(Xt), np.abs(Xt_sk), atol=5e-3)
+
+
+def test_truncated_svd_transform_consistency(data, mesh8):
+    tsvd = TruncatedSVD(n_components=4)
+    Xt = tsvd.fit_transform(data)
+    np.testing.assert_allclose(Xt, tsvd.transform(data), atol=2e-4)
+    back = tsvd.inverse_transform(Xt)
+    assert back.shape == data.shape
+
+
+def test_truncated_svd_validation(data, mesh8):
+    with pytest.raises(ValueError, match="n_components"):
+        TruncatedSVD(n_components=12).fit(data)  # == n_features
+    with pytest.raises(ValueError, match="algorithm"):
+        TruncatedSVD(n_components=2, algorithm="bogus").fit(data)
+
+
+def test_pca_uneven_rows(mesh8, rng):
+    """n not divisible by the mesh: padding must not perturb the spectrum."""
+    X = rng.randn(1003, 9).astype(np.float32)
+    pca = PCA(n_components=5, svd_solver="tsqr").fit(X)
+    sk = SKPCA(n_components=5, svd_solver="full").fit(X)
+    np.testing.assert_allclose(pca.singular_values_, sk.singular_values_,
+                               rtol=2e-3)
+
+
+def test_pca_wide_padded_noise_variance(mesh8, rng):
+    """Wide data (n_samples < n_features) on a padding mesh: the spurious
+    zero singular values from padded rows must not dilute noise_variance_
+    (and with it the whole PPCA get_covariance/score path)."""
+    X = rng.randn(10, 12).astype(np.float32)
+    pca = PCA(n_components=3, svd_solver="tsqr").fit(X)
+    sk = SKPCA(n_components=3, svd_solver="full").fit(X)
+    assert pca.noise_variance_ == pytest.approx(sk.noise_variance_, rel=1e-3)
+    np.testing.assert_allclose(pca.explained_variance_,
+                               sk.explained_variance_, rtol=1e-3)
+
+
+def test_truncated_svd_list_input(mesh8):
+    """Non-array inputs get clean validation errors, not AttributeError."""
+    X = [[1.0, 2.0, 3.0], [4.0, 5.0, 6.0], [7.0, 8.0, 10.0]]
+    t = TruncatedSVD(n_components=2).fit(X)
+    assert t.components_.shape == (2, 3)
+    with pytest.raises(ValueError):
+        TruncatedSVD(n_components=2).fit(np.arange(5.0))
