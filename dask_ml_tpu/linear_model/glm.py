@@ -157,8 +157,9 @@ class LogisticRegression(_GLM):
     def predict_proba(self, X):
         # 1-D probability of the positive class, like the reference
         # (glm.py:203-215 returns sigmoid(X·coef), not an (n, 2) matrix).
-        eta = self._decision_function(X)
-        return 1.0 / (1.0 + np.exp(-eta))
+        from scipy.special import expit
+
+        return expit(self._decision_function(X))
 
     def predict(self, X):
         mask = self.predict_proba(X) > 0.5

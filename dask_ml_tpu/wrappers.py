@@ -1,0 +1,252 @@
+"""Meta-estimators: ParallelPostFit (parallel inference) and Incremental
+(sequential blockwise partial_fit).
+
+The reference bridges sklearn estimators and dask collections
+(reference: wrappers.py:124-272 ``ParallelPostFit``, :275-395 ``Incremental``,
+_partial.py:104-182 the sequential chain builder). The TPU-native rebuild
+keeps the same two capabilities with a dual execution path:
+
+- **jax-native estimators** (anything from this package): predict/transform
+  already run as one SPMD program over the sharded input — the mesh *is* the
+  ``map_blocks`` — so the wrapper simply delegates. For incremental training,
+  :func:`incremental_scan` fuses the whole block chain into a single
+  ``lax.scan`` with a donated model-state carry: the reference's deliberately
+  serial task chain (its docstring: "without any parallelism",
+  _partial.py:222-224) becomes *faster serial* — one compiled program, zero
+  per-block host round-trips.
+- **foreign (sklearn-style) estimators**: host compute. ParallelPostFit
+  splits the input into row blocks and fans them over a thread pool (sklearn
+  kernels release the GIL; this is the moral equivalent of the reference's
+  threaded scheduler executing one task per block), concatenating results.
+  Incremental feeds blocks to ``partial_fit`` sequentially, exactly like the
+  reference's linear task chain.
+
+Both wrappers copy learned ``*_`` attributes onto themselves (reference:
+wrappers.py:144-146 via _utils.copy_learned_attributes) and compose with
+:class:`dask_ml_tpu.model_selection.GridSearchCV` through the standard
+``estimator__<param>`` nesting.
+"""
+
+from __future__ import annotations
+
+import logging
+from concurrent.futures import ThreadPoolExecutor
+from timeit import default_timer as tic
+
+import numpy as np
+import sklearn.base
+import sklearn.metrics
+from sklearn.base import BaseEstimator, MetaEstimatorMixin
+from sklearn.utils.validation import check_is_fitted
+
+from dask_ml_tpu.metrics.scorer import check_scoring, get_scorer
+from dask_ml_tpu.utils._utils import copy_learned_attributes
+
+logger = logging.getLogger(__name__)
+
+# Block size for host-side blockwise inference/training over foreign
+# estimators — the analogue of the reference's "chunks" which it inherits
+# from the input dask array (reference: utils.py:204-214 defaults to one
+# block per core, >= 100 rows).
+DEFAULT_BLOCK_SIZE = 100_000
+
+
+def _is_jax_native(estimator) -> bool:
+    """Heuristic for "this estimator already runs sharded on the mesh":
+    anything defined in this package stages its own inputs."""
+    mod = type(estimator).__module__ or ""
+    return mod.startswith("dask_ml_tpu")
+
+
+def _block_slices(n: int, block_size: int):
+    for start in range(0, n, block_size):
+        yield slice(start, min(start + block_size, n))
+
+
+class ParallelPostFit(BaseEstimator, MetaEstimatorMixin):
+    """Meta-estimator for parallel predict/transform after a plain fit
+    (reference: wrappers.py:52-272).
+
+    Parameters
+    ----------
+    estimator : Estimator
+        The underlying estimator fit on small(ish) data.
+    scoring : str or callable, optional
+        Scorer used by :meth:`score`; default = estimator's own ``score``.
+    block_size : int
+        Rows per block for host-side blockwise inference over foreign
+        estimators. jax-native estimators ignore it (the mesh shards
+        instead).
+    """
+
+    def __init__(self, estimator=None, scoring=None,
+                 block_size: int = DEFAULT_BLOCK_SIZE):
+        self.estimator = estimator
+        self.scoring = scoring
+        self.block_size = block_size
+
+    @property
+    def _postfit_estimator(self):
+        return self.estimator
+
+    def fit(self, X, y=None, **kwargs):
+        """Fit the underlying estimator as-is (reference: wrappers.py:124-146)."""
+        start = tic()
+        logger.info("Starting fit")
+        result = self.estimator.fit(X, y, **kwargs)
+        logger.info("Finished fit, %0.2f", tic() - start)
+        copy_learned_attributes(result, self)
+        return self
+
+    # -- blockwise dispatch ------------------------------------------------
+
+    def _check_method(self, method):
+        """AttributeError passthrough (reference: wrappers.py:260-272)."""
+        estimator = self._postfit_estimator
+        if not hasattr(estimator, method):
+            raise AttributeError(
+                f"The wrapped estimator '{estimator}' does not have a "
+                f"'{method}' method."
+            )
+        return getattr(estimator, method)
+
+    def _blockwise(self, fn, X):
+        """Apply ``fn`` over row blocks of ``X``.
+
+        jax-native estimators get the whole array (their internals shard it
+        over the mesh — one fused program beats any host-side blocking);
+        foreign estimators run one block per host thread and the results are
+        concatenated, the map_blocks analogue."""
+        if _is_jax_native(self._postfit_estimator):
+            return fn(X)
+        X = np.asarray(X)
+        n = X.shape[0]
+        if n <= self.block_size:
+            return fn(X)
+        slices = list(_block_slices(n, self.block_size))
+        with ThreadPoolExecutor(max_workers=min(8, len(slices))) as pool:
+            parts = list(pool.map(lambda s: fn(X[s]), slices))
+        return np.concatenate(parts, axis=0)
+
+    def predict(self, X):
+        return self._blockwise(self._check_method("predict"), X)
+
+    def predict_proba(self, X):
+        return self._blockwise(self._check_method("predict_proba"), X)
+
+    def predict_log_proba(self, X):
+        return self._blockwise(self._check_method("predict_log_proba"), X)
+
+    def transform(self, X):
+        return self._blockwise(self._check_method("transform"), X)
+
+    def score(self, X, y):
+        """Score via the configured scorer, else delegate
+        (reference: wrappers.py:175-201)."""
+        if self.scoring:
+            scorer = (get_scorer(self.scoring)
+                      if isinstance(self.scoring, str) else self.scoring)
+            return scorer(self, X, y)
+        return self._postfit_estimator.score(X, y)
+
+
+class Incremental(ParallelPostFit):
+    """Feed row blocks to a ``partial_fit`` estimator sequentially
+    (reference: wrappers.py:275-395; chain semantics _partial.py:167-182).
+
+    The fitted clone lives in ``estimator_``; learned attributes are copied
+    onto the wrapper. Inference inherits ParallelPostFit's parallel paths.
+    Use ``estimator__<param>`` naming inside grid searches
+    (reference: wrappers.py:345-351).
+    """
+
+    @property
+    def _postfit_estimator(self):
+        check_is_fitted(self, "estimator_")
+        return self.estimator_
+
+    def _fit_for_estimator(self, estimator, X, y, **fit_kwargs):
+        check_scoring(estimator, self.scoring)
+        X = np.asarray(X)
+        y = None if y is None else np.asarray(y)
+        n = X.shape[0]
+        start = tic()
+        for i, s in enumerate(_block_slices(n, self.block_size)):
+            yb = None if y is None else y[s]
+            estimator.partial_fit(X[s], yb, **fit_kwargs)
+            logger.debug("partial_fit block %d (%d rows)", i, X[s].shape[0])
+        logger.info("Finished incremental fit, %0.2f", tic() - start)
+        copy_learned_attributes(estimator, self)
+        self.estimator_ = estimator
+        return self
+
+    def fit(self, X, y=None, **fit_kwargs):
+        estimator = sklearn.base.clone(self.estimator)
+        return self._fit_for_estimator(estimator, X, y, **fit_kwargs)
+
+    def partial_fit(self, X, y=None, **fit_kwargs):
+        """Resume from ``estimator_`` if previously fit
+        (reference: wrappers.py:375-395)."""
+        estimator = getattr(self, "estimator_", None)
+        if estimator is None:
+            estimator = sklearn.base.clone(self.estimator)
+        return self._fit_for_estimator(estimator, X, y, **fit_kwargs)
+
+
+def fit(model, X, y=None, block_size: int = DEFAULT_BLOCK_SIZE, **kwargs):
+    """Functional sequential-chain fit — API parity with the reference's
+    ``_partial.fit`` (reference: _partial.py:110-182). Returns the fitted
+    model (the same object, mutated, as sklearn's partial_fit does)."""
+    if not hasattr(model, "partial_fit"):
+        raise TypeError(f"{model!r} does not implement partial_fit")
+    X = np.asarray(X)
+    y = None if y is None else np.asarray(y)
+    if X.ndim != 2:
+        raise ValueError("X must be 2-D")
+    for s in _block_slices(X.shape[0], block_size):
+        model.partial_fit(X[s], None if y is None else y[s], **kwargs)
+    return model
+
+
+def incremental_scan(step_fn, init_state, X, y=None, block_size: int = 1024):
+    """Fused incremental training for jax-native functional estimators.
+
+    ``step_fn(state, (x_block, y_block)) -> state`` is scanned over
+    fixed-size row blocks as ONE compiled XLA program with a donated carry —
+    the TPU-native upgrade of the reference's serial task chain
+    (_partial.py:167-177): same sequential semantics, no per-block host
+    round-trip, no model serialization between blocks.
+
+    Rows beyond the last full block are dropped (fixed shapes under jit);
+    callers control block_size to bound the remainder.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    X = jnp.asarray(X)
+    n_blocks = X.shape[0] // block_size
+    if n_blocks == 0:
+        raise ValueError(
+            f"block_size={block_size} exceeds n_samples={X.shape[0]}"
+        )
+    n_used = n_blocks * block_size
+    Xb = X[:n_used].reshape(n_blocks, block_size, *X.shape[1:])
+    if y is not None:
+        y = jnp.asarray(y)
+        # Preserve y's trailing dims: step_fn sees exactly the block shapes
+        # the caller's y implies ((block_size,) for 1-D, (block_size, k) for
+        # multi-output).
+        yb = y[:n_used].reshape(n_blocks, block_size, *y.shape[1:])
+    else:
+        yb = jnp.zeros((n_blocks, block_size), X.dtype)
+
+    @jax.jit
+    def run(state, Xb, yb):
+        def body(state, blk):
+            xs, ys = blk
+            return step_fn(state, (xs, ys)), None
+
+        state, _ = jax.lax.scan(body, state, (Xb, yb))
+        return state
+
+    return run(init_state, Xb, yb)
