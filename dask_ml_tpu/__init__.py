@@ -19,6 +19,8 @@ Public subpackages mirror the reference API surface
 - :mod:`dask_ml_tpu.metrics` — sharded metrics + pairwise kernels + scorers
 - :mod:`dask_ml_tpu.model_selection` — ShuffleSplit/KFold/train_test_split,
   GridSearchCV/RandomizedSearchCV with work-sharing
+- :mod:`dask_ml_tpu.preprocessing` — scalers/QuantileTransformer as sharded
+  reductions; Categorizer/Dummy/OrdinalEncoder/LabelEncoder
 - :mod:`dask_ml_tpu.wrappers` — ParallelPostFit / Incremental
   meta-estimators (+ ``incremental_scan`` fused partial_fit for jax cores)
 - :mod:`dask_ml_tpu.datasets` — sharded data generators
@@ -38,6 +40,7 @@ __all__ = [
     "linear_model",
     "metrics",
     "model_selection",
+    "preprocessing",
     "wrappers",
     "datasets",
     "parallel",

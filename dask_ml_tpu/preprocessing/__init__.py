@@ -1,0 +1,25 @@
+"""Preprocessing: scalers and transformers as sharded column reductions,
+plus pandas-tier categorical encoders
+(reference: preprocessing/data.py, preprocessing/label.py)."""
+
+from dask_ml_tpu.preprocessing.data import (  # noqa: F401
+    Categorizer,
+    DummyEncoder,
+    MinMaxScaler,
+    OrdinalEncoder,
+    QuantileTransformer,
+    RobustScaler,
+    StandardScaler,
+)
+from dask_ml_tpu.preprocessing.label import LabelEncoder  # noqa: F401
+
+__all__ = [
+    "StandardScaler",
+    "MinMaxScaler",
+    "RobustScaler",
+    "QuantileTransformer",
+    "Categorizer",
+    "DummyEncoder",
+    "OrdinalEncoder",
+    "LabelEncoder",
+]

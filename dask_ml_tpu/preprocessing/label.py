@@ -1,0 +1,36 @@
+"""LabelEncoder (reference: preprocessing/label.py:12-57).
+
+Label vocabularies are host metadata (they can be strings), so fit runs
+``np.unique`` on host; numeric transforms could ride the device via
+``jnp.searchsorted`` but per-element label lookups are never the bottleneck —
+keeping this host-side mirrors the reference's per-block
+``np.searchsorted`` tasks without the task overhead."""
+
+from __future__ import annotations
+
+import numpy as np
+import sklearn.preprocessing as sklabel
+from sklearn.utils.validation import check_is_fitted
+
+
+class LabelEncoder(sklabel.LabelEncoder):
+    __doc__ = sklabel.LabelEncoder.__doc__
+
+    def fit(self, y):
+        self.classes_ = np.unique(np.asarray(y))
+        return self
+
+    def fit_transform(self, y):
+        return self.fit(y).transform(y)
+
+    def transform(self, y):
+        check_is_fitted(self, "classes_")
+        y = np.asarray(y)
+        diff = np.setdiff1d(y, self.classes_)
+        if diff.size:
+            raise ValueError(f"y contains previously unseen labels: {diff}")
+        return np.searchsorted(self.classes_, y)
+
+    def inverse_transform(self, y):
+        check_is_fitted(self, "classes_")
+        return self.classes_[np.asarray(y)]

@@ -10,8 +10,8 @@ keeps the same two capabilities with a dual execution path:
   already run as one SPMD program over the sharded input — the mesh *is* the
   ``map_blocks`` — so the wrapper simply delegates. For incremental training,
   :func:`incremental_scan` fuses the whole block chain into a single
-  ``lax.scan`` with a donated model-state carry: the reference's deliberately
-  serial task chain (its docstring: "without any parallelism",
+  ``lax.scan`` (model-state carry updated in place by XLA): the reference's
+  deliberately serial task chain (its docstring: "without any parallelism",
   _partial.py:222-224) becomes *faster serial* — one compiled program, zero
   per-block host round-trips.
 - **foreign (sklearn-style) estimators**: host compute. ParallelPostFit
@@ -30,14 +30,18 @@ wrappers.py:144-146 via _utils.copy_learned_attributes) and compose with
 from __future__ import annotations
 
 import logging
+import weakref
 from concurrent.futures import ThreadPoolExecutor
 from timeit import default_timer as tic
 
+import jax
 import numpy as np
 import sklearn.base
 import sklearn.metrics
 from sklearn.base import BaseEstimator, MetaEstimatorMixin
 from sklearn.utils.validation import check_is_fitted
+
+import scipy.sparse as sp
 
 from dask_ml_tpu.metrics.scorer import check_scoring, get_scorer
 from dask_ml_tpu.utils._utils import copy_learned_attributes
@@ -61,6 +65,44 @@ def _is_jax_native(estimator) -> bool:
 def _block_slices(n: int, block_size: int):
     for start in range(0, n, block_size):
         yield slice(start, min(start + block_size, n))
+
+
+def _as_rowsliceable(X):
+    """Row-sliceable view of X without densifying sparse matrices."""
+    if sp.issparse(X):
+        return X.tocsr()
+    return np.asarray(X)
+
+
+def _concat_rows(parts):
+    if parts and sp.issparse(parts[0]):
+        return sp.vstack(parts)
+    return np.concatenate(parts, axis=0)
+
+
+# Fit kwargs that are always per-row (sliced per block) vs. always metadata
+# (never sliced, even if their length happens to equal n).
+_ROW_ALIGNED_KWARGS = {"sample_weight"}
+_NEVER_SLICED_KWARGS = {"classes"}
+
+
+def _slice_kwargs(kwargs, s, n):
+    """Slice per-row fit kwargs to match a block.
+
+    ``sample_weight`` is sliced in any sequence form (sklearn accepts lists);
+    ``classes`` is never sliced; other kwargs are sliced only when they are
+    row-aligned ndarrays (length n)."""
+    out = {}
+    for k, v in kwargs.items():
+        if k in _NEVER_SLICED_KWARGS:
+            out[k] = v
+        elif k in _ROW_ALIGNED_KWARGS and v is not None:
+            out[k] = np.asarray(v)[s]
+        elif isinstance(v, np.ndarray) and v.ndim >= 1 and len(v) == n:
+            out[k] = v[s]
+        else:
+            out[k] = v
+    return out
 
 
 class ParallelPostFit(BaseEstimator, MetaEstimatorMixin):
@@ -119,14 +161,14 @@ class ParallelPostFit(BaseEstimator, MetaEstimatorMixin):
         concatenated, the map_blocks analogue."""
         if _is_jax_native(self._postfit_estimator):
             return fn(X)
-        X = np.asarray(X)
+        X = _as_rowsliceable(X)
         n = X.shape[0]
         if n <= self.block_size:
             return fn(X)
         slices = list(_block_slices(n, self.block_size))
         with ThreadPoolExecutor(max_workers=min(8, len(slices))) as pool:
             parts = list(pool.map(lambda s: fn(X[s]), slices))
-        return np.concatenate(parts, axis=0)
+        return _concat_rows(parts)
 
     def predict(self, X):
         return self._blockwise(self._check_method("predict"), X)
@@ -144,9 +186,8 @@ class ParallelPostFit(BaseEstimator, MetaEstimatorMixin):
         """Score via the configured scorer, else delegate
         (reference: wrappers.py:175-201)."""
         if self.scoring:
-            scorer = (get_scorer(self.scoring)
-                      if isinstance(self.scoring, str) else self.scoring)
-            return scorer(self, X, y)
+            # get_scorer passes callables through and validates names.
+            return get_scorer(self.scoring)(self, X, y)
         return self._postfit_estimator.score(X, y)
 
 
@@ -167,13 +208,13 @@ class Incremental(ParallelPostFit):
 
     def _fit_for_estimator(self, estimator, X, y, **fit_kwargs):
         check_scoring(estimator, self.scoring)
-        X = np.asarray(X)
+        X = _as_rowsliceable(X)
         y = None if y is None else np.asarray(y)
         n = X.shape[0]
         start = tic()
         for i, s in enumerate(_block_slices(n, self.block_size)):
             yb = None if y is None else y[s]
-            estimator.partial_fit(X[s], yb, **fit_kwargs)
+            estimator.partial_fit(X[s], yb, **_slice_kwargs(fit_kwargs, s, n))
             logger.debug("partial_fit block %d (%d rows)", i, X[s].shape[0])
         logger.info("Finished incremental fit, %0.2f", tic() - start)
         copy_learned_attributes(estimator, self)
@@ -199,12 +240,14 @@ def fit(model, X, y=None, block_size: int = DEFAULT_BLOCK_SIZE, **kwargs):
     model (the same object, mutated, as sklearn's partial_fit does)."""
     if not hasattr(model, "partial_fit"):
         raise TypeError(f"{model!r} does not implement partial_fit")
-    X = np.asarray(X)
+    X = _as_rowsliceable(X)
     y = None if y is None else np.asarray(y)
     if X.ndim != 2:
         raise ValueError("X must be 2-D")
-    for s in _block_slices(X.shape[0], block_size):
-        model.partial_fit(X[s], None if y is None else y[s], **kwargs)
+    n = X.shape[0]
+    for s in _block_slices(n, block_size):
+        model.partial_fit(X[s], None if y is None else y[s],
+                          **_slice_kwargs(kwargs, s, n))
     return model
 
 
@@ -212,15 +255,14 @@ def incremental_scan(step_fn, init_state, X, y=None, block_size: int = 1024):
     """Fused incremental training for jax-native functional estimators.
 
     ``step_fn(state, (x_block, y_block)) -> state`` is scanned over
-    fixed-size row blocks as ONE compiled XLA program with a donated carry —
-    the TPU-native upgrade of the reference's serial task chain
-    (_partial.py:167-177): same sequential semantics, no per-block host
-    round-trip, no model serialization between blocks.
+    fixed-size row blocks as ONE compiled XLA program (the carry is updated
+    in place on device by XLA) — the TPU-native upgrade of the reference's
+    serial task chain (_partial.py:167-177): same sequential semantics, no
+    per-block host round-trip, no model serialization between blocks.
 
     Rows beyond the last full block are dropped (fixed shapes under jit);
     callers control block_size to bound the remainder.
     """
-    import jax
     import jax.numpy as jnp
 
     X = jnp.asarray(X)
@@ -240,13 +282,32 @@ def incremental_scan(step_fn, init_state, X, y=None, block_size: int = 1024):
     else:
         yb = jnp.zeros((n_blocks, block_size), X.dtype)
 
+    return _get_scan_run(step_fn)(init_state, Xb, yb)
+
+
+# Compiled-scan cache keyed weakly on step_fn: repeated epochs/candidates
+# with a stable step function reuse one compiled program, while throwaway
+# closures don't pin their captures (and compiled executables) forever the
+# way a static-arg jit cache would.
+_scan_cache = weakref.WeakKeyDictionary()
+
+
+def _get_scan_run(step_fn):
+    try:
+        return _scan_cache[step_fn]
+    except (KeyError, TypeError):
+        pass
+
     @jax.jit
     def run(state, Xb, yb):
         def body(state, blk):
-            xs, ys = blk
-            return step_fn(state, (xs, ys)), None
+            return step_fn(state, blk), None
 
         state, _ = jax.lax.scan(body, state, (Xb, yb))
         return state
 
-    return run(init_state, Xb, yb)
+    try:
+        _scan_cache[step_fn] = run
+    except TypeError:  # unweakrefable callables just skip the cache
+        pass
+    return run

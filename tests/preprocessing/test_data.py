@@ -1,0 +1,212 @@
+"""Differential tests for preprocessing vs scikit-learn
+(strategy of reference: tests/preprocessing/test_data.py:49-57 — fit ours and
+sklearn's on the same data, compare learned attrs and transforms)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+import sklearn.preprocessing as skdata
+
+from dask_ml_tpu.preprocessing import (
+    Categorizer,
+    DummyEncoder,
+    MinMaxScaler,
+    OrdinalEncoder,
+    QuantileTransformer,
+    RobustScaler,
+    StandardScaler,
+)
+
+
+@pytest.fixture
+def X(rng):
+    out = rng.uniform(0, 10, size=(203, 5)).astype(np.float32)
+    out[:, 2] = 3.5  # constant column exercises handle_zeros_in_scale
+    return out
+
+
+def test_standard_scaler(X, any_mesh):
+    a = StandardScaler().fit(X)
+    b = skdata.StandardScaler().fit(X)
+    np.testing.assert_allclose(a.mean_, b.mean_, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(a.var_, b.var_, rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(a.scale_, b.scale_, rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(a.transform(X), b.transform(X), atol=1e-3)
+    np.testing.assert_allclose(a.inverse_transform(a.transform(X)), X,
+                               atol=1e-3)
+    assert a.n_samples_seen_ == 203
+
+
+def test_standard_scaler_flags(X, mesh8):
+    a = StandardScaler(with_mean=False).fit(X)
+    b = skdata.StandardScaler(with_mean=False).fit(X)
+    np.testing.assert_allclose(a.transform(X), b.transform(X), atol=1e-3)
+    a = StandardScaler(with_std=False).fit(X)
+    b = skdata.StandardScaler(with_std=False).fit(X)
+    np.testing.assert_allclose(a.transform(X), b.transform(X), atol=1e-3)
+    with pytest.raises(NotImplementedError):
+        StandardScaler().partial_fit(X)
+
+
+def test_min_max_scaler(X, any_mesh):
+    a = MinMaxScaler().fit(X)
+    b = skdata.MinMaxScaler().fit(X)
+    for attr in ["data_min_", "data_max_", "data_range_", "scale_", "min_"]:
+        np.testing.assert_allclose(getattr(a, attr), getattr(b, attr),
+                                   rtol=1e-5, atol=1e-6, err_msg=attr)
+    np.testing.assert_allclose(a.transform(X), b.transform(X), atol=1e-5)
+    np.testing.assert_allclose(a.inverse_transform(a.transform(X)), X,
+                               atol=1e-4)
+
+
+def test_min_max_scaler_feature_range(X, mesh8):
+    a = MinMaxScaler(feature_range=(-1, 1)).fit(X)
+    b = skdata.MinMaxScaler(feature_range=(-1, 1)).fit(X)
+    np.testing.assert_allclose(a.transform(X), b.transform(X), atol=1e-5)
+    with pytest.raises(ValueError, match="feature range"):
+        MinMaxScaler(feature_range=(1, 1)).fit(X)
+
+
+def test_robust_scaler(X, any_mesh):
+    a = RobustScaler().fit(X)
+    b = skdata.RobustScaler().fit(X)
+    np.testing.assert_allclose(a.center_, b.center_, atol=1e-3)
+    np.testing.assert_allclose(a.scale_, b.scale_, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(a.transform(X), b.transform(X), atol=2e-3)
+    np.testing.assert_allclose(a.inverse_transform(a.transform(X)), X,
+                               atol=1e-3)
+
+
+def test_robust_scaler_options(X, mesh8):
+    a = RobustScaler(quantile_range=(10, 90)).fit(X)
+    b = skdata.RobustScaler(quantile_range=(10, 90)).fit(X)
+    np.testing.assert_allclose(a.scale_, b.scale_, rtol=2e-3, atol=1e-4)
+    with pytest.raises(ValueError, match="quantile"):
+        RobustScaler(quantile_range=(90, 10)).fit(X)
+
+
+@pytest.mark.parametrize("output_distribution", ["uniform", "normal"])
+def test_quantile_transformer(X, output_distribution, mesh8):
+    a = QuantileTransformer(n_quantiles=100,
+                            output_distribution=output_distribution).fit(X)
+    b = skdata.QuantileTransformer(
+        n_quantiles=100, output_distribution=output_distribution,
+        subsample=500_000).fit(X)
+    np.testing.assert_allclose(a.quantiles_, b.quantiles_, atol=0.3)
+    # Transforms agree within the coarseness of 100 quantiles on 203 rows.
+    np.testing.assert_allclose(a.transform(X), b.transform(X), atol=0.05)
+    # Round trip
+    np.testing.assert_allclose(a.inverse_transform(a.transform(X)), X,
+                               atol=0.2)
+
+
+def test_quantile_transformer_validation(X, mesh8):
+    with pytest.raises(ValueError, match="output_distribution"):
+        QuantileTransformer(output_distribution="bogus").fit(X)
+    qt = QuantileTransformer(n_quantiles=10_000).fit(X)
+    assert qt.n_quantiles_ == 203  # clipped to n_samples, like sklearn
+
+
+@pytest.fixture
+def df():
+    return pd.DataFrame({
+        "A": [1, 2, 3, 4],
+        "B": ["a", "a", "b", "c"],
+        "C": pd.Categorical(["x", "y", "x", "x"]),
+    })
+
+
+def test_categorizer(df):
+    ce = Categorizer()
+    out = ce.fit_transform(df)
+    assert out["B"].dtype == "category"
+    assert out["C"].dtype == "category"
+    assert out["A"].dtype == np.int64
+    assert set(ce.categories_) == {"B", "C"}
+    assert list(ce.columns_) == ["B", "C"]
+    # custom dtype pass-through (reference doctest, data.py:304-309)
+    ce2 = Categorizer(categories={"B": CategoricalDtypeB()})
+    out2 = ce2.fit_transform(df)
+    assert list(out2["B"].cat.categories) == ["a", "b", "c", "d"]
+    with pytest.raises(TypeError):
+        Categorizer().fit(np.zeros((3, 2)))
+
+
+def CategoricalDtypeB():
+    return pd.CategoricalDtype(["a", "b", "c", "d"])
+
+
+def test_dummy_encoder(df):
+    cat = Categorizer().fit_transform(df)
+    enc = DummyEncoder()
+    out = enc.fit_transform(cat)
+    assert "B_a" in out.columns and "C_y" in out.columns
+    assert list(enc.columns_) == ["A", "B", "C"]
+    # inverse round-trips
+    back = enc.inverse_transform(out)
+    pd.testing.assert_frame_equal(back, cat)
+    # numpy input to inverse
+    back2 = enc.inverse_transform(np.asarray(out))
+    assert list(back2.columns) == ["A", "B", "C"]
+    with pytest.raises(ValueError, match="do not match"):
+        enc.transform(cat[["B", "A", "C"]])
+
+
+def test_dummy_encoder_drop_first(df):
+    cat = Categorizer().fit_transform(df)
+    enc = DummyEncoder(drop_first=True)
+    out = enc.fit_transform(cat)
+    assert "B_a" not in out.columns
+    back = enc.inverse_transform(out)
+    pd.testing.assert_frame_equal(back, cat)
+
+
+def test_ordinal_encoder(df):
+    cat = Categorizer().fit_transform(df)
+    enc = OrdinalEncoder()
+    out = enc.fit_transform(cat)
+    assert out["B"].tolist() == [0, 0, 1, 2]
+    assert out["C"].tolist() == [0, 1, 0, 0]
+    assert out["A"].tolist() == [1, 2, 3, 4]
+    back = enc.inverse_transform(out)
+    pd.testing.assert_frame_equal(back, cat)
+    back2 = enc.inverse_transform(np.asarray(out))
+    assert back2["B"].tolist() == ["a", "a", "b", "c"]
+
+
+def test_unfitted_transform_raises(X):
+    from sklearn.exceptions import NotFittedError
+
+    for est in [StandardScaler(), MinMaxScaler(), RobustScaler(),
+                QuantileTransformer()]:
+        with pytest.raises(NotFittedError):
+            est.transform(X)
+
+
+def test_standard_scaler_none_attrs(X, mesh8):
+    s = StandardScaler(with_std=False).fit(X)
+    assert s.scale_ is None and s.var_ is None and s.mean_ is not None
+    s = StandardScaler(with_mean=False).fit(X)
+    assert s.mean_ is None
+
+
+def test_quantile_transformer_bad_n_quantiles(X):
+    with pytest.raises(ValueError, match="n_quantiles"):
+        QuantileTransformer(n_quantiles=0).fit(X)
+
+
+def test_dummy_encoder_column_subset(df):
+    """columns= restricts encoding; inverse stays aligned."""
+    cat = Categorizer().fit_transform(df)
+    enc = DummyEncoder(columns=["B"])
+    out = enc.fit_transform(cat)
+    assert "B_a" in out.columns and "C" in out.columns  # C untouched
+    back = enc.inverse_transform(out)
+    pd.testing.assert_frame_equal(back, cat)
+
+
+def test_encoders_array_input_type_error(df):
+    cat = Categorizer().fit_transform(df)
+    for enc in [DummyEncoder().fit(cat), OrdinalEncoder().fit(cat)]:
+        with pytest.raises(TypeError, match="Unexpected type"):
+            enc.transform(np.asarray(cat))

@@ -1,0 +1,29 @@
+"""LabelEncoder tests (reference: tests/preprocessing/test_label.py)."""
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu.preprocessing import LabelEncoder
+
+
+def test_label_encoder_numeric():
+    y = np.array([2, 1, 3, 1, 3])
+    le = LabelEncoder().fit(y)
+    np.testing.assert_array_equal(le.classes_, [1, 2, 3])
+    np.testing.assert_array_equal(le.transform(y), [1, 0, 2, 0, 2])
+    np.testing.assert_array_equal(le.inverse_transform([1, 0, 2, 0, 2]), y)
+
+
+def test_label_encoder_strings():
+    y = ["b", "a", "c", "a"]
+    le = LabelEncoder()
+    out = le.fit_transform(y)
+    np.testing.assert_array_equal(le.classes_, ["a", "b", "c"])
+    np.testing.assert_array_equal(out, [1, 0, 2, 0])
+    np.testing.assert_array_equal(le.inverse_transform(out), y)
+
+
+def test_label_encoder_unseen_raises():
+    le = LabelEncoder().fit([1, 2, 3])
+    with pytest.raises(ValueError, match="unseen"):
+        le.transform([4])

@@ -107,9 +107,11 @@ def test_incremental_partial_fit_resumes(Xy):
 
 
 def test_incremental_postfit_requires_fit(Xy):
+    from sklearn.exceptions import NotFittedError
+
     X, _ = Xy
     inc = Incremental(SGDClassifier())
-    with pytest.raises(Exception):
+    with pytest.raises(NotFittedError):
         inc.predict(X)
 
 
@@ -194,3 +196,38 @@ def test_fit_does_not_mutate_input_estimator(Xy):
     inc = Incremental(base, block_size=100)
     inc.fit(X, y, classes=[0, 1])
     assert not hasattr(base, "coef_")
+
+
+def test_incremental_sample_weight_sliced(Xy):
+    """Per-row fit kwargs (sample_weight) are sliced per block; list-valued
+    metadata (classes) never is."""
+    X, y = Xy
+    w = np.ones(len(y), dtype=np.float64)
+    inc = Incremental(SGDClassifier(random_state=0, tol=1e-3), block_size=100)
+    inc.fit(X, y, classes=[0, 1], sample_weight=w)
+    manual = SGDClassifier(random_state=0, tol=1e-3)
+    for i in range(0, 500, 100):
+        manual.partial_fit(X[i:i + 100], y[i:i + 100], classes=[0, 1],
+                           sample_weight=w[i:i + 100])
+    np.testing.assert_allclose(inc.coef_, manual.coef_)
+
+
+def test_parallel_post_fit_sparse_blocks(Xy):
+    """Sparse inputs survive the blocked path without densification."""
+    import scipy.sparse as sp
+
+    X, y = Xy
+    Xs = sp.csr_matrix(X)
+    clf = ParallelPostFit(estimator=SKLogistic(), block_size=64).fit(Xs, y)
+    base = SKLogistic().fit(Xs, y)
+    np.testing.assert_array_equal(clf.predict(Xs), base.predict(Xs))
+
+
+def test_slice_kwargs_list_weight_and_ndarray_classes(Xy):
+    """sample_weight works as a list; ndarray classes are never sliced."""
+    X, y = Xy
+    w = [1.0] * len(y)
+    m = wrappers.fit(SGDClassifier(random_state=0, tol=1e-3), X, y,
+                     block_size=100, classes=np.array([0, 1]),
+                     sample_weight=w)
+    assert hasattr(m, "coef_")
