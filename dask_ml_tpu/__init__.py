@@ -11,7 +11,9 @@ pipeline-prefix memoization.
 Public subpackages mirror the reference API surface
 (reference: docs/source/modules/api.rst):
 
-- :mod:`dask_ml_tpu.cluster` — KMeans (k-means|| init)
+- :mod:`dask_ml_tpu.cluster` — KMeans (k-means|| init), Nyström
+  SpectralClustering
+- :mod:`dask_ml_tpu.naive_bayes` — GaussianNB (one-pass per-class moments)
 - :mod:`dask_ml_tpu.decomposition` — PCA / TruncatedSVD over native
   distributed tsqr + randomized SVD
 - :mod:`dask_ml_tpu.linear_model` — GLMs (Logistic/Linear/Poisson) over the
@@ -40,6 +42,7 @@ __all__ = [
     "linear_model",
     "metrics",
     "model_selection",
+    "naive_bayes",
     "preprocessing",
     "wrappers",
     "datasets",

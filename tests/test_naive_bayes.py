@@ -1,0 +1,94 @@
+"""GaussianNB differential tests vs sklearn
+(reference: tests/test_naive_bayes.py compares against sklearn on blobs)."""
+
+import numpy as np
+import pytest
+from sklearn.naive_bayes import GaussianNB as SKGaussianNB
+
+from dask_ml_tpu.naive_bayes import GaussianNB
+
+
+@pytest.fixture
+def Xy(rng):
+    from sklearn.datasets import make_blobs
+
+    X, y = make_blobs(n_samples=300, n_features=5, centers=3, random_state=0)
+    return X.astype(np.float32), y
+
+
+def test_matches_sklearn(Xy, any_mesh):
+    X, y = Xy
+    a = GaussianNB().fit(X, y)
+    b = SKGaussianNB().fit(X, y)
+    np.testing.assert_array_equal(a.classes_, b.classes_)
+    np.testing.assert_allclose(a.theta_, b.theta_, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(a.var_, b.var_, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(a.class_prior_, b.class_prior_, rtol=1e-6)
+    np.testing.assert_allclose(a.class_count_, b.class_count_)
+    np.testing.assert_array_equal(a.predict(X), b.predict(X))
+    np.testing.assert_allclose(a.predict_proba(X), b.predict_proba(X),
+                               atol=1e-3)
+    np.testing.assert_allclose(
+        a.predict_log_proba(X), b.predict_log_proba(X), atol=2e-2)
+    assert a.score(X, y) == pytest.approx(b.score(X, y))
+
+
+def test_sigma_alias(Xy, mesh8):
+    """The reference exposes the variances as ``sigma_``
+    (naive_bayes.py:30); keep that alias alongside sklearn's ``var_``."""
+    X, y = Xy
+    nb = GaussianNB().fit(X, y)
+    np.testing.assert_array_equal(nb.sigma_, nb.var_)
+
+
+def test_priors_and_classes_params(Xy, mesh8):
+    X, y = Xy
+    priors = np.array([0.5, 0.25, 0.25])
+    a = GaussianNB(priors=priors).fit(X, y)
+    b = SKGaussianNB(priors=priors).fit(X, y)
+    np.testing.assert_array_equal(a.predict(X), b.predict(X))
+    nb = GaussianNB(classes=[0, 1, 2]).fit(X, y)
+    np.testing.assert_array_equal(nb.classes_, [0, 1, 2])
+    with pytest.raises(ValueError, match="priors"):
+        GaussianNB(priors=np.array([0.5, 0.5])).fit(X, y)
+    with pytest.raises(ValueError, match="labels"):
+        GaussianNB(classes=[0, 1]).fit(X, y)
+
+
+def test_sample_weight(Xy, mesh8):
+    X, y = Xy
+    w = np.random.RandomState(0).uniform(0.5, 2.0, len(y))
+    a = GaussianNB().fit(X, y, sample_weight=w)
+    b = SKGaussianNB().fit(X, y, sample_weight=w)
+    np.testing.assert_allclose(a.theta_, b.theta_, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(a.var_, b.var_, rtol=1e-3, atol=1e-4)
+
+
+def test_constant_feature(mesh8, rng):
+    """var_smoothing keeps constant features finite."""
+    X = rng.randn(100, 3).astype(np.float32)
+    X[:, 1] = 7.0
+    y = (X[:, 0] > 0).astype(int)
+    nb = GaussianNB().fit(X, y)
+    assert np.isfinite(nb._jll(X)).all()
+
+
+def test_perfectly_separable_epsilon(mesh8, rng):
+    """Per-class-constant features: epsilon_ must come from the pooled
+    variance so the JLL stays finite (sklearn semantics)."""
+    X = rng.randn(120, 2).astype(np.float32)
+    y = np.repeat([0, 1], 60)
+    X[:, 1] = y  # constant within each class, varies across classes
+    a = GaussianNB().fit(X, y)
+    b = SKGaussianNB().fit(X, y)
+    assert a.epsilon_ > 0
+    assert np.isfinite(a._jll(X)).all()
+    np.testing.assert_array_equal(a.predict(X), b.predict(X))
+
+
+def test_unsorted_classes_param(Xy, mesh8):
+    X, y = Xy
+    nb = GaussianNB(classes=[2, 0, 1]).fit(X, y)
+    np.testing.assert_array_equal(nb.classes_, [2, 0, 1])
+    sk = SKGaussianNB().fit(X, y)
+    np.testing.assert_array_equal(nb.predict(X), sk.predict(X))

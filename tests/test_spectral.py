@@ -1,0 +1,108 @@
+"""SpectralClustering tests (reference: tests/test_spectral_clustering.py —
+the reference's quality oracle is standardized easy blobs where every true
+group must land in exactly one predicted cluster; circles are NOT in the
+reference suite, and the Nyström + approximate-degree normalization it
+implements does not separate them even in exact-NumPy form)."""
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_blobs
+from sklearn.metrics import adjusted_rand_score
+
+from dask_ml_tpu.cluster import SpectralClustering
+
+
+@pytest.fixture
+def blobs(rng):
+    X, y = make_blobs(n_samples=500, n_features=4, centers=3,
+                      cluster_std=0.5, random_state=0)
+    X = (X - X.mean(0)) / X.std(0)
+    return X.astype(np.float32), y
+
+
+def test_blobs_grouping(blobs, any_mesh):
+    """Each true blob maps to a single predicted label
+    (reference: tests/test_spectral_clustering.py:81-93)."""
+    X, y = blobs
+    sc = SpectralClustering(n_clusters=3, n_components=50, gamma=None,
+                            random_state=0)
+    labels = sc.fit_predict(X)
+    assert labels.shape == (500,)
+    for i in range(3):
+        assert len(set(labels[y == i])) == 1
+    assert adjusted_rand_score(y, labels) == 1.0
+    assert sc.eigenvalues_.shape == (3,)
+    assert hasattr(sc.assign_labels_, "cluster_centers_")
+
+
+def test_sklearn_kmeans_assign(blobs, mesh8):
+    import sklearn.cluster
+
+    X, y = blobs
+    sc = SpectralClustering(n_clusters=3, n_components=50, gamma=None,
+                            random_state=0, assign_labels="sklearn-kmeans")
+    sc.fit(X)
+    assert isinstance(sc.assign_labels_, sklearn.cluster.KMeans)
+    assert adjusted_rand_score(y, sc.labels_) == 1.0
+
+
+def test_estimator_assign_labels(blobs, mesh8):
+    from dask_ml_tpu.cluster import KMeans
+
+    X, y = blobs
+    km = KMeans(n_clusters=3, random_state=1)
+    sc = SpectralClustering(n_clusters=3, n_components=40, gamma=None,
+                            random_state=0, assign_labels=km)
+    sc.fit(X)
+    assert sc.assign_labels_ is km
+
+
+def test_validation(blobs, mesh8):
+    X, _ = blobs
+    with pytest.raises(ValueError, match="n_components"):
+        SpectralClustering(n_components=500).fit(X)
+    with pytest.raises(ValueError, match="affinity"):
+        SpectralClustering(n_components=50, affinity="bogus").fit(X)
+    with pytest.raises(ValueError, match="assign_labels"):
+        SpectralClustering(n_components=50, assign_labels="bogus").fit(X)
+    with pytest.raises(TypeError, match="assign_labels"):
+        SpectralClustering(n_components=50, assign_labels=42).fit(X)
+
+
+def test_callable_affinity(blobs, mesh8):
+    from dask_ml_tpu.ops.pairwise import rbf_kernel
+
+    X, y = blobs
+    # Callables receive the merged gamma/degree/coef0 params (reference
+    # behavior), so accept and ignore the extras.
+    sc = SpectralClustering(
+        n_clusters=3, n_components=50, random_state=0,
+        affinity=lambda a, b, **kw: rbf_kernel(a, b, gamma=0.25))
+    sc.fit(X)
+    assert adjusted_rand_score(y, sc.labels_) == 1.0
+
+
+def test_kmeans_params_passthrough(blobs, mesh8):
+    X, _ = blobs
+    sc = SpectralClustering(n_clusters=3, n_components=40, gamma=None,
+                            random_state=0,
+                            kmeans_params={"max_iter": 5})
+    sc.fit(X)
+    assert sc.assign_labels_.max_iter == 5
+
+
+def test_callable_affinity_gets_merged_params(blobs, mesh8):
+    """gamma/degree/coef0 reach callable affinities too
+    (reference: spectral.py:307-308)."""
+    X, y = blobs
+    seen = {}
+
+    def affinity(a, b, gamma=None, degree=None, coef0=None):
+        from dask_ml_tpu.ops.pairwise import rbf_kernel
+
+        seen["gamma"] = gamma
+        return rbf_kernel(a, b, gamma=gamma)
+
+    SpectralClustering(n_clusters=3, n_components=40, gamma=0.25,
+                       random_state=0, affinity=affinity).fit(X)
+    assert seen["gamma"] == 0.25
