@@ -110,8 +110,9 @@ class KMeans(TransformerMixin, BaseEstimator):
         logger.info("init (%s) finished in %.2fs", self.init, t_init - t0)
 
         tol = core.scaled_tolerance(data.X, data.weights, self.tol)
-        centers, _, n_iter, _ = core.lloyd_loop(
-            data.X, data.weights, centers, tol, self.max_iter
+        centers, _, n_iter, _ = core.lloyd_loop_fused(
+            data.X, data.weights, centers, tol,
+            mesh=data.mesh, max_iter=self.max_iter,
         )
         # Recompute cost against the *final* centers so inertia_ is consistent
         # with cluster_centers_/labels_ and score(X) — the reference likewise

@@ -99,6 +99,115 @@ def lloyd_loop(X, w, centers, tol, max_iter: int):
     return jax.lax.while_loop(cond, body, init)
 
 
+def _largest_divisor_leq(n: int, target: int) -> int:
+    for c in range(min(target, n), 0, -1):
+        if n % c == 0:
+            return c
+    return 1
+
+
+@partial(jax.jit, static_argnames=("mesh", "max_iter", "block"))
+def lloyd_loop_fused(X, w, centers0, tol, *, mesh, max_iter: int,
+                     block: int = 32768):
+    """Bandwidth-optimal Lloyd: X is read ONCE per iteration.
+
+    The plain :func:`lloyd_step` reads X twice (distance matmul, then the
+    one-hot M-step matmul) and materializes an (n, k) one-hot array in HBM.
+    Here each shard scans its rows in VMEM-sized blocks and, per block,
+    computes distances, argmin, and the (k, d)/(k,) partial sums while the
+    block is still resident — the fused assign+accumulate pass the survey
+    calls for (SURVEY §2.10; the reference's Cython kernel _k_means.pyx:29-78
+    is the per-block sum, but dask still pays two passes + a graph barrier
+    per iteration). Works in bf16 inputs with f32 accumulation
+    (``preferred_element_type``): distances, sums, counts and inertia all
+    accumulate in f32 regardless of X's dtype.
+
+    Cross-shard reduction is one psum of (k·d + k + 1) floats per iteration;
+    the convergence check stays on device, so the entire optimization remains
+    a single XLA program.
+
+    Only the ``-2·x·c + ‖c‖²`` part of the distance enters the argmin (the
+    ‖x‖² term is constant per row); inertia adds the ‖x‖² term back.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from dask_ml_tpu.parallel.mesh import DATA_AXIS
+
+    n_shards = mesh.shape[DATA_AXIS]
+    n_loc = X.shape[0] // n_shards
+    k, d = centers0.shape
+    blk = _largest_divisor_leq(n_loc, block)
+    n_blocks = n_loc // blk
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(), P()),
+        out_specs=(P(), P(), P(), P()),
+    )
+    def run(X_loc, w_loc, c0, tol_):
+        Xb = X_loc.reshape(n_blocks, blk, d)
+        wb = w_loc.reshape(n_blocks, blk)
+
+        def one_iter(centers):
+            c = centers.astype(X_loc.dtype)
+            c2 = jnp.sum(centers * centers, axis=1)  # (k,) f32
+
+            def body(carry, inp):
+                sums, counts, inertia = carry
+                xb, wv = inp
+                prod = jax.lax.dot(
+                    xb, c.T, preferred_element_type=jnp.float32)  # (blk, k)
+                scores = c2[None, :] - 2.0 * prod
+                labels = jnp.argmin(scores, axis=1)
+                onehot = jax.nn.one_hot(labels, k, dtype=jnp.float32)
+                onehot = onehot * wv[:, None]
+                sums = sums + jax.lax.dot(
+                    onehot.T, xb.astype(jnp.float32),
+                    preferred_element_type=jnp.float32)
+                counts = counts + onehot.sum(axis=0)
+                x2 = jnp.sum(
+                    xb.astype(jnp.float32) ** 2, axis=1)
+                mind = jnp.maximum(jnp.min(scores, axis=1) + x2, 0.0)
+                inertia = inertia + jnp.sum(mind * wv)
+                return (sums, counts, inertia), None
+
+            # Accumulators are per-shard partial sums: mark varying so the
+            # scan carry types line up under shard_map's vma checks.
+            init = jax.lax.pcast(
+                (jnp.zeros((k, d), jnp.float32),
+                 jnp.zeros((k,), jnp.float32),
+                 jnp.asarray(0.0, jnp.float32)),
+                (DATA_AXIS,), to="varying")
+            (sums, counts, inertia), _ = jax.lax.scan(body, init, (Xb, wb))
+            sums = jax.lax.psum(sums, DATA_AXIS)
+            counts = jax.lax.psum(counts, DATA_AXIS)
+            inertia = jax.lax.psum(inertia, DATA_AXIS)
+            safe = jnp.where(counts > 0, counts, 1.0)
+            new_centers = jnp.where(
+                counts[:, None] > 0, sums / safe[:, None], centers)
+            shift = jnp.sum((new_centers - centers) ** 2)
+            return new_centers, inertia, shift
+
+        def cond(state):
+            _, _, it, shift = state
+            return jnp.logical_and(it < max_iter, shift >= tol_)
+
+        def body(state):
+            centers, _, it, _ = state
+            new_centers, inertia, shift = one_iter(centers)
+            return new_centers, inertia, it + 1, shift
+
+        init = (c0.astype(jnp.float32),
+                jnp.asarray(jnp.inf, jnp.float32),
+                jnp.asarray(0, jnp.int32),
+                jnp.asarray(jnp.inf, jnp.float32))
+        return jax.lax.while_loop(cond, body, init)
+
+    return run(X, w, centers0.astype(jnp.float32),
+               jnp.asarray(tol, jnp.float32))
+
+
 @jax.jit
 def compute_inertia(X, w, centers):
     """Weighted cost of assigning X to ``centers``
@@ -203,13 +312,14 @@ def init_scalable(
         n_rounds = int(min(max(max_iter, 1), n_rounds))
     logger.info("k-means|| init: phi=%.4g, %d rounds", phi, n_rounds)
 
-    # Fixed-size candidate buffer → one compilation for every round.
+    # Fixed-size candidate buffer, kept ON DEVICE: each round gathers the
+    # newly drawn rows with a device-side take + dynamic_update_slice instead
+    # of re-uploading the whole buffer from host (only the row-index vector
+    # crosses the host boundary, because its size is data-dependent).
     max_cand = int(1 + np.ceil(l) * n_rounds)
-    cand = np.zeros((max_cand, d), dtype=np.asarray(first).dtype)
-    cand[0] = first
+    cand_dev = jnp.zeros((max_cand, d), X.dtype).at[0].set(jnp.asarray(first))
     n_cand = 1
 
-    cand_dev = jnp.asarray(cand)
     valid = jnp.arange(max_cand) < n_cand
     for r in range(n_rounds):
         key, kr = jax.random.split(key)
@@ -222,24 +332,25 @@ def init_scalable(
             idx = idx[:take]
         if take == 0:
             break
-        cand[n_cand : n_cand + take] = np.asarray(X[jnp.asarray(idx)])
+        rows = jnp.take(X, jnp.asarray(idx), axis=0)
+        cand_dev = jax.lax.dynamic_update_slice(cand_dev, rows, (n_cand, 0))
         n_cand += take
-        cand_dev = jnp.asarray(cand)
         valid = jnp.arange(max_cand) < n_cand
 
     if n_cand < n_clusters:
         # Degenerate draw (tiny data): top up with random distinct rows,
         # like the reference falls back to random sampling.
         key, kf = jax.random.split(key)
-        extra = _random_rows(X, w, n_valid, n_clusters - n_cand, kf)
-        cand[n_cand : n_cand + extra.shape[0]] = extra
-        n_cand += extra.shape[0]
-        cand_dev = jnp.asarray(cand)
+        extra = jnp.asarray(_random_rows(X, w, n_valid,
+                                         n_clusters - n_cand, kf))
+        cand_dev = jax.lax.dynamic_update_slice(cand_dev, extra, (n_cand, 0))
+        n_cand += int(extra.shape[0])
         valid = jnp.arange(max_cand) < n_cand
 
     cweights = np.asarray(_candidate_weights(X, w, cand_dev, valid))[:n_cand]
+    cand = np.asarray(cand_dev[:n_cand], dtype=np.float32)
     seed = int(jax.random.randint(key, (), 0, 2**31 - 1))
-    centers = _finish_on_candidates(cand[:n_cand], cweights, n_clusters, seed)
+    centers = _finish_on_candidates(cand, cweights, n_clusters, seed)
     return jnp.asarray(centers)
 
 
