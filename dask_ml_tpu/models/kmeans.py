@@ -92,52 +92,56 @@ def lloyd_loop(X, w, centers, tol, max_iter: int):
     def body(state):
         centers, _, it, _ = state
         new_centers, _, inertia, shift = lloyd_step(X, w, centers)
-        return new_centers, inertia, it + 1, shift
+        return (new_centers, inertia.astype(jnp.float32), it + 1,
+                shift.astype(jnp.float32))
 
-    init = (centers, jnp.asarray(jnp.inf, X.dtype), jnp.asarray(0, jnp.int32),
-            jnp.asarray(jnp.inf, X.dtype))
+    init = (centers, jnp.asarray(jnp.inf, jnp.float32),
+            jnp.asarray(0, jnp.int32), jnp.asarray(jnp.inf, jnp.float32))
     return jax.lax.while_loop(cond, body, init)
 
 
-def _largest_divisor_leq(n: int, target: int) -> int:
-    for c in range(min(target, n), 0, -1):
-        if n % c == 0:
-            return c
-    return 1
+@partial(jax.jit, static_argnames=("mesh", "max_iter"))
+def lloyd_loop_fused(X, w, centers0, tol, *, mesh, max_iter: int):
+    """Bandwidth-optimal Lloyd over a feature-major (transposed) copy of X.
 
+    Two layout/scheduling facts dominate this kernel's speed on TPU, both
+    found by measurement (see bench.py for the methodology):
 
-@partial(jax.jit, static_argnames=("mesh", "max_iter", "block"))
-def lloyd_loop_fused(X, w, centers0, tol, *, mesh, max_iter: int,
-                     block: int = 32768):
-    """Bandwidth-optimal Lloyd: X is read ONCE per iteration.
+    1. **Lane padding.** TPU tiles are (sublane, 128-lane); an (n, d) array
+       with small d (the reference workload has d=50) is physically padded
+       d→128 in the minor dimension, so every pass over X reads up to 2.56×
+       the logical bytes. Transposing once to (d, n) moves the padding to the
+       sublane dimension (50→56 for f32), making physical ≈ logical traffic.
+       The transpose costs one extra pass, amortized over all Lloyd
+       iterations.
+    2. **Let XLA tile.** Handing the whole shard to XLA as plain matmul +
+       elementwise ops beats a hand-written `lax.scan` over VMEM-sized
+       blocks: XLA's own pipelined tiling overlaps HBM reads with compute,
+       while a scan serializes them. (A previous revision of this kernel
+       scanned manually and also collapsed to pathological block sizes when
+       the per-shard row count was prime; both problems are gone.)
 
-    The plain :func:`lloyd_step` reads X twice (distance matmul, then the
-    one-hot M-step matmul) and materializes an (n, k) one-hot array in HBM.
-    Here each shard scans its rows in VMEM-sized blocks and, per block,
-    computes distances, argmin, and the (k, d)/(k,) partial sums while the
-    block is still resident — the fused assign+accumulate pass the survey
-    calls for (SURVEY §2.10; the reference's Cython kernel _k_means.pyx:29-78
-    is the per-block sum, but dask still pays two passes + a graph barrier
-    per iteration). Works in bf16 inputs with f32 accumulation
-    (``preferred_element_type``): distances, sums, counts and inertia all
-    accumulate in f32 regardless of X's dtype.
+    Per iteration each shard computes distances as one (k, n_loc) matmul on
+    the MXU with a fused argmin/one-hot/M-step epilogue — the TPU-native
+    replacement for the reference's per-block Cython segment-sum + dask
+    tree-reduce (reference: cluster/k_means.py:470-492, _k_means.pyx:29-78).
+    The per-row ‖x‖² term is loop-invariant and hoisted out of the while_loop
+    (only the ``-2·x·c + ‖c‖²`` part enters the argmin; inertia adds ‖x‖²
+    back). Cross-shard reduction is one psum of (k·d + k + 1) floats per
+    iteration over the ICI, and the convergence check stays on device, so the
+    entire optimization is a single XLA program with no per-iteration host
+    round-trip (the reference pays a driver↔cluster barrier every iteration).
 
-    Cross-shard reduction is one psum of (k·d + k + 1) floats per iteration;
-    the convergence check stays on device, so the entire optimization remains
-    a single XLA program.
-
-    Only the ``-2·x·c + ‖c‖²`` part of the distance enters the argmin (the
-    ‖x‖² term is constant per row); inertia adds the ‖x‖² term back.
+    Accepts bf16 or f32 X; distances, sums, counts and inertia always
+    accumulate in f32 (``preferred_element_type``). On bandwidth-bound shapes
+    f32 is typically *faster* end-to-end than bf16 here, because Mosaic's
+    small-d bf16 matmul tiling is less efficient — measure before switching.
     """
     from jax.sharding import PartitionSpec as P
 
     from dask_ml_tpu.parallel.mesh import DATA_AXIS
 
-    n_shards = mesh.shape[DATA_AXIS]
-    n_loc = X.shape[0] // n_shards
     k, d = centers0.shape
-    blk = _largest_divisor_leq(n_loc, block)
-    n_blocks = n_loc // blk
 
     @partial(
         jax.shard_map,
@@ -146,40 +150,29 @@ def lloyd_loop_fused(X, w, centers0, tol, *, mesh, max_iter: int,
         out_specs=(P(), P(), P(), P()),
     )
     def run(X_loc, w_loc, c0, tol_):
-        Xb = X_loc.reshape(n_blocks, blk, d)
-        wb = w_loc.reshape(n_blocks, blk)
+        # One-time feature-major relayout; the barrier keeps XLA from fusing
+        # the transpose into each iteration's reads (which would re-pad d
+        # back onto the lane dimension).
+        XT = jax.lax.optimization_barrier(X_loc.T)  # (d, n_loc)
+        x2 = jnp.sum(XT.astype(jnp.float32) ** 2, axis=0)  # loop-invariant
+        kidx = jnp.arange(k, dtype=jnp.int32)[:, None]
 
         def one_iter(centers):
-            c = centers.astype(X_loc.dtype)
+            cx = centers.astype(XT.dtype)
             c2 = jnp.sum(centers * centers, axis=1)  # (k,) f32
-
-            def body(carry, inp):
-                sums, counts, inertia = carry
-                xb, wv = inp
-                prod = jax.lax.dot(
-                    xb, c.T, preferred_element_type=jnp.float32)  # (blk, k)
-                scores = c2[None, :] - 2.0 * prod
-                labels = jnp.argmin(scores, axis=1)
-                onehot = jax.nn.one_hot(labels, k, dtype=jnp.float32)
-                onehot = onehot * wv[:, None]
-                sums = sums + jax.lax.dot(
-                    onehot.T, xb.astype(jnp.float32),
-                    preferred_element_type=jnp.float32)
-                counts = counts + onehot.sum(axis=0)
-                x2 = jnp.sum(
-                    xb.astype(jnp.float32) ** 2, axis=1)
-                mind = jnp.maximum(jnp.min(scores, axis=1) + x2, 0.0)
-                inertia = inertia + jnp.sum(mind * wv)
-                return (sums, counts, inertia), None
-
-            # Accumulators are per-shard partial sums: mark varying so the
-            # scan carry types line up under shard_map's vma checks.
-            init = jax.lax.pcast(
-                (jnp.zeros((k, d), jnp.float32),
-                 jnp.zeros((k,), jnp.float32),
-                 jnp.asarray(0.0, jnp.float32)),
-                (DATA_AXIS,), to="varying")
-            (sums, counts, inertia), _ = jax.lax.scan(body, init, (Xb, wb))
+            prod = jax.lax.dot_general(
+                cx, XT, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)  # (k, n_loc)
+            scores = c2[:, None] - 2.0 * prod
+            best = jnp.argmin(scores, axis=0).astype(jnp.int32)
+            onehot = (kidx == best[None, :]).astype(jnp.float32)
+            oh_w = onehot * w_loc[None, :]
+            sums = jax.lax.dot_general(
+                oh_w, XT.astype(jnp.float32), (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # (k, d)
+            counts = oh_w.sum(axis=1)
+            mind = jnp.maximum(jnp.min(scores, axis=0) + x2, 0.0)
+            inertia = jnp.sum(mind * w_loc)
             sums = jax.lax.psum(sums, DATA_AXIS)
             counts = jax.lax.psum(counts, DATA_AXIS)
             inertia = jax.lax.psum(inertia, DATA_AXIS)
