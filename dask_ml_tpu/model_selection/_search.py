@@ -35,7 +35,7 @@ from typing import Optional
 import numpy as np
 from sklearn.base import BaseEstimator, MetaEstimatorMixin, is_classifier
 from sklearn.model_selection import ParameterGrid, ParameterSampler
-from sklearn.pipeline import Pipeline
+from sklearn.pipeline import FeatureUnion, Pipeline
 
 from dask_ml_tpu.model_selection import methods
 from dask_ml_tpu.model_selection._split import check_cv
@@ -208,6 +208,30 @@ def _split_pipeline_params(steps, params):
     return per_stage, top
 
 
+def _is_dropped(trans) -> bool:
+    return trans is None or trans == "drop"
+
+
+def _union_concat(parts, weights, n_rows):
+    """Weighted horizontal concat of sub-transformer outputs, matching
+    sklearn's ``FeatureUnion.transform`` (and the reference's
+    ``feature_union_concat``, methods.py:179-187)."""
+    arrays = []
+    for name, Xt in parts:
+        w = (weights or {}).get(name)
+        arrays.append(Xt if w is None else np.asarray(Xt) * w)
+    if not arrays:
+        return np.zeros((n_rows, 0))
+    try:
+        from scipy import sparse
+
+        if any(sparse.issparse(a) for a in arrays):
+            return sparse.hstack(arrays).tocsr()
+    except ImportError:  # pragma: no cover
+        pass
+    return np.hstack([np.asarray(a) for a in arrays])
+
+
 class _CandidateRunner:
     """Executes one (candidate, split) cell with memoized stage fits."""
 
@@ -269,104 +293,259 @@ class _CandidateRunner:
 
         return self.memo.get_or_run(key, run)
 
-    # -- pipeline, stage-by-stage with prefix CSE ------------------------
-    def _fit_pipeline(self, pipe, params, split_idx):
-        per_stage, top = _split_pipeline_params(pipe.steps, params)
-        per_stage_fp, top_fp = _split_pipeline_params(
-            pipe.steps, self._fit_params_for(split_idx)
-        )
-        if top or top_fp:
-            # params targeting the Pipeline object itself (e.g. steps=...):
-            # no prefix sharing possible; fall back to a whole-object fit.
-            return self._fit_plain(params, split_idx)
+    # -- recursive composite expansion with CSE --------------------------
+    #
+    # Pipelines and FeatureUnions are expanded recursively so every leaf
+    # transformer fit is its own memo entry: pipeline prefixes are shared
+    # across candidates (reference: _search.py:462-503 ``_do_pipeline``) and
+    # union sub-transformers are shared across candidates *including ones that
+    # differ only in transformer_weights*, because weights apply at the concat
+    # step, not the fit (reference: _search.py:524-593 ``_do_featureunion``,
+    # methods.py:169-187).
 
-        upstream = tokenize("pipe-root", split_idx)
-        # a pairwise first stage (precomputed kernel) needs the two-axis
-        # root slice K[train, train], same as the plain-estimator path
-        first_real = next(
-            (s for _, s in pipe.steps if s is not None and s != "passthrough"),
-            None,
-        )
-        root_pairwise = _is_pairwise(first_real) if first_real is not None else False
-        fitted_steps = []
-        total_fit_time = 0.0
-        failed = False
-        for i, (name, stage) in enumerate(pipe.steps):
-            sparams = per_stage[name]
-            sfit = per_stage_fp.get(name) or {}
-            is_last = i == len(pipe.steps) - 1
-            if stage is None or stage == "passthrough":
-                fitted_steps.append((name, stage))
-                upstream = tokenize(upstream, "passthrough")
-                continue
-            key = tokenize("stage", upstream, type(stage),
-                           stage.get_params(deep=True), sparams,
-                           sorted(sfit), is_last)
+    def _root_token(self, split_idx):
+        return tokenize("pipe-root", split_idx)
 
-            if is_last:
-                def run_last(upstream=upstream, stage=stage, sparams=sparams,
-                             sfit=sfit):
-                    Xt = self._stage_input(upstream, split_idx, train=True,
-                                           pairwise=root_pairwise)
-                    y = self.cv_cache.extract(split_idx, train=True, is_x=False)
-                    return methods.fit(
-                        stage, Xt, y, params=sparams, fit_params=sfit,
-                        error_score=self.error_score,
-                    )
-
-                fitted, t = self.memo.get_or_run(key, run_last)
-                total_fit_time += t
-                if fitted is FIT_FAILURE:
-                    failed = True
-                fitted_steps.append((name, fitted))
-            else:
-                def run_stage(upstream=upstream, stage=stage, sparams=sparams,
-                              sfit=sfit):
-                    Xt = self._stage_input(upstream, split_idx, train=True,
-                                           pairwise=root_pairwise)
-                    y = self.cv_cache.extract(split_idx, train=True, is_x=False)
-                    return methods.fit_transform(
-                        stage, Xt, y, params=sparams, fit_params=sfit,
-                        error_score=self.error_score,
-                    )
-
-                (fitted, Xt), t = self.memo.get_or_run(key, run_stage)
-                total_fit_time += t
-                if fitted is FIT_FAILURE:
-                    failed = True
-                    fitted_steps.append((name, FIT_FAILURE))
-                    break
-                fitted_steps.append((name, fitted))
-            upstream = key
-
-        if failed:
-            return FIT_FAILURE, total_fit_time
-        out = methods.copy_estimator(pipe)
-        out.steps = fitted_steps
-        return out, total_fit_time
-
-    def _stage_input(self, upstream, split_idx, train: bool = True,
-                     pairwise: bool = False):
-        """Train-side input of a stage: the original slice at the pipeline
-        root, else the transformed output stored in the upstream stage's memo
-        entry. Safe to read here: any thread reaching stage *i+1* already
-        passed through stage *i*'s ``get_or_run`` in its own loop, so the
-        upstream future exists and resolving it cannot race."""
-        if upstream == tokenize("pipe-root", split_idx):
-            return self.cv_cache.extract(split_idx, train=train,
-                                         pairwise=pairwise)
+    def _resolve_input(self, upstream, split_idx, root_pairwise: bool = False):
+        """Train-side input identified by ``upstream``: the original slice at
+        the root token, else the transformed output stored in the upstream
+        node's memo entry. Safe to read here: any thread reaching node *i+1*
+        already passed through node *i*'s ``get_or_run`` in its own recursion,
+        so the upstream future exists and resolving it cannot race."""
+        if upstream == self._root_token(split_idx):
+            return self.cv_cache.extract(split_idx, train=True,
+                                         pairwise=root_pairwise)
 
         def missing():  # pragma: no cover - ordering invariant
-            raise RuntimeError("upstream stage output missing")
+            raise RuntimeError("upstream node output missing")
 
         (_, Xt), _t = self.memo.get_or_run(upstream, missing)
         return Xt
 
+    def _y_train(self, split_idx):
+        return self.cv_cache.extract(split_idx, train=True, is_x=False)
+
+    def _fit_transform_any(self, est, params, sfit, upstream, split_idx,
+                           root_pairwise=False):
+        """Fit+transform a node in the composite tree.
+        Returns ``(token, fitted, Xt, fit_time, failed)``; ``token`` has a
+        memo entry of shape ``((fitted, Xt), time)`` so it can serve as the
+        ``upstream`` of downstream nodes."""
+        if isinstance(est, Pipeline):
+            return self._ft_pipeline(est, params, sfit, upstream, split_idx,
+                                     root_pairwise, need_transform=True)
+        if isinstance(est, FeatureUnion):
+            return self._ft_union(est, params, sfit, upstream, split_idx,
+                                  root_pairwise, need_transform=True)
+        key = tokenize("stage", upstream, type(est),
+                       est.get_params(deep=True), params, sorted(sfit), "ft")
+
+        def run_stage():
+            Xin = self._resolve_input(upstream, split_idx, root_pairwise)
+            return methods.fit_transform(
+                est, Xin, self._y_train(split_idx), params=params,
+                fit_params=sfit, error_score=self.error_score,
+            )
+
+        (fitted, Xt), t = self.memo.get_or_run(key, run_stage)
+        return key, fitted, Xt, t, fitted is FIT_FAILURE
+
+    def _fit_any(self, est, params, sfit, upstream, split_idx,
+                 root_pairwise=False):
+        """Fit-only variant (terminal nodes: the last pipeline stage, or the
+        search estimator itself). Returns ``(token, fitted, fit_time,
+        failed)``."""
+        if isinstance(est, Pipeline):
+            token, fitted, _Xt, t, failed = self._ft_pipeline(
+                est, params, sfit, upstream, split_idx, root_pairwise,
+                need_transform=False,
+            )
+            return token, fitted, t, failed
+        if isinstance(est, FeatureUnion):
+            token, fitted, _Xt, t, failed = self._ft_union(
+                est, params, sfit, upstream, split_idx, root_pairwise,
+                need_transform=False,
+            )
+            return token, fitted, t, failed
+        key = tokenize("stage", upstream, type(est),
+                       est.get_params(deep=True), params, sorted(sfit), "fit")
+
+        def run_fit():
+            Xin = self._resolve_input(upstream, split_idx, root_pairwise)
+            return methods.fit(
+                est, Xin, self._y_train(split_idx), params=params,
+                fit_params=sfit, error_score=self.error_score,
+            )
+
+        fitted, t = self.memo.get_or_run(key, run_fit)
+        return key, fitted, t, fitted is FIT_FAILURE
+
+    def _ft_atomic_fallback(self, est, params, sfit, upstream, split_idx,
+                            root_pairwise, need_transform):
+        """Whole-object fit for composites whose candidate params target the
+        composite itself (e.g. ``steps=``/``transformer_list=`` overrides):
+        no sub-sharing is possible, same fallback the reference takes."""
+        mode = "ft" if need_transform else "fit"
+        key = tokenize("whole", upstream, type(est),
+                       est.get_params(deep=True), params, sorted(sfit), mode)
+
+        def run_whole():
+            Xin = self._resolve_input(upstream, split_idx, root_pairwise)
+            y = self._y_train(split_idx)
+            if need_transform:
+                return methods.fit_transform(
+                    est, Xin, y, params=params, fit_params=sfit,
+                    error_score=self.error_score,
+                )
+            return methods.fit(
+                est, Xin, y, params=params, fit_params=sfit,
+                error_score=self.error_score,
+            )
+
+        if need_transform:
+            (fitted, Xt), t = self.memo.get_or_run(key, run_whole)
+        else:
+            fitted, t = self.memo.get_or_run(key, run_whole)
+            Xt = None
+        return key, fitted, Xt, t, fitted is FIT_FAILURE
+
+    def _ft_pipeline(self, pipe, params, sfit, upstream, split_idx,
+                     root_pairwise, need_transform):
+        per_stage, top = _split_pipeline_params(pipe.steps, params)
+        per_stage_fp, top_fp = _split_pipeline_params(pipe.steps, sfit)
+        if top or top_fp:
+            return self._ft_atomic_fallback(
+                pipe, params, sfit, upstream, split_idx, root_pairwise,
+                need_transform,
+            )
+        token = upstream
+        fitted_steps = []
+        total_time = 0.0
+        failed = False
+        Xt = None
+        for i, (name, stage) in enumerate(pipe.steps):
+            if _is_dropped(stage) or stage == "passthrough":
+                # identity stage: downstream input IS the upstream data, so
+                # the token must stay unchanged (it has a resolvable memo
+                # entry / root slice; a synthetic re-token would not)
+                fitted_steps.append((name, stage))
+                continue
+            sparams = per_stage[name]
+            stage_fp = per_stage_fp.get(name) or {}
+            is_last = i == len(pipe.steps) - 1
+            if is_last and not need_transform:
+                token, fitted, t, f = self._fit_any(
+                    stage, sparams, stage_fp, token, split_idx, root_pairwise)
+            else:
+                token, fitted, Xt, t, f = self._fit_transform_any(
+                    stage, sparams, stage_fp, token, split_idx, root_pairwise)
+            total_time += t
+            if f:
+                failed = True
+                fitted_steps.append((name, FIT_FAILURE))
+                break
+            fitted_steps.append((name, fitted))
+        if failed:
+            return token, FIT_FAILURE, FIT_FAILURE, total_time, True
+        out = methods.copy_estimator(pipe)
+        out.steps = fitted_steps
+        # `token` is the last real stage's token; its memo entry already holds
+        # Xt, but for a fit-only tail there is no transform output to expose.
+        return token, out, Xt, total_time, False
+
+    _UNION_SELF_PARAMS = ("n_jobs", "verbose", "verbose_feature_names_out")
+
+    def _ft_union(self, union, params, sfit, upstream, split_idx,
+                  root_pairwise, need_transform):
+        per_sub, top = _split_pipeline_params(union.transformer_list, params)
+        per_sub_fp, top_fp = _split_pipeline_params(union.transformer_list, sfit)
+        top = dict(top)
+        weights = union.transformer_weights
+        if "transformer_weights" in top:
+            weights = top.pop("transformer_weights")
+        self_params = {
+            k: top.pop(k) for k in list(top) if k in self._UNION_SELF_PARAMS
+        }
+        if top or top_fp:
+            # e.g. transformer_list= overrides, or params for an unknown name
+            return self._ft_atomic_fallback(
+                union, params, sfit, upstream, split_idx, root_pairwise,
+                need_transform,
+            )
+
+        sub_tokens = []
+        sub_fitted = []
+        sub_parts = []  # (name, Xt) for concat, transform-producing subs only
+        total_time = 0.0
+        failed = False
+        for name, trans in union.transformer_list:
+            if _is_dropped(trans):
+                sub_tokens.append("drop")
+                sub_fitted.append((name, trans))
+                continue
+            if need_transform:
+                tok, fitted, Xt, t, f = self._fit_transform_any(
+                    trans, per_sub[name], per_sub_fp.get(name) or {},
+                    upstream, split_idx, root_pairwise,
+                )
+                sub_parts.append((name, Xt))
+            else:
+                tok, fitted, t, f = self._fit_any(
+                    trans, per_sub[name], per_sub_fp.get(name) or {},
+                    upstream, split_idx, root_pairwise,
+                )
+            total_time += t
+            failed = failed or f
+            sub_tokens.append(tok)
+            sub_fitted.append((name, fitted))
+
+        wkey = sorted(weights.items()) if weights else None
+        mode = "ft" if need_transform else "fit"
+        ckey = tokenize("union-concat", sub_tokens, wkey,
+                        sorted(self_params.items()), mode)
+
+        def assemble():
+            if failed:
+                return (FIT_FAILURE, FIT_FAILURE), 0.0
+            out = methods.copy_estimator(union)
+            if self_params:
+                out.set_params(**self_params)
+            out.transformer_list = list(sub_fitted)
+            out.transformer_weights = weights
+            Xt = None
+            if need_transform:
+                n_rows = len(
+                    np.asarray(
+                        self._resolve_input(upstream, split_idx, root_pairwise)
+                    )
+                )
+                Xt = _union_concat(sub_parts, weights, n_rows)
+            return (out, Xt), 0.0
+
+        (fitted_union, Xt), t_assemble = self.memo.get_or_run(ckey, assemble)
+        total_time += t_assemble
+        return (ckey, fitted_union, Xt, total_time,
+                fitted_union is FIT_FAILURE)
+
     # -- one cell --------------------------------------------------------
     def run(self, params, split_idx):
         est = self.estimator
-        if isinstance(est, Pipeline):
-            fitted, fit_time = self._fit_pipeline(est, params, split_idx)
+        if isinstance(est, (Pipeline, FeatureUnion)):
+            root = self._root_token(split_idx)
+            root_pairwise = False
+            if isinstance(est, Pipeline):
+                first_real = next(
+                    (s for _, s in est.steps
+                     if not _is_dropped(s) and s != "passthrough"),
+                    None,
+                )
+                root_pairwise = (
+                    _is_pairwise(first_real) if first_real is not None else False
+                )
+            _tok, fitted, fit_time, _failed = self._fit_any(
+                est, params, self._fit_params_for(split_idx), root, split_idx,
+                root_pairwise,
+            )
         else:
             fitted, fit_time = self._fit_plain(params, split_idx)
 

@@ -91,6 +91,26 @@ class FailingClassifier(BaseEstimator, ClassifierMixin):
         return 0.0
 
 
+class FailingTransformer(BaseEstimator, TransformerMixin):
+    """Transformer that raises inside fit when parameter ==
+    FAILING_PARAMETER — drives FIT_FAILURE propagation through FeatureUnion
+    expansion (reference: test_model_selection.py:466-537 uses
+    FailingClassifier inside composite grids the same way)."""
+
+    FAILING_PARAMETER = 2
+
+    def __init__(self, parameter=None):
+        self.parameter = parameter
+
+    def fit(self, X, y=None):
+        if self.parameter == FailingTransformer.FAILING_PARAMETER:
+            raise ValueError("Failing transformer failed as required")
+        return self
+
+    def transform(self, X):
+        return np.asarray(X)
+
+
 class CheckXClassifier(BaseEstimator, ClassifierMixin):
     """Asserts the X it receives equals ``expected_X``
     (reference: utils_test.py:59-73)."""

@@ -1,0 +1,267 @@
+"""FeatureUnion work-sharing in the search driver
+(reference: _search.py:524-593 ``_do_featureunion``, methods.py:169-187,
+test_model_selection.py:466-537)."""
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_classification
+from sklearn.decomposition import PCA as SKPCA
+from sklearn.exceptions import FitFailedWarning
+from sklearn.linear_model import LogisticRegression as SKLogisticRegression
+from sklearn.model_selection import GridSearchCV as SkGridSearchCV
+from sklearn.pipeline import FeatureUnion, Pipeline
+from sklearn.preprocessing import StandardScaler as SKStandardScaler
+
+from dask_ml_tpu.model_selection import GridSearchCV, KFold
+from dask_ml_tpu.model_selection.utils_test import (
+    CountingTransformer,
+    FailingTransformer,
+    ScalingTransformer,
+)
+
+
+@pytest.fixture
+def clf_data():
+    return make_classification(
+        n_samples=120, n_features=6, random_state=0, n_informative=4
+    )
+
+
+def union_pipe():
+    return Pipeline([
+        ("union", FeatureUnion([
+            ("scale", SKStandardScaler()),
+            ("pca", SKPCA(n_components=2, random_state=0)),
+        ])),
+        ("clf", SKLogisticRegression()),
+    ])
+
+
+def test_union_grid_matches_sklearn(clf_data):
+    """Differential parity on shared splits for a union grid that varies a
+    sub-transformer param, the downstream classifier, and the weights."""
+    X, y = clf_data
+    grid = {
+        "union__pca__n_components": [2, 3],
+        "union__transformer_weights": [None, {"scale": 0.5, "pca": 2.0}],
+        "clf__C": [0.1, 1.0],
+    }
+    splits = list(KFold(n_splits=3).split(X, y))
+    ours = GridSearchCV(
+        union_pipe(), grid, cv=splits, iid=False, refit=False
+    ).fit(X, y)
+    theirs = SkGridSearchCV(
+        union_pipe(), grid, cv=iter(splits), refit=False
+    ).fit(X, y)
+    np.testing.assert_allclose(
+        ours.cv_results_["mean_test_score"],
+        theirs.cv_results_["mean_test_score"],
+        rtol=1e-6,
+    )
+    np.testing.assert_array_equal(
+        ours.cv_results_["rank_test_score"],
+        theirs.cv_results_["rank_test_score"],
+    )
+
+
+def test_union_sub_transformer_cse(clf_data):
+    """A union sub-transformer with one config fits once per split across the
+    whole candidate grid — the CountingTransformer proof the reference's CSE
+    delivers via graph keys (reference: _search.py:538-556)."""
+    X, y = clf_data
+    CountingTransformer.reset()
+    pipe = Pipeline([
+        ("union", FeatureUnion([
+            ("count", CountingTransformer(factor=2.0)),
+            ("scale", ScalingTransformer(factor=1.0)),
+        ])),
+        ("clf", SKLogisticRegression()),
+    ])
+    grid = {
+        "union__scale__factor": [1.0, 3.0],
+        "clf__C": [0.1, 1.0, 10.0],
+    }
+    GridSearchCV(pipe, grid, cv=3, refit=False, n_jobs=4).fit(X, y)
+    # 6 candidates x 3 splits = 18 cells, but the counting sub-transformer has
+    # a single config → 3 real fits (one per split).
+    assert CountingTransformer.n_fits == 3
+
+
+def test_union_weights_grouping(clf_data):
+    """Candidates differing ONLY in transformer_weights share every sub-fit:
+    weights apply at concat, not at fit (reference: _search.py:558-575)."""
+    X, y = clf_data
+    CountingTransformer.reset()
+    pipe = Pipeline([
+        ("union", FeatureUnion([
+            ("count", CountingTransformer(factor=2.0)),
+        ])),
+        ("clf", SKLogisticRegression()),
+    ])
+    grid = {
+        "union__transformer_weights": [None, {"count": 0.5}, {"count": 2.0}],
+    }
+    ours = GridSearchCV(pipe, grid, cv=3, refit=False, n_jobs=4).fit(X, y)
+    assert CountingTransformer.n_fits == 3  # one per split, not 3x3
+    assert np.isfinite(ours.cv_results_["mean_test_score"]).all()
+
+
+def test_union_error_score_propagation(clf_data):
+    """A failing sub-transformer poisons exactly the failing candidates and
+    propagates error_score through union → pipeline → scoring
+    (reference: methods.py:169-187 sentinel flow)."""
+    X, y = clf_data
+    pipe = Pipeline([
+        ("union", FeatureUnion([
+            ("maybe_fail", FailingTransformer()),
+            ("scale", ScalingTransformer()),
+        ])),
+        ("clf", SKLogisticRegression()),
+    ])
+    grid = {
+        "union__maybe_fail__parameter": [
+            0, FailingTransformer.FAILING_PARAMETER
+        ],
+    }
+    gs = GridSearchCV(pipe, grid, cv=3, error_score=-5.0, refit=False,
+                      return_train_score=True)
+    with pytest.warns(FitFailedWarning):
+        gs.fit(X, y)
+    res = gs.cv_results_
+    assert (res["mean_test_score"][1] == -5.0)
+    assert (res["mean_train_score"][1] == -5.0)
+    assert (res["mean_test_score"][:1] != -5.0).all()
+
+
+def test_union_error_score_raise(clf_data):
+    X, y = clf_data
+    pipe = Pipeline([
+        ("union", FeatureUnion([
+            ("fail", FailingTransformer(
+                parameter=FailingTransformer.FAILING_PARAMETER)),
+        ])),
+        ("clf", SKLogisticRegression()),
+    ])
+    gs = GridSearchCV(pipe, {}, cv=3, error_score="raise", refit=False)
+    with pytest.raises(ValueError, match="Failing transformer"):
+        gs.fit(X, y)
+
+
+def test_union_dropped_transformer(clf_data):
+    """'drop' / None sub-transformers are skipped, as sklearn does."""
+    X, y = clf_data
+    pipe = Pipeline([
+        ("union", FeatureUnion([
+            ("scale", SKStandardScaler()),
+            ("dropped", "drop"),
+        ])),
+        ("clf", SKLogisticRegression()),
+    ])
+    splits = list(KFold(n_splits=3).split(X, y))
+    ours = GridSearchCV(
+        pipe, {"clf__C": [0.5, 1.0]}, cv=splits, iid=False, refit=False
+    ).fit(X, y)
+    theirs = SkGridSearchCV(
+        pipe, {"clf__C": [0.5, 1.0]}, cv=iter(splits), refit=False
+    ).fit(X, y)
+    np.testing.assert_allclose(
+        ours.cv_results_["mean_test_score"],
+        theirs.cv_results_["mean_test_score"],
+        rtol=1e-6,
+    )
+
+
+def test_union_nested_pipeline_sub_transformer(clf_data):
+    """A Pipeline nested inside a FeatureUnion expands recursively."""
+    X, y = clf_data
+    CountingTransformer.reset()
+    pipe = Pipeline([
+        ("union", FeatureUnion([
+            ("nested", Pipeline([
+                ("count", CountingTransformer(factor=2.0)),
+                ("pca", SKPCA(n_components=2, random_state=0)),
+            ])),
+            ("scale", SKStandardScaler()),
+        ])),
+        ("clf", SKLogisticRegression()),
+    ])
+    grid = {
+        "union__nested__pca__n_components": [2, 3],
+        "clf__C": [0.1, 1.0],
+    }
+    splits = list(KFold(n_splits=3).split(X, y))
+    ours = GridSearchCV(
+        pipe, grid, cv=splits, iid=False, refit=False, n_jobs=4
+    ).fit(X, y)
+    # the nested prefix (count) is shared across all 4 candidates
+    assert CountingTransformer.n_fits == 3
+    theirs = SkGridSearchCV(
+        pipe, grid, cv=iter(splits), refit=False
+    ).fit(X, y)
+    np.testing.assert_allclose(
+        ours.cv_results_["mean_test_score"],
+        theirs.cv_results_["mean_test_score"],
+        rtol=1e-6,
+    )
+
+
+def test_union_as_terminal_stage(clf_data):
+    """FeatureUnion as the LAST pipeline stage exercises the fit-only union
+    path (scoring via a custom scorer on the transform output)."""
+    X, y = clf_data
+    pipe = Pipeline([
+        ("scale", SKStandardScaler()),
+        ("union", FeatureUnion([
+            ("pca", SKPCA(n_components=2, random_state=0)),
+            ("ident", ScalingTransformer(factor=1.0)),
+        ])),
+    ])
+
+    def width_scorer(est, X, y=None):
+        return float(est.transform(np.asarray(X)).shape[1])
+
+    gs = GridSearchCV(
+        pipe, {"union__pca__n_components": [2, 3]}, cv=2, iid=False,
+        refit=False, scoring=width_scorer,
+    ).fit(X, y)
+    np.testing.assert_allclose(
+        gs.cv_results_["mean_test_score"], [2 + 6, 3 + 6]
+    )
+
+
+def test_union_transformer_list_override_falls_back(clf_data):
+    """Grid params that replace the transformer_list force the whole-object
+    fallback but stay correct."""
+    X, y = clf_data
+    alt = [("scale", SKStandardScaler())]
+    pipe = union_pipe()
+    grid = {
+        "union__transformer_list": [
+            [("scale", SKStandardScaler()),
+             ("pca", SKPCA(n_components=2, random_state=0))],
+            alt,
+        ],
+        "clf__C": [1.0],
+    }
+    splits = list(KFold(n_splits=3).split(X, y))
+    ours = GridSearchCV(
+        pipe, grid, cv=splits, iid=False, refit=False
+    ).fit(X, y)
+    theirs = SkGridSearchCV(
+        pipe, grid, cv=iter(splits), refit=False
+    ).fit(X, y)
+    np.testing.assert_allclose(
+        ours.cv_results_["mean_test_score"],
+        theirs.cv_results_["mean_test_score"],
+        rtol=1e-6,
+    )
+
+
+def test_union_refit_delegation(clf_data):
+    """refit=True end-to-end through a union pipeline: predict delegates."""
+    X, y = clf_data
+    gs = GridSearchCV(
+        union_pipe(), {"clf__C": [0.1, 1.0]}, cv=3, iid=False, refit=True
+    ).fit(X, y)
+    assert gs.predict(X).shape == (len(y),)
+    assert gs.best_estimator_.score(X, y) > 0.5

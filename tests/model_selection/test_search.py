@@ -368,6 +368,76 @@ def test_grid_search_pickles(clf_data):
     np.testing.assert_array_equal(gs2.predict(X), gs.predict(X))
 
 
+def test_cache_cv_false(clf_data):
+    """cache_cv=False re-extracts slices per task but must give identical
+    results (reference: _search.py:979-999 semantics knob)."""
+    X, y = clf_data
+    grid = {"C": [0.1, 1.0]}
+    splits = list(KFold(n_splits=3).split(X, y))
+    cached = GridSearchCV(
+        SKLogisticRegression(), grid, cv=splits, iid=False, refit=False,
+        cache_cv=True,
+    ).fit(X, y)
+    uncached = GridSearchCV(
+        SKLogisticRegression(), grid, cv=splits, iid=False, refit=False,
+        cache_cv=False,
+    ).fit(X, y)
+    np.testing.assert_array_equal(
+        cached.cv_results_["mean_test_score"],
+        uncached.cv_results_["mean_test_score"],
+    )
+
+
+def test_multimetric_error_score_interaction():
+    """Failing candidates get error_score in EVERY metric
+    (reference: test_model_selection_sklearn.py:976-1024)."""
+    X = np.random.RandomState(0).randn(60, 3)
+    y = np.r_[np.zeros(30), np.ones(30)].astype(int)
+    grid = {"parameter": [0, FailingClassifier.FAILING_PARAMETER]}
+
+    def one(est, X, y):
+        return 1.0
+
+    def two(est, X, y):
+        return 2.0
+
+    gs = GridSearchCV(
+        FailingClassifier(), grid, cv=3,
+        scoring={"one": one, "two": two},
+        refit=False, error_score=-7.0, return_train_score=True,
+    )
+    with pytest.warns(FitFailedWarning):
+        gs.fit(X, y)
+    res = gs.cv_results_
+    for m in ("one", "two"):
+        assert res[f"mean_test_{m}"][1] == -7.0
+        assert res[f"mean_train_{m}"][1] == -7.0
+    assert res["mean_test_one"][0] == 1.0
+    assert res["mean_test_two"][0] == 2.0
+
+
+def test_n_jobs_sequential_matches_threaded(clf_data):
+    """n_jobs=1 (sequential) and threaded execution produce identical
+    cv_results_ including the CSE counter."""
+    X, y = clf_data
+    pipe = Pipeline([
+        ("scale", SKStandardScaler()),
+        ("clf", SKLogisticRegression()),
+    ])
+    grid = {"clf__C": [0.1, 1.0, 10.0]}
+    splits = list(KFold(n_splits=3).split(X, y))
+    seq = GridSearchCV(
+        pipe, grid, cv=splits, iid=False, refit=False, n_jobs=1
+    ).fit(X, y)
+    par = GridSearchCV(
+        pipe, grid, cv=splits, iid=False, refit=False, n_jobs=4
+    ).fit(X, y)
+    np.testing.assert_array_equal(
+        seq.cv_results_["mean_test_score"], par.cv_results_["mean_test_score"]
+    )
+    assert seq.n_shared_fits_ == par.n_shared_fits_
+
+
 def test_full_pipeline_grid_matches_sklearn(clf_data):
     """3-stage pipeline grid, parity with sklearn over shared splits — the
     worked example of docs/source/hyper-parameter-search.rst:78-135."""
@@ -386,3 +456,26 @@ def test_full_pipeline_grid_matches_sklearn(clf_data):
         theirs.cv_results_["mean_test_score"],
         rtol=1e-6,
     )
+
+
+def test_pipeline_passthrough_stage(clf_data):
+    """'passthrough'/None stages are identity: the next stage resolves its
+    input from the unchanged upstream token (code-review r3 regression)."""
+    X, y = clf_data
+    for ident in ("passthrough", None):
+        pipe = Pipeline([
+            ("p", ident),
+            ("clf", SKLogisticRegression()),
+        ])
+        splits = list(KFold(n_splits=3).split(X, y))
+        ours = GridSearchCV(
+            pipe, {"clf__C": [0.1, 1.0]}, cv=splits, iid=False, refit=False
+        ).fit(X, y)
+        theirs = SkGridSearchCV(
+            pipe, {"clf__C": [0.1, 1.0]}, cv=iter(splits), refit=False
+        ).fit(X, y)
+        np.testing.assert_allclose(
+            ours.cv_results_["mean_test_score"],
+            theirs.cv_results_["mean_test_score"],
+            rtol=1e-6,
+        )
