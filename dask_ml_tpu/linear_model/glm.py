@@ -97,6 +97,8 @@ class _GLM(BaseEstimator):
         return np.asarray(y)
 
     def fit(self, X, y=None, sample_weight=None):
+        self._pf_state = None  # batch fit discards any streaming state
+        self._pf_classes = None
         X = check_array(X)
         y = self._encode_y(y)
         mesh = mesh_lib.default_mesh()
@@ -131,6 +133,94 @@ class _GLM(BaseEstimator):
         eta = Xs @ jnp.asarray(self._coef, Xs.dtype)
         return np.asarray(unpad_rows(eta, n))
 
+    # -- streaming / incremental training --------------------------------
+    #
+    # The reference reaches streaming GLMs through the deprecated Partial*
+    # wrappers + the Incremental chain (reference: _partial.py:104-182,
+    # stochastic_gradient.py:7-15). Here the estimator itself implements
+    # partial_fit (one proximal-SGD step per block), and exposes the
+    # functional hooks Incremental uses to fuse the whole block chain into a
+    # single lax.scan (wrappers.incremental_scan).
+
+    def _encode_y_partial(self, y, classes=None):
+        return self._encode_y(y)
+
+    def _sgd_config(self):
+        sk = dict(self.solver_kwargs or {})
+        regularizer, lamduh = self.penalty, 1.0 / self.C
+        if self.solver in ("gradient_descent", "newton"):
+            # these solvers optimize the unregularized objective in fit()
+            # (reference: glm.py:120-122); streaming must match, or
+            # fit/partial_fit on the same estimator solve different problems
+            regularizer, lamduh = "l2", 0.0
+        return dict(
+            family=self.family,
+            regularizer=regularizer,
+            lamduh=lamduh,
+            eta0=float(sk.get("eta0", 0.1)),
+            power_t=float(sk.get("power_t", 0.5)),
+            fit_intercept=bool(self.fit_intercept),
+        )
+
+    def _pf_width(self, n_features: int) -> int:
+        return n_features + 1 if self.fit_intercept else n_features
+
+    def _pf_state_device(self, n_features: int):
+        state = getattr(self, "_pf_state", None)
+        if state is None:
+            width = self._pf_width(n_features)
+            coef = getattr(self, "_coef", None)
+            if coef is not None and coef.shape == (width,):
+                # warm-start streaming from a batch-fitted solution, the
+                # sklearn partial_fit contract (continue, don't reset)
+                return (jnp.asarray(coef, jnp.float32),
+                        jnp.asarray(0.0, jnp.float32))
+            return (jnp.zeros((width,), jnp.float32),
+                    jnp.asarray(0.0, jnp.float32))
+        beta, t = state
+        if beta.shape[0] != self._pf_width(n_features):
+            raise ValueError(
+                f"partial_fit block has {n_features} features but the "
+                f"running state was built for "
+                f"{beta.shape[0] - int(self.fit_intercept)}"
+            )
+        return jnp.asarray(beta, jnp.float32), jnp.asarray(t, jnp.float32)
+
+    def _store_pf_state(self, state):
+        beta = np.asarray(state[0])
+        self._pf_state = (beta, float(state[1]))
+        self._coef = beta
+        if self.fit_intercept:
+            self.coef_ = beta[:-1]
+            self.intercept_ = beta[-1]
+        else:
+            self.coef_ = beta
+        self.n_iter_ = int(float(state[1]))
+
+    def partial_fit(self, X, y=None, classes=None, sample_weight=None):
+        """One proximal-SGD step on this block; resumable across calls."""
+        X = check_array(X)
+        y_enc = self._encode_y_partial(y, classes)
+        state = self._pf_state_device(int(X.shape[1]))
+        _, apply_one = core.get_stream_step(**self._sgd_config())
+        data = prepare_data(X, y=y_enc, sample_weight=sample_weight,
+                            y_dtype=jnp.float32)
+        state = apply_one(state, data.X, data.y, data.weights)
+        self._store_pf_state(state)
+        return self
+
+    def _incremental_begin(self, X, y, classes=None):
+        """Hook for :class:`dask_ml_tpu.wrappers.Incremental`'s fused-scan
+        path: returns ``(step_fn, init_state, y_encoded)``."""
+        y_enc = self._encode_y_partial(y, classes)
+        step, _ = core.get_stream_step(**self._sgd_config())
+        state = self._pf_state_device(int(X.shape[1]))
+        return step, state, y_enc
+
+    def _incremental_finalize(self, state):
+        self._store_pf_state(state)
+        return self
+
 
 class LogisticRegression(_GLM):
     """Logistic regression (reference: linear_model/glm.py:180-232)."""
@@ -149,6 +239,33 @@ class LogisticRegression(_GLM):
                 f"LogisticRegression requires exactly 2 classes, got "
                 f"{len(self.classes_)}: {self.classes_!r}"
             )
+        return (y == self.classes_[1]).astype(np.float32)
+
+    def _encode_y_partial(self, y, classes=None):
+        # Streaming blocks may not contain both classes; the class set is
+        # pinned on the first call (explicitly via ``classes=`` — the same
+        # requirement the reference's Partial* wrappers declare,
+        # stochastic_gradient.py:7-15 — or inferred from the first block).
+        y = np.asarray(y)
+        if classes is not None:
+            classes = np.asarray(classes)
+            prior = getattr(self, "_pf_classes", None)
+            if prior is not None and not np.array_equal(classes, prior):
+                raise ValueError(
+                    f"classes={classes!r} changed between partial_fit calls "
+                    f"(was {prior!r})"
+                )
+            self._pf_classes = classes
+        if getattr(self, "_pf_classes", None) is None:
+            self._pf_classes = np.unique(y)
+        if len(self._pf_classes) != 2:
+            raise ValueError(
+                f"LogisticRegression requires exactly 2 classes, got "
+                f"{len(self._pf_classes)}: {self._pf_classes!r}"
+            )
+        self.classes_ = self._pf_classes
+        if not np.isin(y, self._pf_classes).all():
+            raise ValueError("y contains labels outside `classes`")
         return (y == self.classes_[1]).astype(np.float32)
 
     def decision_function(self, X):

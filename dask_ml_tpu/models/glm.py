@@ -522,6 +522,82 @@ def admm(X, y, w, beta0, mask, mesh, *, family="logistic", regularizer="l2",
                       max_iter=int(max_iter), inner_max_iter=int(inner_max_iter))
 
 
+# ---------------------------------------------------------------------------
+# Streaming (incremental) training: one proximal-SGD step per row block
+# ---------------------------------------------------------------------------
+
+
+def make_sgd_step(family="logistic", regularizer="l2", lamduh=0.0,
+                  eta0=0.1, power_t=0.5, fit_intercept=True):
+    """Build the jittable partial_fit step for streaming GLM training.
+
+    Returns ``step(state, (x, y, w)) -> state`` with
+    ``state = (beta, t)``: one proximal-SGD update per block — gradient of
+    the weighted-mean family loss on the block, step size
+    ``eta0 / (1 + t)**power_t``, then the regularizer's prox applied to the
+    penalized coordinates (mask excludes the intercept). The capability this
+    provides is the reference's ``Incremental``/``_partial.fit`` chain over
+    an SGD-style estimator (reference: _partial.py:104-182,
+    linear_model/stochastic_gradient.py:7-15); here the whole chain of
+    blocks fuses into one ``lax.scan`` via
+    :func:`dask_ml_tpu.wrappers.incremental_scan`.
+
+    ``w`` is the per-row weight (0 marks padding in the remainder block, so
+    partial blocks are exact, not dropped). ``beta``'s last coordinate is
+    the intercept when ``fit_intercept`` — blocks arrive WITHOUT the ones
+    column; the step appends it, keeping the caller's block layout identical
+    to the batch solvers' convention.
+    """
+    loss_fn, _ = FAMILIES[family]
+    _, pen_prox = _penalty(regularizer)
+
+    def step(state, blk):
+        beta, t = state
+        x, y, w = blk
+        if fit_intercept:
+            x = jnp.concatenate(
+                [x, jnp.ones((x.shape[0], 1), x.dtype)], axis=1)
+        wsum = jnp.maximum(jnp.sum(w), 1e-12)
+
+        def block_loss(b):
+            return jnp.sum(w * loss_fn(x @ b, y)) / wsum
+
+        g = jax.grad(block_loss)(beta)
+        lr = eta0 / (1.0 + t) ** power_t
+        cand = beta - lr * g
+        prox = pen_prox(cand, lr * lamduh)
+        if fit_intercept:
+            # prox only the penalized coordinates; intercept takes the plain
+            # gradient step (unpenalized, matching the batch solvers' mask)
+            cand = cand.at[:-1].set(prox[:-1])
+        else:
+            cand = prox
+        return (cand, t + 1.0)
+
+    return step
+
+
+# One (step, jitted single-block apply) pair per hyperparameter config:
+# stable identities keep both the single-step jit cache (host-loop
+# partial_fit) and incremental_scan's per-step-fn compiled-scan cache warm
+# across estimator instances and deepcopies.
+_STREAM_CACHE: dict = {}
+
+
+def get_stream_step(family="logistic", regularizer="l2", lamduh=0.0,
+                    eta0=0.1, power_t=0.5, fit_intercept=True):
+    """Cached :func:`make_sgd_step` plus a jitted one-block apply."""
+    key = (family, regularizer, float(lamduh), float(eta0), float(power_t),
+           bool(fit_intercept))
+    if key not in _STREAM_CACHE:
+        step = make_sgd_step(family=family, regularizer=regularizer,
+                             lamduh=lamduh, eta0=eta0, power_t=power_t,
+                             fit_intercept=fit_intercept)
+        apply_one = jax.jit(lambda s, x, y, w: step(s, (x, y, w)))
+        _STREAM_CACHE[key] = (step, apply_one)
+    return _STREAM_CACHE[key]
+
+
 SOLVERS = ("admm", "gradient_descent", "newton", "lbfgs", "proximal_grad")
 
 

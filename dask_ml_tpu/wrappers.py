@@ -208,15 +208,33 @@ class Incremental(ParallelPostFit):
 
     def _fit_for_estimator(self, estimator, X, y, **fit_kwargs):
         check_scoring(estimator, self.scoring)
-        X = _as_rowsliceable(X)
-        y = None if y is None else np.asarray(y)
-        n = X.shape[0]
         start = tic()
-        for i, s in enumerate(_block_slices(n, self.block_size)):
-            yb = None if y is None else y[s]
-            estimator.partial_fit(X[s], yb, **_slice_kwargs(fit_kwargs, s, n))
-            logger.debug("partial_fit block %d (%d rows)", i, X[s].shape[0])
-        logger.info("Finished incremental fit, %0.2f", tic() - start)
+        if _is_jax_native(estimator) and hasattr(estimator,
+                                                 "_incremental_begin"):
+            # jax-native fast path: the whole block chain fuses into ONE
+            # lax.scan program — no per-block host round-trip, and X may
+            # already live on the mesh (no transfer at all).
+            sample_weight = fit_kwargs.pop("sample_weight", None)
+            if not hasattr(X, "shape"):
+                X = np.asarray(X)
+            step, state, y_enc = estimator._incremental_begin(
+                X, y, **fit_kwargs)
+            state = incremental_scan(
+                step, state, X, y_enc, sample_weight=sample_weight,
+                block_size=self.block_size,
+            )
+            estimator._incremental_finalize(state)
+            logger.info("Finished fused incremental fit, %0.2f", tic() - start)
+        else:
+            X = _as_rowsliceable(X)
+            y = None if y is None else np.asarray(y)
+            n = X.shape[0]
+            for i, s in enumerate(_block_slices(n, self.block_size)):
+                yb = None if y is None else y[s]
+                estimator.partial_fit(X[s], yb,
+                                      **_slice_kwargs(fit_kwargs, s, n))
+                logger.debug("partial_fit block %d (%d rows)", i, X[s].shape[0])
+            logger.info("Finished incremental fit, %0.2f", tic() - start)
         copy_learned_attributes(estimator, self)
         self.estimator_ = estimator
         return self
@@ -251,38 +269,55 @@ def fit(model, X, y=None, block_size: int = DEFAULT_BLOCK_SIZE, **kwargs):
     return model
 
 
-def incremental_scan(step_fn, init_state, X, y=None, block_size: int = 1024):
+def incremental_scan(step_fn, init_state, X, y=None, sample_weight=None,
+                     block_size: int = 1024):
     """Fused incremental training for jax-native functional estimators.
 
-    ``step_fn(state, (x_block, y_block)) -> state`` is scanned over
+    ``step_fn(state, (x_block, y_block, w_block)) -> state`` is scanned over
     fixed-size row blocks as ONE compiled XLA program (the carry is updated
     in place on device by XLA) — the TPU-native upgrade of the reference's
     serial task chain (_partial.py:167-177): same sequential semantics, no
     per-block host round-trip, no model serialization between blocks.
 
-    Rows beyond the last full block are dropped (fixed shapes under jit);
-    callers control block_size to bound the remainder.
+    ``w_block`` carries the per-row weight: ``sample_weight`` (default 1) on
+    real rows, 0 on the zero-padding appended to complete the final block —
+    a partial tail block is processed exactly, not dropped (fixed shapes
+    under jit demand the padding; the weights make it inert).
     """
     import jax.numpy as jnp
 
     X = jnp.asarray(X)
-    n_blocks = X.shape[0] // block_size
-    if n_blocks == 0:
-        raise ValueError(
-            f"block_size={block_size} exceeds n_samples={X.shape[0]}"
-        )
-    n_used = n_blocks * block_size
-    Xb = X[:n_used].reshape(n_blocks, block_size, *X.shape[1:])
+    n = X.shape[0]
+    if n == 0:
+        raise ValueError("X has no rows")
+    block_size = min(block_size, n)
+    n_blocks = -(-n // block_size)  # ceil
+    pad = n_blocks * block_size - n
+
+    if sample_weight is None:
+        w = jnp.ones((n,), jnp.float32)
+    else:
+        w = jnp.asarray(sample_weight, jnp.float32)
+        if w.shape != (n,):
+            raise ValueError(
+                f"sample_weight shape {w.shape} != ({n},)")
+    if pad:
+        X = jnp.pad(X, [(0, pad)] + [(0, 0)] * (X.ndim - 1))
+        w = jnp.pad(w, (0, pad))
+    Xb = X.reshape(n_blocks, block_size, *X.shape[1:])
+    wb = w.reshape(n_blocks, block_size)
     if y is not None:
         y = jnp.asarray(y)
+        if pad:
+            y = jnp.pad(y, [(0, pad)] + [(0, 0)] * (y.ndim - 1))
         # Preserve y's trailing dims: step_fn sees exactly the block shapes
         # the caller's y implies ((block_size,) for 1-D, (block_size, k) for
         # multi-output).
-        yb = y[:n_used].reshape(n_blocks, block_size, *y.shape[1:])
+        yb = y.reshape(n_blocks, block_size, *y.shape[1:])
     else:
         yb = jnp.zeros((n_blocks, block_size), X.dtype)
 
-    return _get_scan_run(step_fn)(init_state, Xb, yb)
+    return _get_scan_run(step_fn)(init_state, Xb, yb, wb)
 
 
 # Compiled-scan cache keyed weakly on step_fn: repeated epochs/candidates
@@ -299,11 +334,11 @@ def _get_scan_run(step_fn):
         pass
 
     @jax.jit
-    def run(state, Xb, yb):
+    def run(state, Xb, yb, wb):
         def body(state, blk):
             return step_fn(state, blk), None
 
-        state, _ = jax.lax.scan(body, state, (Xb, yb))
+        state, _ = jax.lax.scan(body, state, (Xb, yb, wb))
         return state
 
     try:

@@ -151,9 +151,9 @@ def test_incremental_scan_matches_host_loop(mesh8):
     y = (X @ np.array([1.0, -2.0, 0.5, 0.0]) > 0).astype(np.float32)
 
     def sgd_step(w, blk):
-        xs, ys = blk
+        xs, ys, wv = blk
         p = 1.0 / (1.0 + jnp.exp(-(xs @ w)))
-        g = xs.T @ (p - ys) / xs.shape[0]
+        g = xs.T @ (wv * (p - ys)) / jnp.maximum(wv.sum(), 1e-12)
         return w - 0.5 * g
 
     w0 = jnp.zeros(4)
@@ -162,11 +162,35 @@ def test_incremental_scan_matches_host_loop(mesh8):
     w_loop = w0
     for i in range(0, 512, 64):
         w_loop = sgd_step(w_loop, (jnp.asarray(X[i:i + 64]),
-                                   jnp.asarray(y[i:i + 64])))
+                                   jnp.asarray(y[i:i + 64]),
+                                   jnp.ones(64)))
     np.testing.assert_allclose(np.asarray(w_scan), np.asarray(w_loop),
                                atol=1e-6)
-    with pytest.raises(ValueError, match="block_size"):
-        wrappers.incremental_scan(sgd_step, w0, X[:10], y[:10], block_size=64)
+
+
+def test_incremental_scan_remainder_masked(mesh8):
+    """A partial tail block is processed exactly via zero weights, not
+    dropped (the r2 advice item on wrappers.py)."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(150, 3).astype(np.float32)  # 150 = 2*64 + 22 remainder
+    y = rng.randn(150).astype(np.float32)
+
+    def step(acc, blk):
+        xs, ys, wv = blk
+        return acc + jnp.sum(wv * ys) + jnp.sum(wv[:, None] * xs)
+
+    total = wrappers.incremental_scan(step, jnp.asarray(0.0), X, y,
+                                      block_size=64)
+    np.testing.assert_allclose(float(total), y.sum() + X.sum(), rtol=1e-5)
+
+    # sample_weight flows through as the real-row weights
+    sw = rng.rand(150).astype(np.float32)
+    total_w = wrappers.incremental_scan(step, jnp.asarray(0.0), X, y,
+                                        sample_weight=sw, block_size=64)
+    np.testing.assert_allclose(
+        float(total_w), (sw * y).sum() + (sw[:, None] * X).sum(), rtol=1e-4)
 
 
 def test_incremental_scan_multioutput_y(mesh8):
@@ -178,13 +202,83 @@ def test_incremental_scan_multioutput_y(mesh8):
     Y = rng.randn(128, 2).astype(np.float32)
 
     def step(W, blk):
-        xs, ys = blk
+        xs, ys, wv = blk
         assert ys.ndim == 2 and ys.shape[1] == 2
-        return W + xs.T @ ys
+        return W + xs.T @ (wv[:, None] * ys)
 
     W = wrappers.incremental_scan(step, jnp.zeros((3, 2)), X, Y,
                                   block_size=32)
     np.testing.assert_allclose(np.asarray(W), X.T @ Y, rtol=1e-4)
+
+
+def test_incremental_native_glm_scan_matches_host_loop(mesh8):
+    """Incremental(native LogisticRegression) routes through the fused scan
+    and matches the host partial_fit loop block-for-block
+    (VERDICT r2 #5; reference capability: _partial.py:104-182)."""
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    rng = np.random.RandomState(0)
+    n = 777  # deliberately not a block multiple → remainder block
+    X = rng.randn(n, 5).astype(np.float32)
+    y = (X @ np.array([1.0, -2.0, 0.5, 0.0, 1.5]) > 0).astype(int)
+
+    inc = Incremental(LogisticRegression(solver="proximal_grad", C=10.0),
+                      block_size=128)
+    inc.fit(X, y, classes=[0, 1])
+    assert hasattr(inc, "coef_")
+
+    # host-loop oracle: same step function driven by repeated partial_fit
+    host = LogisticRegression(solver="proximal_grad", C=10.0)
+    for i in range(0, n, 128):
+        host.partial_fit(X[i:i + 128], y[i:i + 128], classes=[0, 1])
+    np.testing.assert_allclose(inc.coef_, host.coef_, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(inc.intercept_, host.intercept_, rtol=1e-4,
+                               atol=1e-5)
+
+    # streaming training actually learns the separating direction
+    acc = (inc.predict(X) == y).mean()
+    assert acc > 0.9
+
+
+def test_native_glm_partial_fit_resumes(mesh8):
+    """partial_fit accumulates state across calls; classes pinned on the
+    first call are enforced later."""
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    rng = np.random.RandomState(1)
+    X = rng.randn(200, 3).astype(np.float32)
+    y = (X @ np.array([2.0, -1.0, 0.0]) > 0).astype(int)
+
+    m = LogisticRegression(solver="proximal_grad")
+    m.partial_fit(X[:100], y[:100], classes=[0, 1])
+    c1 = m.coef_.copy()
+    m.partial_fit(X[100:], y[100:])
+    assert m.n_iter_ == 2
+    assert not np.allclose(c1, m.coef_)
+    with pytest.raises(ValueError, match="classes"):
+        m.partial_fit(X[:50], y[:50], classes=[0, 2])
+
+
+def test_incremental_native_linear_regression(mesh8):
+    """Normal-family streaming: Incremental(native LinearRegression) learns
+    a linear fit through the scan path."""
+    from dask_ml_tpu.linear_model import LinearRegression
+
+    rng = np.random.RandomState(2)
+    X = rng.randn(1000, 4).astype(np.float32)
+    coef = np.array([1.0, -2.0, 3.0, 0.5])
+    y = X @ coef + 0.01 * rng.randn(1000)
+
+    inc = Incremental(
+        LinearRegression(penalty="l2", C=1e4,
+                         solver_kwargs={"eta0": 0.5, "power_t": 0.25}),
+        block_size=100,
+    )
+    # several epochs of the stream to converge
+    inc.fit(X, y)
+    for _ in range(20):
+        inc.partial_fit(X, y)
+    np.testing.assert_allclose(inc.coef_, coef, atol=0.1)
 
 
 def test_fit_does_not_mutate_input_estimator(Xy):
@@ -231,3 +325,46 @@ def test_slice_kwargs_list_weight_and_ndarray_classes(Xy):
                      block_size=100, classes=np.array([0, 1]),
                      sample_weight=w)
     assert hasattr(m, "coef_")
+
+
+def test_partial_fit_warm_starts_from_batch_fit(mesh8):
+    """partial_fit after fit continues from the batch solution instead of
+    silently resetting to zeros (code-review r3 regression)."""
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    rng = np.random.RandomState(3)
+    X = rng.randn(300, 3).astype(np.float32)
+    y = (X @ np.array([3.0, -1.0, 0.5]) > 0).astype(int)
+    m = LogisticRegression(solver="lbfgs", C=10.0)
+    m.fit(X, y)
+    coef_batch = m.coef_.copy()
+    m.partial_fit(X[:64], y[:64])
+    # one small SGD step moves the solution a little, not back to the origin
+    assert np.linalg.norm(m.coef_ - coef_batch) < 0.5 * np.linalg.norm(coef_batch)
+
+
+def test_fit_partial_fit_same_objective(mesh8):
+    """gradient_descent/newton zero the penalty in fit(); the streaming path
+    must match, or the same estimator optimizes two different problems
+    (code-review r3 regression)."""
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    m = LogisticRegression(solver="newton", C=0.01)
+    cfg = m._sgd_config()
+    assert cfg["lamduh"] == 0.0
+    m2 = LogisticRegression(solver="admm", C=0.01)
+    assert m2._sgd_config()["lamduh"] == 100.0
+
+
+def test_incremental_native_list_input(mesh8):
+    """The fused path coerces non-array inputs like the host path does
+    (code-review r3 regression)."""
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    rng = np.random.RandomState(4)
+    X = rng.randn(100, 3).astype(np.float32)
+    y = (X[:, 0] > 0).astype(int)
+    inc = Incremental(LogisticRegression(solver="proximal_grad"),
+                      block_size=32)
+    inc.fit(X.tolist(), y.tolist(), classes=[0, 1])
+    assert hasattr(inc, "coef_")
