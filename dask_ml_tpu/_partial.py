@@ -1,0 +1,96 @@
+"""Deprecated ``Partial*`` subclass wrappers.
+
+The reference ships a family of deprecated estimators — sklearn classes
+subclassed with ``_BigPartialFitMixin`` so ``fit`` feeds data blocks to
+``partial_fit`` sequentially (reference: _partial.py:40-101 the mixin,
+cluster/minibatch.py:9-11, linear_model/stochastic_gradient.py:7-15,
+perceptron.py:7-9, passive_aggressive.py:7-15, neural_network.py:7-13,
+naive_bayes.py:123-132 the concrete wrappers). They predate ``Incremental``,
+which supersedes them (reference deprecation notes point there); we keep them
+for drop-in parity, with the same FutureWarning.
+
+The rebuild's mixin drives :func:`dask_ml_tpu.wrappers.fit` (the sequential
+block loop) instead of building a dask task chain; semantics are identical:
+``classes``-style kwargs are accepted at construction and forwarded to every
+``partial_fit`` call (reference: _partial.py:59-76).
+"""
+
+from __future__ import annotations
+
+import warnings
+
+from sklearn.base import BaseEstimator
+
+from dask_ml_tpu import wrappers
+
+
+class _BigPartialFitMixin(BaseEstimator):
+    """Wrapper for estimators with ``partial_fit``
+    (reference: _partial.py:40-101)."""
+
+    _init_kwargs: list = []  # accepted at __init__, forwarded to partial_fit
+    _fit_kwargs: list = []   # accepted at fit, forwarded to partial_fit
+
+    def __init__(self, **kwargs):
+        missing = set(self._init_kwargs) - set(kwargs)
+        if missing:
+            raise TypeError(
+                f"{type(self).__name__} requires the keyword arguments "
+                f"{sorted(missing)} at construction (forwarded to each "
+                f"partial_fit call)"
+            )
+        for kwarg in self._init_kwargs:
+            setattr(self, kwarg, kwargs.pop(kwarg))
+        warnings.warn(
+            f"'{type(self).__name__}' is deprecated, use "
+            f"'dask_ml_tpu.wrappers.Incremental({self._base_name()}(...))' "
+            "instead",
+            FutureWarning,
+        )
+        super().__init__(**kwargs)
+
+    @classmethod
+    def _base_name(cls) -> str:
+        for base in cls.__mro__:
+            if (
+                not issubclass(base, _BigPartialFitMixin)
+                and issubclass(base, BaseEstimator)
+                and base is not BaseEstimator
+            ):
+                return base.__name__
+        return "Estimator"  # pragma: no cover
+
+    @classmethod
+    def _get_param_names(cls):
+        """Underlying estimator's params + the extra init kwargs — the same
+        MRO walk the reference performs (reference: _partial.py:84-96)."""
+        bases = [
+            base for base in cls.__mro__
+            if not issubclass(base, _BigPartialFitMixin)
+            and hasattr(base, "_get_param_names")
+        ]
+        params = set(cls._init_kwargs)
+        for base in bases:
+            params |= set(base._get_param_names())
+        return sorted(params)
+
+    def fit(self, X, y=None, block_size: int = wrappers.DEFAULT_BLOCK_SIZE):
+        kwargs = {k: getattr(self, k) for k in self._init_kwargs}
+        for k in self._fit_kwargs:
+            if hasattr(self, k):
+                kwargs[k] = getattr(self, k)
+        wrappers.fit(self, X, y, block_size=block_size, **kwargs)
+        return self
+
+
+def _copy_partial_doc(cls):
+    """Prefix the wrapped estimator's docstring with the deprecation banner
+    (reference: _partial.py:208-230)."""
+    base = cls.__mro__[2] if len(cls.__mro__) > 2 else cls
+    cls.__doc__ = (
+        f"Deprecated blockwise ``fit``-via-``partial_fit`` wrapper around "
+        f"``{base.__module__}.{base.__name__}``; use "
+        f"``dask_ml_tpu.wrappers.Incremental`` instead.\n\n"
+        + (base.__doc__ or "")
+    )
+    return cls

@@ -1,6 +1,7 @@
 """Clustering estimators (reference: dask_ml/cluster/__init__.py)."""
 
 from dask_ml_tpu.cluster.k_means import KMeans  # noqa: F401
+from dask_ml_tpu.cluster.minibatch import PartialMiniBatchKMeans  # noqa: F401
 from dask_ml_tpu.cluster.spectral import SpectralClustering  # noqa: F401
 
-__all__ = ["KMeans", "SpectralClustering"]
+__all__ = ["KMeans", "SpectralClustering", "PartialMiniBatchKMeans"]

@@ -1,0 +1,13 @@
+"""Deprecated PartialMiniBatchKMeans wrapper
+(reference: cluster/minibatch.py:9-11)."""
+
+from __future__ import annotations
+
+from sklearn.cluster import MiniBatchKMeans as _MiniBatchKMeans
+
+from dask_ml_tpu._partial import _BigPartialFitMixin, _copy_partial_doc
+
+
+@_copy_partial_doc
+class PartialMiniBatchKMeans(_BigPartialFitMixin, _MiniBatchKMeans):
+    pass

@@ -1,0 +1,75 @@
+"""Ecosystem hand-off: export sharded device data and fitted models to host.
+
+The reference's ecosystem bridges are one-line re-exports of external
+runtimes colocated with the dask cluster (reference: xgboost.py:1-7
+``dask-xgboost``'s rabit trainer, tensorflow.py:1-5
+``dask-tensorflow``'s cluster bootstrap, joblib.py:1 the distributed joblib
+backend). Those runtimes are out of scope for a TPU framework — capability
+parity per SURVEY §2.9 (last row) is a *clean export of sharded arrays to
+host NumPy plus an interop shim*, which is this module:
+
+- :func:`to_numpy` — any ``jax.Array`` (sharded or not) or
+  :class:`~dask_ml_tpu.parallel.sharding.DeviceData` → host ndarray, with
+  padding rows dropped. This is the input side of an XGBoost/TF/torch
+  hand-off: train the tree/neural model on the exported features.
+- :func:`to_torch` — zero-copy(ish) bridge to a CPU torch tensor via
+  dlpack when torch is importable.
+- :func:`export_learned_attrs` — fitted-estimator learned state
+  (trailing-underscore attributes) as a plain ``{name: ndarray}`` dict, the
+  serialization-friendly form for serving stacks.
+
+The thin ``dask_ml_tpu.xgboost`` / ``dask_ml_tpu.tensorflow`` /
+``dask_ml_tpu.joblib`` modules re-export these under the reference's module
+names and document the per-ecosystem recipe.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["to_numpy", "to_torch", "export_learned_attrs"]
+
+
+def to_numpy(x, n_valid=None):
+    """Gather a (possibly sharded, possibly padded) array to host NumPy.
+
+    Accepts a ``jax.Array``, ndarray, or a ``DeviceData`` (in which case the
+    padding rows are dropped automatically; for raw arrays pass ``n_valid``
+    to drop them explicitly)."""
+    from dask_ml_tpu.parallel.sharding import DeviceData
+
+    if isinstance(x, DeviceData):
+        return np.asarray(x.X)[: x.n]
+    out = np.asarray(x)
+    if n_valid is not None:
+        out = out[:n_valid]
+    return out
+
+
+def to_torch(x, n_valid=None):
+    """Export to a CPU torch tensor (the torch side of an XGBoost/TF-style
+    hand-off). Imports torch lazily; raises ImportError with the recipe when
+    unavailable."""
+    try:
+        import torch
+    except ImportError as e:  # pragma: no cover
+        raise ImportError(
+            "to_torch requires torch; install it or use to_numpy() and "
+            "torch.from_numpy() on your side"
+        ) from e
+    # copy: jax gives read-only buffers, torch wants writable memory
+    return torch.from_numpy(np.array(to_numpy(x, n_valid), copy=True))
+
+
+def export_learned_attrs(estimator) -> dict:
+    """Fitted state (``*_`` attributes) as plain host arrays — the hand-off
+    form for foreign serving/training stacks (the same attribute set
+    ``copy_learned_attributes`` propagates, reference: _utils.py:1-5)."""
+    out = {}
+    for name, value in vars(estimator).items():
+        if name.endswith("_") and not name.startswith("_"):
+            try:
+                out[name] = np.asarray(value)
+            except Exception:
+                out[name] = value
+    return out

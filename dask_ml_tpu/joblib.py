@@ -1,0 +1,15 @@
+"""joblib interop (reference: joblib.py:1 registers the distributed joblib
+backend as an import side-effect).
+
+No backend registration is needed here: this framework's estimators hold
+their learned state as plain host ndarrays after fit, so they pickle with
+stock joblib, and sklearn's ``n_jobs``-threaded code can call them directly —
+predictions release the GIL during device execution. This module exists for
+import parity and documents the equivalence::
+
+    import joblib
+    joblib.dump(fitted_estimator, "model.joblib")   # just works
+    est = joblib.load("model.joblib")
+"""
+
+from dask_ml_tpu.interop import export_learned_attrs, to_numpy  # noqa: F401

@@ -6,5 +6,23 @@ from dask_ml_tpu.linear_model.glm import (  # noqa: F401
     LogisticRegression,
     PoissonRegression,
 )
+from dask_ml_tpu.linear_model.passive_aggressive import (  # noqa: F401
+    PartialPassiveAggressiveClassifier,
+    PartialPassiveAggressiveRegressor,
+)
+from dask_ml_tpu.linear_model.perceptron import PartialPerceptron  # noqa: F401
+from dask_ml_tpu.linear_model.stochastic_gradient import (  # noqa: F401
+    PartialSGDClassifier,
+    PartialSGDRegressor,
+)
 
-__all__ = ["LogisticRegression", "LinearRegression", "PoissonRegression"]
+__all__ = [
+    "LogisticRegression",
+    "LinearRegression",
+    "PoissonRegression",
+    "PartialSGDClassifier",
+    "PartialSGDRegressor",
+    "PartialPerceptron",
+    "PartialPassiveAggressiveClassifier",
+    "PartialPassiveAggressiveRegressor",
+]

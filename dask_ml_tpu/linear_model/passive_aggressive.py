@@ -1,0 +1,24 @@
+"""Deprecated Partial PassiveAggressive wrappers
+(reference: passive_aggressive.py:7-15)."""
+
+from __future__ import annotations
+
+from sklearn.linear_model import (
+    PassiveAggressiveClassifier as _PAClassifier,
+)
+from sklearn.linear_model import (
+    PassiveAggressiveRegressor as _PARegressor,
+)
+
+from dask_ml_tpu._partial import _BigPartialFitMixin, _copy_partial_doc
+
+
+@_copy_partial_doc
+class PartialPassiveAggressiveClassifier(_BigPartialFitMixin, _PAClassifier):
+    _init_kwargs = ["classes"]
+    _fit_kwargs = []
+
+
+@_copy_partial_doc
+class PartialPassiveAggressiveRegressor(_BigPartialFitMixin, _PARegressor):
+    pass

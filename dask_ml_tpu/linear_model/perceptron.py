@@ -1,0 +1,13 @@
+"""Deprecated Partial Perceptron wrapper (reference: perceptron.py:7-9)."""
+
+from __future__ import annotations
+
+from sklearn.linear_model import Perceptron as _Perceptron
+
+from dask_ml_tpu._partial import _BigPartialFitMixin, _copy_partial_doc
+
+
+@_copy_partial_doc
+class PartialPerceptron(_BigPartialFitMixin, _Perceptron):
+    _init_kwargs = ["classes"]
+    _fit_kwargs = []

@@ -1,0 +1,20 @@
+"""Deprecated Partial SGD wrappers
+(reference: linear_model/stochastic_gradient.py:7-15)."""
+
+from __future__ import annotations
+
+from sklearn.linear_model import SGDClassifier as _SGDClassifier
+from sklearn.linear_model import SGDRegressor as _SGDRegressor
+
+from dask_ml_tpu._partial import _BigPartialFitMixin, _copy_partial_doc
+
+
+@_copy_partial_doc
+class PartialSGDClassifier(_BigPartialFitMixin, _SGDClassifier):
+    _init_kwargs = ["classes"]
+    _fit_kwargs = []
+
+
+@_copy_partial_doc
+class PartialSGDRegressor(_BigPartialFitMixin, _SGDRegressor):
+    pass

@@ -23,21 +23,36 @@ from sklearn.base import BaseEstimator, ClassifierMixin
 from dask_ml_tpu.parallel.sharding import prepare_data, shard_rows, unpad_rows
 from dask_ml_tpu.utils.validation import check_array
 
-__all__ = ["GaussianNB"]
+__all__ = ["GaussianNB", "PartialMultinomialNB", "PartialBernoulliNB"]
 
 
 @jax.jit
-def _class_moments(X, onehot):
-    """Weighted per-class counts, means, variances in one pass.
+def _global_mean(X, w):
+    """Weighted per-feature mean — the cheap shift point for the stabilized
+    class moments."""
+    return (w[:, None] * X).sum(axis=0) / jnp.maximum(w.sum(), 1e-12)
+
+
+@jax.jit
+def _class_moments(X, onehot, mu):
+    """Weighted per-class counts, means, variances via SHIFTED moments.
 
     ``onehot`` is (n, K) row-class membership scaled by sample weight; the
-    two matmuls contract the sharded axis (→ psum over ICI)."""
+    two matmuls contract the sharded axis (→ psum over ICI). Moments are
+    taken about the global per-feature mean ``mu`` (shifted two-pass
+    variance): computing ``E[x²]−θ²`` directly in f32 catastrophically
+    cancels when ``|mean| ≫ std`` (e.g. mean ~1e4, std ~1 → variance 0 →
+    inf/NaN likelihoods); about ``mu`` the magnitudes are O(std²) and the
+    subtraction is benign. The reference/sklearn get the same protection
+    from two-pass f64 computation."""
+    Xc = X - mu[None, :]
     counts = onehot.sum(axis=0)  # (K,)
     safe = jnp.maximum(counts, 1e-12)
-    theta = (onehot.T @ X) / safe[:, None]  # (K, d)
-    ex2 = (onehot.T @ (X * X)) / safe[:, None]
-    var = jnp.maximum(ex2 - theta**2, 0.0)
-    return counts, theta, var
+    m1 = (onehot.T @ Xc) / safe[:, None]  # (K, d): E_k[x-mu]
+    ex2 = (onehot.T @ (Xc * Xc)) / safe[:, None]
+    var = jnp.maximum(ex2 - m1**2, 0.0)
+    theta = mu[None, :] + m1
+    return counts, theta, var, m1
 
 
 @jax.jit
@@ -82,22 +97,28 @@ class GaussianNB(BaseEstimator, ClassifierMixin):
                             y_dtype=jnp.int32)
         onehot = jax.nn.one_hot(data.y, len(classes), dtype=data.X.dtype)
         onehot = onehot * data.weights[:, None]
-        counts_d, theta_d, var_d = _class_moments(data.X, onehot)
+        mu = _global_mean(data.X, data.weights)
+        counts_d, theta_d, var_d, m1_d = _class_moments(data.X, onehot, mu)
 
         counts = np.asarray(counts_d, dtype=np.float64)
         theta = np.asarray(theta_d, dtype=np.float64)
         var = np.asarray(var_d, dtype=np.float64)
+        m1 = np.asarray(m1_d, dtype=np.float64)
         # sklearn's numerical floor: var_smoothing × the largest TOTAL-data
         # feature variance (not per-class — per-class can be 0 on perfectly
         # separable data while the pooled variance is not). Pooled moments
-        # come from the per-class ones by the law of total variance — tiny
-        # (K, d) host math, no extra data pass.
+        # come from the per-class SHIFTED ones by the law of total variance —
+        # tiny (K, d) host math, no extra data pass, and stable because all
+        # terms are O(std²) about the global mean.
         total_w = counts.sum()
-        total_mean = (counts[:, None] * theta).sum(0) / total_w
-        total_ex2 = (counts[:, None] * (var + theta**2)).sum(0) / total_w
-        total_var = np.maximum(total_ex2 - total_mean**2, 0.0)
-        self.epsilon_ = float(self.var_smoothing * total_var.max()) \
+        total_m1 = (counts[:, None] * m1).sum(0) / total_w  # ≈ 0 by shift
+        total_e2 = (counts[:, None] * (var + m1**2)).sum(0) / total_w
+        total_var = np.maximum(total_e2 - total_m1**2, 0.0)
+        eps = float(self.var_smoothing * total_var.max()) \
             if total_var.size else 0.0
+        # absolute floor so a fully-degenerate dataset (all features constant)
+        # still yields finite likelihoods instead of dividing by exact zero
+        self.epsilon_ = max(eps, float(np.finfo(np.float32).tiny))
         var += self.epsilon_
 
         self.class_count_ = counts
@@ -140,3 +161,26 @@ class GaussianNB(BaseEstimator, ClassifierMixin):
         from dask_ml_tpu.metrics import accuracy_score
 
         return accuracy_score(np.asarray(y), self.predict(X))
+
+
+# -- deprecated Partial* NB wrappers (reference: naive_bayes.py:123-132) -----
+
+from sklearn.naive_bayes import BernoulliNB as _BernoulliNB  # noqa: E402
+from sklearn.naive_bayes import MultinomialNB as _MultinomialNB  # noqa: E402
+
+from dask_ml_tpu._partial import (  # noqa: E402
+    _BigPartialFitMixin,
+    _copy_partial_doc,
+)
+
+
+@_copy_partial_doc
+class PartialMultinomialNB(_BigPartialFitMixin, _MultinomialNB):
+    _init_kwargs = ["classes"]
+    _fit_kwargs = []
+
+
+@_copy_partial_doc
+class PartialBernoulliNB(_BigPartialFitMixin, _BernoulliNB):
+    _init_kwargs = ["classes"]
+    _fit_kwargs = []

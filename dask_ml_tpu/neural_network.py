@@ -1,0 +1,26 @@
+"""Deprecated Partial MLP wrappers (reference: neural_network.py:7-13;
+the reference's class names carry a ``Parital`` typo — we export the
+corrected names and alias the typo'd ones for drop-in parity)."""
+
+from __future__ import annotations
+
+from sklearn.neural_network import MLPClassifier as _MLPClassifier
+from sklearn.neural_network import MLPRegressor as _MLPRegressor
+
+from dask_ml_tpu._partial import _BigPartialFitMixin, _copy_partial_doc
+
+
+@_copy_partial_doc
+class PartialMLPClassifier(_BigPartialFitMixin, _MLPClassifier):
+    _init_kwargs = ["classes"]
+    _fit_kwargs = []
+
+
+@_copy_partial_doc
+class PartialMLPRegressor(_BigPartialFitMixin, _MLPRegressor):
+    pass
+
+
+# reference-spelling aliases (neural_network.py:7,11)
+ParitalMLPClassifier = PartialMLPClassifier
+ParitalMLPRegressor = PartialMLPRegressor

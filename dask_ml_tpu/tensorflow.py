@@ -1,0 +1,13 @@
+"""TensorFlow hand-off (reference: tensorflow.py:1-5 re-exports
+``dask-tensorflow``'s cluster bootstrap).
+
+The reference spins a TF cluster on dask workers. Here the hand-off is data
+export: host arrays feed ``tf.data`` directly, and fitted-model state
+transfers as plain ndarrays::
+
+    from dask_ml_tpu.tensorflow import to_numpy, export_learned_attrs
+    ds = tf.data.Dataset.from_tensor_slices((to_numpy(Xd), to_numpy(yd)))
+    weights = export_learned_attrs(fitted_estimator)
+"""
+
+from dask_ml_tpu.interop import export_learned_attrs, to_numpy  # noqa: F401

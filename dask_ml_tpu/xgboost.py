@@ -1,0 +1,16 @@
+"""XGBoost hand-off (reference: xgboost.py:1-7 re-exports ``dask-xgboost``).
+
+The reference trains distributed XGBoost on the dask cluster's workers via
+rabit. A TPU mesh is not an XGBoost runtime, so the parity surface is the
+hand-off: export the (possibly TPU-resident, sharded) features to host and
+feed xgboost's own trainer::
+
+    from dask_ml_tpu.xgboost import to_numpy
+    import xgboost as xgb
+    dtrain = xgb.DMatrix(to_numpy(Xd), label=to_numpy(yd))
+    booster = xgb.train(params, dtrain)
+
+``to_numpy`` drops the mesh-padding rows, so labels stay aligned.
+"""
+
+from dask_ml_tpu.interop import export_learned_attrs, to_numpy  # noqa: F401

@@ -92,3 +92,30 @@ def test_unsorted_classes_param(Xy, mesh8):
     np.testing.assert_array_equal(nb.classes_, [2, 0, 1])
     sk = SKGaussianNB().fit(X, y)
     np.testing.assert_array_equal(nb.predict(X), sk.predict(X))
+
+
+def test_large_mean_variance_stability(any_mesh):
+    """Shifted two-pass class moments: |mean| >> std must not cancel the
+    variance to zero in f32 (single-pass E[x²]−θ² would)."""
+    rng = np.random.RandomState(0)
+    n = 400
+    X = rng.randn(n, 3).astype(np.float32)
+    X[:, 0] += 1e4  # catastrophic for single-pass f32 moments
+    X[:, 1] += 3e3
+    y = (rng.rand(n) > 0.5).astype(int)
+    a = GaussianNB().fit(X, y)
+    b = SKGaussianNB().fit(X, y)
+    np.testing.assert_allclose(a.var_, b.var_, rtol=5e-2, atol=1e-3)
+    assert np.isfinite(a.predict_log_proba(X)).all()
+    assert (a.predict(X) == b.predict(X)).mean() > 0.95
+    assert a.epsilon_ > 0
+
+
+def test_all_constant_features_finite(any_mesh):
+    """Fully degenerate data: zero variance everywhere still yields finite
+    likelihoods (absolute epsilon floor)."""
+    X = np.full((40, 2), 7.0, dtype=np.float32)
+    y = np.r_[np.zeros(20), np.ones(20)].astype(int)
+    m = GaussianNB().fit(X, y)
+    assert m.epsilon_ > 0
+    assert np.isfinite(m._jll(X)).all()

@@ -1,0 +1,142 @@
+"""Deprecated Partial* wrappers + ecosystem interop shims
+(reference: _partial.py:40-101, stochastic_gradient.py, minibatch.py,
+neural_network.py, naive_bayes.py:123-132; xgboost.py / tensorflow.py /
+joblib.py bridges)."""
+
+import numpy as np
+import pytest
+from sklearn.base import clone
+from sklearn.linear_model import SGDClassifier
+
+from dask_ml_tpu import wrappers
+from dask_ml_tpu.cluster import PartialMiniBatchKMeans
+from dask_ml_tpu.interop import export_learned_attrs, to_numpy, to_torch
+from dask_ml_tpu.linear_model import (
+    PartialPassiveAggressiveClassifier,
+    PartialPerceptron,
+    PartialSGDClassifier,
+    PartialSGDRegressor,
+)
+from dask_ml_tpu.naive_bayes import PartialBernoulliNB, PartialMultinomialNB
+from dask_ml_tpu.neural_network import (
+    ParitalMLPClassifier,
+    PartialMLPClassifier,
+)
+
+
+@pytest.fixture
+def Xy(rng):
+    X = rng.randn(500, 5).astype(np.float64)
+    y = (X @ np.array([1.0, -2.0, 0.5, 0.0, 1.0]) > 0).astype(int)
+    return X, y
+
+
+def test_partial_sgd_matches_manual_chain(Xy):
+    """fit == the manual partial_fit block loop
+    (reference: tests/linear_model/test_stochastic_gradient.py)."""
+    X, y = Xy
+    with pytest.warns(FutureWarning, match="Incremental"):
+        m = PartialSGDClassifier(classes=[0, 1], random_state=0, tol=1e-3)
+    m.fit(X, y, block_size=100)
+    manual = SGDClassifier(random_state=0, tol=1e-3)
+    for i in range(0, 500, 100):
+        manual.partial_fit(X[i:i + 100], y[i:i + 100], classes=[0, 1])
+    np.testing.assert_allclose(m.coef_, manual.coef_)
+
+
+def test_partial_requires_declared_kwargs():
+    with pytest.raises(TypeError, match="classes"):
+        PartialSGDClassifier()
+
+
+def test_partial_get_params_round_trip(Xy):
+    """get_params includes both sklearn params and the extra init kwargs, so
+    clone() works (the reference's MRO hack, _partial.py:84-96)."""
+    with pytest.warns(FutureWarning):
+        m = PartialSGDClassifier(classes=[0, 1], alpha=0.01)
+    params = m.get_params()
+    assert params["classes"] == [0, 1]
+    assert params["alpha"] == 0.01
+    with pytest.warns(FutureWarning):
+        m2 = clone(m)
+    assert m2.get_params()["alpha"] == 0.01
+
+
+@pytest.mark.parametrize("cls,needs_classes", [
+    (PartialPerceptron, True),
+    (PartialPassiveAggressiveClassifier, True),
+    (PartialMultinomialNB, True),
+    (PartialBernoulliNB, True),
+    (PartialSGDRegressor, False),
+    (PartialMiniBatchKMeans, False),
+])
+def test_partial_wrappers_fit(cls, needs_classes, Xy):
+    X, y = Xy
+    X = np.abs(X)  # MultinomialNB needs nonnegative features
+    kwargs = {"classes": [0, 1]} if needs_classes else {}
+    with pytest.warns(FutureWarning):
+        m = cls(**kwargs)
+    m.fit(X, y, block_size=200)
+    pred = m.predict(X[:10])
+    assert pred.shape == (10,)
+
+
+def test_partial_mlp_alias():
+    assert ParitalMLPClassifier is PartialMLPClassifier
+
+
+def test_to_numpy_device_data(mesh8, rng):
+    from dask_ml_tpu.parallel.sharding import prepare_data
+
+    X = rng.randn(37, 4).astype(np.float32)  # 37 → padding on mesh8
+    data = prepare_data(X)
+    out = to_numpy(data)
+    assert out.shape == (37, 4)
+    np.testing.assert_allclose(out, X, rtol=1e-6)
+    # raw array + n_valid
+    out2 = to_numpy(data.X, n_valid=37)
+    np.testing.assert_allclose(out2, X, rtol=1e-6)
+
+
+def test_to_torch(mesh8, rng):
+    torch = pytest.importorskip("torch")
+    from dask_ml_tpu.parallel.sharding import prepare_data
+
+    X = rng.randn(10, 3).astype(np.float32)
+    t = to_torch(prepare_data(X))
+    assert isinstance(t, torch.Tensor)
+    np.testing.assert_allclose(t.numpy(), X, rtol=1e-6)
+
+
+def test_export_learned_attrs(mesh8, rng):
+    from dask_ml_tpu.cluster import KMeans
+
+    X = rng.randn(100, 4).astype(np.float32)
+    km = KMeans(n_clusters=3, init="random", random_state=0, max_iter=5).fit(X)
+    attrs = export_learned_attrs(km)
+    assert "cluster_centers_" in attrs and "labels_" in attrs
+    assert isinstance(attrs["cluster_centers_"], np.ndarray)
+
+
+def test_bridge_modules_import():
+    import dask_ml_tpu.joblib as jb
+    import dask_ml_tpu.tensorflow as tf_mod
+    import dask_ml_tpu.xgboost as xgb_mod
+
+    for mod in (jb, tf_mod, xgb_mod):
+        assert mod.to_numpy is to_numpy
+
+
+def test_joblib_round_trip(tmp_path, mesh8, rng):
+    """Stock joblib dump/load works on a fitted native estimator — the
+    documented equivalence in dask_ml_tpu.joblib."""
+    joblib = pytest.importorskip("joblib")
+    from dask_ml_tpu.cluster import KMeans
+
+    X = rng.randn(80, 3).astype(np.float32)
+    km = KMeans(n_clusters=2, init="random", random_state=0, max_iter=5).fit(X)
+    path = tmp_path / "m.joblib"
+    joblib.dump(km, path)
+    km2 = joblib.load(path)
+    np.testing.assert_allclose(km2.cluster_centers_, km.cluster_centers_)
+    np.testing.assert_array_equal(km2.predict(X), km.predict(X))
