@@ -116,12 +116,12 @@ class PCA(BaseEstimator, TransformerMixin):
         Xc = _center_and_mask(data.X, data.weights, mean)
 
         if solver in ("full", "tsqr"):
-            U, S, Vt = linalg.tsvd(Xc, mesh=mesh)
+            U, S, Vt = linalg.tsvd(Xc, mesh=mesh, weights=data.weights)
         else:
             key = check_random_state(self.random_state)
             U, S, Vt = linalg.svd_compressed(
                 Xc, n_components, n_power_iter=int(self.iterated_power),
-                key=key, mesh=mesh,
+                key=key, mesh=mesh, weights=data.weights,
             )
         U, Vt = linalg.svd_flip(U, Vt)
 

@@ -53,12 +53,13 @@ class TruncatedSVD(BaseEstimator, TransformerMixin):
         mesh = mesh_lib.default_mesh()
         data = prepare_data(X, mesh=mesh)
         if self.algorithm == "tsqr":
-            u, s, v = linalg.tsvd(data.X, mesh=mesh)
+            u, s, v = linalg.tsvd(data.X, mesh=mesh, weights=data.weights)
             u, s, v = u[:, :k], s[:k], v[:k]
         else:
             key = check_random_state(self.random_state)
             u, s, v = linalg.svd_compressed(
-                data.X, k, n_power_iter=int(self.n_iter), key=key, mesh=mesh)
+                data.X, k, n_power_iter=int(self.n_iter), key=key, mesh=mesh,
+                weights=data.weights)
         u, v = linalg.svd_flip(u, v)
 
         X_transformed = u * s

@@ -77,13 +77,25 @@ def _tsqr_impl(X, *, mesh):
     return run(X)
 
 
-def tsqr(X, mesh: Optional[jax.sharding.Mesh] = None):
+@jax.jit
+def _mask_padding_rows(X, weights):
+    """Zero out padding rows (weight 0). The factorizations below are only
+    correct when padding rows are exact zeros; passing ``weights`` makes that
+    an enforced property instead of a caller convention (a centered-but-
+    unmasked array would otherwise silently produce wrong factors)."""
+    return X * (weights > 0).astype(X.dtype)[:, None]
+
+
+def tsqr(X, mesh: Optional[jax.sharding.Mesh] = None, weights=None):
     """Thin QR of a row-sharded tall-skinny array.
 
     Returns ``(Q, R)`` with Q sharded like X (``P('data', None)``) and R
     replicated. Requires the feature axis unsharded — the same single-block
-    constraint the reference enforces (reference: utils.py:120-125)."""
+    constraint the reference enforces (reference: utils.py:120-125).
+    ``weights`` (optional row weights) masks padding rows to exact zeros."""
     mesh = mesh or mesh_lib.default_mesh()
+    if weights is not None:
+        X = _mask_padding_rows(X, weights)
     return _tsqr_impl(X, mesh=mesh)
 
 
@@ -96,11 +108,13 @@ def _tsvd_impl(X, *, mesh):
     return Q @ Ur, S, Vt
 
 
-def tsvd(X, mesh: Optional[jax.sharding.Mesh] = None):
+def tsvd(X, mesh: Optional[jax.sharding.Mesh] = None, weights=None):
     """Thin SVD via tsqr (the ``da.linalg.svd`` analogue, used by the
     reference at pca.py:233, truncated_svd.py:164). U sharded, S/Vt
-    replicated."""
+    replicated. ``weights`` masks padding rows to exact zeros."""
     mesh = mesh or mesh_lib.default_mesh()
+    if weights is not None:
+        X = _mask_padding_rows(X, weights)
     return _tsvd_impl(X, mesh=mesh)
 
 
@@ -126,13 +140,17 @@ def _svd_compressed_impl(X, key, *, mesh, k, n_power_iter, n_oversamples):
 
 def svd_compressed(X, k: int, n_power_iter: int = 0, key=None,
                    n_oversamples: int = 10,
-                   mesh: Optional[jax.sharding.Mesh] = None):
+                   mesh: Optional[jax.sharding.Mesh] = None, weights=None):
     """Randomized truncated SVD (Halko et al. 2009) — the
     ``da.linalg.svd_compressed`` analogue (used by the reference at
-    pca.py:236-241)."""
+    pca.py:236-241). ``weights`` masks padding rows to exact zeros (the
+    ``Xᵀ·Q`` / ``Qᵀ·X`` contractions would otherwise pick up whatever the
+    caller left in the padding rows)."""
     mesh = mesh or mesh_lib.default_mesh()
     if key is None:
         key = jax.random.key(0)
+    if weights is not None:
+        X = _mask_padding_rows(X, weights)
     return _svd_compressed_impl(X, key, mesh=mesh, k=int(k),
                                 n_power_iter=int(n_power_iter),
                                 n_oversamples=int(n_oversamples))

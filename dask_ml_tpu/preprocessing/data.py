@@ -153,6 +153,9 @@ class MinMaxScaler(skdata.MinMaxScaler):
         Xs, n = shard_rows(X)
         out = Xs * jnp.asarray(self.scale_, Xs.dtype) + jnp.asarray(
             self.min_, Xs.dtype)
+        if getattr(self, "clip", False):
+            lo, hi = self.feature_range
+            out = jnp.clip(out, lo, hi)
         return np.asarray(unpad_rows(out, n))
 
     def inverse_transform(self, X, y=None, copy=None):

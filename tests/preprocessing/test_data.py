@@ -210,3 +210,23 @@ def test_encoders_array_input_type_error(df):
     for enc in [DummyEncoder().fit(cat), OrdinalEncoder().fit(cat)]:
         with pytest.raises(TypeError, match="Unexpected type"):
             enc.transform(np.asarray(cat))
+
+
+def test_min_max_scaler_clip(X, mesh8):
+    """clip=True bounds transform output to feature_range, as sklearn does."""
+    a = MinMaxScaler(clip=True).fit(X)
+    b = skdata.MinMaxScaler(clip=True).fit(X)
+    X_out = X.copy()
+    X_out[0, 0] = 100.0  # out of the fitted range
+    X_out[1, 1] = -50.0
+    ours = a.transform(X_out)
+    theirs = b.transform(X_out)
+    np.testing.assert_allclose(ours, theirs, rtol=1e-5, atol=1e-6)
+    assert ours.min() >= 0.0 and ours.max() <= 1.0
+
+
+def test_min_max_scaler_no_clip_default(X, mesh8):
+    a = MinMaxScaler().fit(X)
+    X_out = X.copy()
+    X_out[0, 0] = 100.0
+    assert a.transform(X_out).max() > 1.0
