@@ -1,116 +1,405 @@
-"""Headline benchmark: KMeans Lloyd-iteration throughput (samples/sec/chip).
+"""Benchmarks for the five BASELINE.md configs. Prints one JSON line each,
+flagship (KMeans Lloyd throughput) first.
 
-Mirrors the reference's flagship benchmark workload — KMeans on a large blob
-dataset (reference: benchmarks/k_means_kdd.py runs k=8 over ~4.9M×41;
-BASELINE.md config #1 is make_blobs 1e6×50, k=8). We time the fused
-single-program Lloyd loop (assign + M-step in one pass over X, bf16 inputs /
-f32 accumulation) and compare against scikit-learn's Lloyd on the host CPU
-(the reference's own qualitative baseline is "2-3x over scikit-learn",
-cluster/k_means.py:117-121; BASELINE.md's stated bar — 8×A100 CuPy — is not
-runnable in this environment, so vs_baseline remains the sklearn ratio and
-the absolute bytes/s figure below is the honest hardware-utilization
-signal).
+Methodology notes (all discovered by measurement on this environment):
 
-Efficiency accounting: the fused loop reads X exactly once per iteration, so
-the minimum HBM traffic is n·d·sizeof(dtype) bytes/iteration.
-``effective_gbps`` = that traffic divided by measured time; a v5e chip peaks
-at ~819 GB/s HBM bandwidth, so effective_gbps/819 approximates the roofline
-fraction for this bandwidth-bound kernel (k=8 is far too small to be
-MXU-bound).
+- The TPU is reached through a tunnel: ``jax.block_until_ready`` does NOT
+  block here, a value fetch costs ~60-120 ms round-trip (RTT), and
+  host<->device transfers run at only ~10 MB/s. Timing is therefore done by
+  (a) generating/staging all data ON DEVICE outside timed regions (the
+  package's dataset generators are jitted, device-output programs),
+  (b) putting the repetition loop INSIDE one jitted program
+  (``lax.fori_loop`` / the solver's own ``lax.while_loop``) so queued
+  dispatch can't fake completion, and (c) fetching one scalar at the end and
+  subtracting the separately-measured RTT. Each prior measurement is a
+  warm-up, so compile time never lands in a reported number.
+- Roofline accounting for the flagship: the fused Lloyd kernel's floor is a
+  bare streaming matmul over the same feature-major data, which this script
+  MEASURES (``floor_us_per_iter``) instead of trusting a spec-sheet GB/s
+  (the measured streaming rate here exceeds the v5e paper number — the
+  tunnel hides the actual chip generation). ``kernel_vs_floor`` close to
+  1.0 = the full iteration costs little more than just reading the data.
 
-Prints exactly one JSON line:
-    {"metric", "value", "unit", "vs_baseline", plus efficiency extras}.
+Baselines: scikit-learn on this host's CPU. Where the full-size sklearn run
+would take many minutes on the single available core, it runs on a smaller
+slice and is scaled linearly (every scaled workload is O(n) in rows);
+``baseline_note`` records this. ``vs_baseline`` is whole-system speedup
+(mesh throughput / sklearn throughput, or sklearn_time / our_time).
+
+Reference workloads mirrored: benchmarks/k_means_kdd.py:108-125 (KMeans),
+decomposition/pca.py:229-241 (PCA-100), linear_model/glm.py:157 (ADMM),
+_partial.py:167-182 (Incremental), docs/source/hyper-parameter-search.rst:
+78-135 (GridSearchCV pipeline sweep).
 """
 
 import json
 import time
+from functools import partial
 
 import numpy as np
 
-N_SAMPLES = 1_000_000
-N_FEATURES = 50
-N_CLUSTERS = 8
-N_ITER = 20
-SK_SAMPLES = 200_000  # sklearn baseline runs a smaller slice, scaled by work
-HBM_PEAK_GBPS = 819.0  # TPU v5e spec sheet; roofline denominator
+HBM_V5E_SPEC_GBPS = 819.0  # spec-sheet reference point only; see module doc
+
+KM = dict(n=1_000_000, d=50, k=8, iters=1000)
+PCA = dict(n=500_000, d=1000, k=100, rank=64, reps=8)
+ADMM = dict(n=10_000_000, d=100, outer=10)
+INC = dict(n=2_000_000, d=100, block=100_000)
+GRID = dict(n=20_000, d=100, points=500, cv=2, sk_points=100)
 
 
-def bench_tpu(dtype_name: str):
+def fetch(x):
+    """Force completion + value transfer (block_until_ready lies here)."""
+    import jax
+
+    return np.asarray(jax.tree_util.tree_leaves(x)[0])
+
+
+def measure(fn, *args, reps=3):
+    """Min wall-time of fn(*args) with a forced fetch; call once to warm."""
+    fetch(fn(*args))
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fetch(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def measure_rtt():
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x + 1.0)
+    return measure(f, jnp.asarray(0.0), reps=8)
+
+
+# ---------------------------------------------------------------------------
+# config 1: KMeans Lloyd throughput (flagship)
+# ---------------------------------------------------------------------------
+
+
+def bench_kmeans(rtt):
     import jax
     import jax.numpy as jnp
 
     from dask_ml_tpu import datasets
     from dask_ml_tpu.models import kmeans as core
     from dask_ml_tpu.parallel import mesh as mesh_lib
-    from dask_ml_tpu.parallel.sharding import prepare_data
 
-    dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[dtype_name]
-    X, _ = datasets.make_blobs(
-        n_samples=N_SAMPLES, n_features=N_FEATURES, centers=N_CLUSTERS,
-        cluster_std=2.0, random_state=0,
-    )
+    n, d, k, iters = KM["n"], KM["d"], KM["k"], KM["iters"]
     mesh = mesh_lib.default_mesh()
-    data = prepare_data(np.asarray(X), dtype=dtype)
+    X, _ = datasets.make_blobs(n_samples=n, n_features=d, centers=k,
+                               cluster_std=2.0, random_state=0, mesh=mesh)
+    w = jnp.ones((n,), jnp.float32)
     key = jax.random.key(0)
-    centers0 = core.init_random(
-        data.X.astype(jnp.float32), data.weights, data.n, N_CLUSTERS, key)
-    tol = jnp.asarray(0.0, jnp.float32)
+    centers0 = core.init_random(X, w, n, k, key)
+    tol = jnp.asarray(0.0, jnp.float32)  # run all `iters` iterations
 
-    def run():
-        return core.lloyd_loop_fused(
-            data.X, data.weights, centers0, tol, mesh=mesh, max_iter=N_ITER)
+    out = {}
+    for dtype_name, Xd in (("float32", X), ("bfloat16", X.astype(jnp.bfloat16))):
+        f = partial(core.lloyd_loop_fused, mesh=mesh, max_iter=iters)
+        t = max(measure(f, Xd, w, centers0, tol) - rtt, 1e-9)
+        out[dtype_name] = n * iters / t / jax.device_count()
 
-    jax.block_until_ready(run())  # compile + warm
-    t0 = time.perf_counter()
-    centers, inertia, n_iter, _ = run()
-    jax.block_until_ready(centers)
-    dt = time.perf_counter() - t0
-    iters = max(int(n_iter), 1)
-    mesh_rate = N_SAMPLES * iters / dt  # whole-mesh samples/sec
-    bytes_per_iter = N_SAMPLES * N_FEATURES * np.dtype(
-        "float32" if dtype_name == "float32" else "uint16").itemsize
-    gbps = bytes_per_iter * iters / dt / 1e9 / jax.device_count()
-    return mesh_rate, mesh_rate / jax.device_count(), gbps, float(inertia)
+    # streaming floor: bare distance matmul + min over the same data,
+    # feature-major, same rep count — the kernel's bandwidth floor
+    XT = jnp.asarray(np.asarray(X).T.copy())
+    C0 = jnp.asarray(np.asarray(centers0))
 
+    @jax.jit
+    def floor_loop(XT, C):
+        def body(i, carry):
+            acc, c = carry
+            prod = jax.lax.dot_general(c, XT, (((1,), (0,)), ((), ())),
+                                       preferred_element_type=jnp.float32)
+            m = prod.min(axis=0).sum()
+            return (acc + m, c + m * 1e-30)
+        return jax.lax.fori_loop(0, iters, body,
+                                 (jnp.asarray(0.0, jnp.float32), C))
 
-def bench_sklearn_baseline():
+    t_floor = (measure(floor_loop, XT, C0) - rtt) / iters
+    per_iter = n / out["float32"] / jax.device_count()  # sec/iter (whole mesh)
+    gbps = n * d * 4 / jax.device_count() / per_iter / 1e9  # per-chip traffic
+
+    # sklearn Lloyd baseline on a slice, scaled by rows x iters
     from sklearn.cluster import KMeans as SKKMeans
 
+    ns = 200_000
     rng = np.random.RandomState(0)
-    X = rng.randn(SK_SAMPLES, N_FEATURES).astype(np.float32) * 2.0
-    init = X[rng.choice(SK_SAMPLES, N_CLUSTERS, replace=False)]
-    km = SKKMeans(
-        n_clusters=N_CLUSTERS, init=init, n_init=1, max_iter=N_ITER,
-        tol=0.0, algorithm="lloyd",
-    )
+    Xs = rng.randn(ns, d).astype(np.float32) * 2.0
+    init = Xs[rng.choice(ns, k, replace=False)]
+    km = SKKMeans(n_clusters=k, init=init, n_init=1, max_iter=20, tol=0.0,
+                  algorithm="lloyd")
     t0 = time.perf_counter()
-    km.fit(X)
-    dt = time.perf_counter() - t0
-    iters = max(int(km.n_iter_), 1)
-    return SK_SAMPLES * iters / dt
+    km.fit(Xs)
+    sk_rate = ns * max(int(km.n_iter_), 1) / (time.perf_counter() - t0)
+
+    print(json.dumps({
+        "metric": "kmeans_lloyd_throughput",
+        "value": round(out["float32"], 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(out["float32"] * 1.0 / sk_rate, 2),
+        "dtype": "float32 (f32 accumulation)",
+        "bf16_samples_per_sec_per_chip": round(out["bfloat16"], 1),
+        "effective_gbps_logical": round(gbps, 1),
+        "spec_frac_of_v5e_819gbps": round(gbps / HBM_V5E_SPEC_GBPS, 3),
+        "floor_us_per_iter": round(t_floor * 1e6, 1),
+        "kernel_vs_floor": round(per_iter / t_floor, 2),
+        "baseline_note": f"sklearn Lloyd on {ns} rows, rate-normalized",
+    }))
+
+
+# ---------------------------------------------------------------------------
+# config 2: PCA n_components=100 on tall-skinny (tsqr + randomized)
+# ---------------------------------------------------------------------------
+
+
+def bench_pca(rtt):
+    import jax
+    import jax.numpy as jnp
+
+    from dask_ml_tpu.ops import linalg
+    from dask_ml_tpu.parallel import mesh as mesh_lib
+
+    n, d, k, rank, reps = (PCA["n"], PCA["d"], PCA["k"], PCA["rank"],
+                           PCA["reps"])
+    mesh = mesh_lib.default_mesh()
+    row_sh = mesh_lib.data_sharding(mesh, ndim=2)
+
+    def gen(key):
+        ka, kb, kn = jax.random.split(key, 3)
+        A = jax.random.normal(ka, (n, rank), jnp.float32)
+        B = jax.random.normal(kb, (rank, d), jnp.float32)
+        return A @ B + 0.1 * jax.random.normal(kn, (n, d), jnp.float32)
+
+    X = jax.jit(gen, out_shardings=row_sh)(jax.random.key(0))
+
+    @partial(jax.jit, static_argnames=("mesh", "reps"))
+    def tsvd_loop(X, *, mesh, reps):
+        def body(i, acc):
+            Xi = X + acc * 1e-30  # carry-dependence defeats loop hoisting
+            _U, S, _Vt = linalg._tsvd_impl(Xi, mesh=mesh)
+            return acc + S[0]
+        return jax.lax.fori_loop(0, reps, body, jnp.asarray(0.0, jnp.float32))
+
+    @partial(jax.jit, static_argnames=("mesh", "reps"))
+    def rand_loop(X, key, *, mesh, reps):
+        def body(i, acc):
+            Xi = X + acc * 1e-30
+            _U, S, _Vt = linalg._svd_compressed_impl(
+                Xi, key, mesh=mesh, k=k, n_power_iter=2, n_oversamples=10)
+            return acc + S[0]
+        return jax.lax.fori_loop(0, reps, body, jnp.asarray(0.0, jnp.float32))
+
+    t_tsqr = (measure(partial(tsvd_loop, mesh=mesh, reps=reps), X) - rtt) / reps
+    t_rand = (measure(partial(rand_loop, mesh=mesh, reps=reps), X,
+                      jax.random.key(1)) - rtt) / reps
+
+    # sklearn randomized PCA on a slice, scaled linearly in rows (O(n d k))
+    from sklearn.decomposition import PCA as SKPCA
+
+    ns = 50_000
+    Xh = np.asarray(X[:ns])
+    t0 = time.perf_counter()
+    SKPCA(n_components=k, svd_solver="randomized", iterated_power=2,
+          random_state=0).fit(Xh)
+    sk_scaled = (time.perf_counter() - t0) * n / ns
+
+    print(json.dumps({
+        "metric": "pca100_randomized_fit",
+        "value": round(t_rand, 4),
+        "unit": "seconds",
+        "vs_baseline": round(sk_scaled / t_rand, 1),
+        "rows": n, "cols": d, "n_components": k,
+        "tsqr_exact_svd_seconds": round(t_tsqr, 4),
+        "samples_per_sec_per_chip": round(n / t_rand, 1),
+        "baseline_note": f"sklearn randomized PCA on {ns} rows x{n // ns} "
+                         "(linear in rows)",
+    }))
+    del X
+
+
+# ---------------------------------------------------------------------------
+# config 3: LogisticRegression via consensus ADMM
+# ---------------------------------------------------------------------------
+
+
+def bench_admm(rtt):
+    import jax
+    import jax.numpy as jnp
+
+    from dask_ml_tpu import datasets
+    from dask_ml_tpu.models import glm as glm_core
+    from dask_ml_tpu.parallel import mesh as mesh_lib
+
+    n, d, outer = ADMM["n"], ADMM["d"], ADMM["outer"]
+    mesh = mesh_lib.default_mesh()
+    X, y = datasets.make_classification(
+        n_samples=n, n_features=d, n_informative=d, scale=2.0,
+        random_state=0, mesh=mesh)
+    w = jnp.ones((n,), jnp.float32)
+    beta0 = jnp.zeros((d,), jnp.float32)
+    mask = jnp.ones((d,), jnp.float32)
+
+    def run():
+        return glm_core.admm(
+            X, y.astype(jnp.float32), w, beta0, mask, mesh,
+            family="logistic", regularizer="l2", lamduh=1.0,
+            max_iter=outer, abstol=0.0, reltol=0.0)  # run all outer iters
+
+    t = measure(run) - rtt
+
+    from sklearn.linear_model import LogisticRegression as SKLR
+
+    ns = 200_000
+    Xh, yh = np.asarray(X[:ns]), np.asarray(y[:ns])
+    t0 = time.perf_counter()
+    SKLR(C=1.0, max_iter=100).fit(Xh, yh)
+    sk_scaled = (time.perf_counter() - t0) * n / ns
+
+    print(json.dumps({
+        "metric": "logreg_admm_fit",
+        "value": round(t, 3),
+        "unit": "seconds",
+        "vs_baseline": round(sk_scaled / t, 1),
+        "rows": n, "cols": d, "admm_outer_iters": outer,
+        "samples_per_sec_per_chip":
+            round(n * outer / t / jax.device_count(), 1),
+        "baseline_note": f"sklearn lbfgs LogisticRegression on {ns} rows "
+                         f"x{n // ns} (linear in rows)",
+    }))
+    del X, y
+
+
+# ---------------------------------------------------------------------------
+# config 4: Incremental streaming partial_fit (fused scan path)
+# ---------------------------------------------------------------------------
+
+
+def bench_incremental(rtt):
+    import jax
+    import jax.numpy as jnp
+
+    from dask_ml_tpu import datasets
+    from dask_ml_tpu.models import glm as glm_core
+    from dask_ml_tpu.parallel import mesh as mesh_lib
+    from dask_ml_tpu.wrappers import incremental_scan
+
+    n, d, block = INC["n"], INC["d"], INC["block"]
+    mesh = mesh_lib.default_mesh()
+    X, y = datasets.make_classification(
+        n_samples=n, n_features=d, n_informative=d, scale=2.0,
+        random_state=1, mesh=mesh)
+    y = y.astype(jnp.float32)
+
+    step, _ = glm_core.get_stream_step(
+        family="logistic", regularizer="l2", lamduh=0.01, eta0=0.5,
+        fit_intercept=True)
+    state0 = (jnp.zeros((d + 1,), jnp.float32), jnp.asarray(0.0, jnp.float32))
+
+    def run():
+        return incremental_scan(step, state0, X, y, block_size=block)
+
+    t = measure(run) - rtt
+
+    # sklearn SGDClassifier partial_fit host loop over the same stream
+    from sklearn.linear_model import SGDClassifier
+
+    ns = 500_000
+    Xh, yh = np.asarray(X[:ns]), np.asarray(y[:ns])
+    sk = SGDClassifier(alpha=0.01, random_state=0)
+    t0 = time.perf_counter()
+    for i in range(0, ns, block):
+        sk.partial_fit(Xh[i:i + block], yh[i:i + block], classes=[0.0, 1.0])
+    sk_scaled = (time.perf_counter() - t0) * n / ns
+
+    print(json.dumps({
+        "metric": "incremental_stream_fit",
+        "value": round(t, 4),
+        "unit": "seconds",
+        "vs_baseline": round(sk_scaled / t, 1),
+        "rows": n, "cols": d, "block_size": block,
+        "rows_per_sec_per_chip": round(n / t / jax.device_count(), 1),
+        "baseline_note": f"sklearn SGDClassifier partial_fit loop on {ns} "
+                         f"rows x{n // ns} (linear in rows)",
+    }))
+    del X, y
+
+
+# ---------------------------------------------------------------------------
+# config 5: GridSearchCV 500-point StandardScaler->PCA->KMeans sweep
+# ---------------------------------------------------------------------------
+
+
+def bench_gridsearch(_rtt):
+    from sklearn.cluster import KMeans as SKKMeans
+    from sklearn.decomposition import PCA as SKPCA
+    from sklearn.model_selection import GridSearchCV as SkGridSearchCV
+    from sklearn.model_selection import ParameterGrid
+    from sklearn.pipeline import Pipeline
+    from sklearn.preprocessing import StandardScaler
+
+    from dask_ml_tpu.model_selection import GridSearchCV
+
+    n, d, cv = GRID["n"], GRID["d"], GRID["cv"]
+    rng = np.random.RandomState(0)
+    X = (rng.randn(n, d) @ np.diag(np.linspace(2, 0.5, d))).astype(np.float32)
+    y = None
+
+    def make_pipe():
+        return Pipeline([
+            ("scale", StandardScaler()),
+            ("pca", SKPCA(random_state=0)),
+            ("km", SKKMeans(n_init=1, max_iter=10, random_state=0)),
+        ])
+
+    grid = {
+        "pca__n_components": [5, 10, 15, 20, 25],
+        "km__n_clusters": list(range(2, 12)),
+        "km__tol": list(np.logspace(-6, -2, 10)),
+    }  # 5 x 10 x 10 = 500 points
+    assert len(ParameterGrid(grid)) == GRID["points"]
+
+    def km_scorer(est, X, y=None):
+        return float(est.score(X))  # KMeans score = -inertia
+
+    t0 = time.perf_counter()
+    ours = GridSearchCV(make_pipe(), grid, cv=cv, scoring=km_scorer,
+                        refit=False, iid=False).fit(X)
+    t_ours = time.perf_counter() - t0
+
+    # sklearn on a candidate subset, scaled (candidates are homogeneous)
+    sub = {
+        "pca__n_components": [5, 10, 15, 20, 25],
+        "km__n_clusters": list(range(2, 12)),
+        "km__tol": [1e-4, 1e-3],
+    }  # 100 points
+    n_sub = len(ParameterGrid(sub))
+    t0 = time.perf_counter()
+    SkGridSearchCV(make_pipe(), sub, cv=cv, scoring=km_scorer,
+                   refit=False).fit(X)
+    sk_scaled = (time.perf_counter() - t0) * GRID["points"] / n_sub
+
+    print(json.dumps({
+        "metric": "gridsearch_500pt_pipeline_sweep",
+        "value": round(t_ours, 2),
+        "unit": "seconds",
+        "vs_baseline": round(sk_scaled / t_ours, 2),
+        "points": GRID["points"], "cv": cv, "rows": n,
+        "n_shared_fits": int(ours.n_shared_fits_),
+        "cells": GRID["points"] * cv,
+        "baseline_note": f"sklearn GridSearchCV on {n_sub} of 500 points "
+                         f"x{GRID['points'] // n_sub} (homogeneous grid)",
+    }))
 
 
 def main():
-    mesh_rate, per_chip, gbps, _ = bench_tpu("bfloat16")
-    _, per_chip_f32, gbps_f32, _ = bench_tpu("float32")
-    sk_throughput = bench_sklearn_baseline()
-    print(
-        json.dumps(
-            {
-                "metric": "kmeans_lloyd_throughput",
-                "value": round(per_chip, 1),
-                "unit": "samples/sec/chip",
-                # whole-system vs whole-baseline speedup (not per-chip), so
-                # the ratio keeps its meaning across mesh sizes
-                "vs_baseline": round(mesh_rate / sk_throughput, 2),
-                "dtype": "bfloat16 (f32 accumulation)",
-                "effective_gbps_per_chip": round(gbps, 1),
-                "roofline_frac_of_819gbps": round(gbps / HBM_PEAK_GBPS, 3),
-                "f32_samples_per_sec_per_chip": round(per_chip_f32, 1),
-                "f32_effective_gbps": round(gbps_f32, 1),
-            }
-        )
-    )
+    rtt = measure_rtt()
+    bench_kmeans(rtt)
+    bench_pca(rtt)
+    bench_admm(rtt)
+    bench_incremental(rtt)
+    bench_gridsearch(rtt)
 
 
 if __name__ == "__main__":

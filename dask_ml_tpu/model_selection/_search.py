@@ -637,17 +637,26 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
             for si in range(n_splits)
         ]
         n_workers = _normalize_n_jobs(self.n_jobs)
-        if n_workers == 1:
-            results = [
-                runner.run(candidate_params[ci], si) for ci, si in cells
-            ]
-        else:
-            with ThreadPoolExecutor(max_workers=n_workers) as pool:
-                futs = [
-                    pool.submit(runner.run, candidate_params[ci], si)
-                    for ci, si in cells
+        # Device-staging memo: jax-native candidates re-stage their CV slice
+        # inside fit; within this scope identical (slice, role) pairs upload
+        # once for the whole search (the analogue of the reference's
+        # data-key sharing, model_selection/utils.py:53-68).
+        from dask_ml_tpu.parallel.sharding import staging_memo
+
+        with staging_memo() as dmemo:
+            if n_workers == 1:
+                results = [
+                    runner.run(candidate_params[ci], si) for ci, si in cells
                 ]
-                results = [f.result() for f in futs]
+            else:
+                with ThreadPoolExecutor(max_workers=n_workers) as pool:
+                    futs = [
+                        pool.submit(runner.run, candidate_params[ci], si)
+                        for ci, si in cells
+                    ]
+                    results = [f.result() for f in futs]
+        self.n_device_stagings_ = dmemo.n_stagings
+        self.n_staging_hits_ = dmemo.hits
 
         test_weights = None
         if self.iid:

@@ -25,3 +25,7 @@ from dask_ml_tpu.parallel.sharding import (  # noqa: F401
     shard_rows,
     unpad_rows,
 )
+
+# runtime (multi-host bootstrap) is imported lazily by users that need it:
+#   from dask_ml_tpu.parallel import runtime; runtime.initialize(...)
+# importing it here would pull jax.distributed into every single-host run.

@@ -1,0 +1,102 @@
+"""Multi-host runtime bootstrap.
+
+The reference distributes across machines by pointing its graph executor at a
+``dask.distributed`` TCP scheduler (reference: model_selection/_search.py:
+841-852 scheduler resolution; tests spin real worker subprocesses via
+``distributed.utils_test.cluster``, conftest.py:131-141). The TPU-native
+equivalent is JAX's multi-controller runtime: every host runs THIS SAME
+program, :func:`initialize` wires them into one runtime via
+``jax.distributed.initialize``, and a mesh built over ``jax.devices()``
+(which, after initialization, lists every device on every host) spans the
+whole system. Collectives inside ``shard_map``/``jit`` then ride ICI within
+a slice and DCN across slices — placement follows the mesh's device order,
+which :func:`global_mesh` keeps contiguous per host so the sample axis maps
+host-locally wherever possible.
+
+There is no driver/worker asymmetry to manage (the reference's
+scheduler/client split collapses into SPMD): each process stages ITS OWN
+sample-axis shard with :func:`process_rows`, and only the hyperparameter
+search layer remains host-side Python.
+
+Single-host use needs none of this — :mod:`dask_ml_tpu.parallel.mesh`
+lazily builds a mesh over the local devices.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+
+from dask_ml_tpu.parallel import mesh as mesh_lib
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids=None,
+) -> None:
+    """Join this process into a multi-host JAX runtime.
+
+    Thin, idempotent wrapper over ``jax.distributed.initialize``: on TPU
+    pods the arguments are discovered from the environment and may all be
+    None; on CPU/GPU clusters pass ``coordinator_address`` (``"host:port"``
+    of process 0), ``num_processes``, and this process's ``process_id``.
+
+    Call BEFORE any other JAX/device use (backends must not exist yet) —
+    the same constraint dask has that the Client must exist before work is
+    submitted. After this returns, ``jax.devices()`` spans every host and
+    :func:`global_mesh` builds the system-wide mesh.
+    """
+    global _initialized
+    if _initialized:
+        logger.debug("runtime.initialize: already initialized, skipping")
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    _initialized = True
+    logger.info(
+        "distributed runtime up: process %d/%d, %d local / %d global devices",
+        jax.process_index(), jax.process_count(),
+        jax.local_device_count(), jax.device_count(),
+    )
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def global_mesh(axis_names=(mesh_lib.DATA_AXIS,), shape=None) -> "jax.sharding.Mesh":
+    """A mesh over every device on every participating host.
+
+    ``jax.devices()`` orders devices process-contiguously, so a 1-D
+    ``('data',)`` mesh gives each host a contiguous run of sample-axis
+    shards: cross-shard psums reduce over ICI within the host/slice first
+    and touch DCN only for the cross-host combine. For a 2-D
+    ``('data', 'model')`` layout pass ``shape=(n_data, n_model)`` — keep
+    the model axis within a slice (it carries the chattier collectives).
+    """
+    return mesh_lib.make_mesh(devices=jax.devices(), shape=shape,
+                              axis_names=axis_names)
+
+
+def process_rows(n_rows: int) -> tuple[int, int]:
+    """This process's contiguous [start, stop) slice of a length-``n_rows``
+    sample axis, by even split over processes (remainder to the front
+    processes) — the staging contract for multi-host ``prepare_data``-style
+    loading where each host reads only its own rows."""
+    p, np_ = jax.process_index(), jax.process_count()
+    base, rem = divmod(n_rows, np_)
+    start = p * base + min(p, rem)
+    stop = start + base + (1 if p < rem else 0)
+    return start, stop

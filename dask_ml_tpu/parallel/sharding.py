@@ -11,7 +11,9 @@ wants equal shards, so we pad the sample axis up to a multiple of the mesh's
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import threading
 from typing import Optional, Union
 
 import jax
@@ -22,6 +24,67 @@ from jax.sharding import Mesh
 from dask_ml_tpu.parallel import mesh as mesh_lib
 
 ArrayLike = Union[np.ndarray, jax.Array]
+
+
+class StagingMemo:
+    """Scoped host→device staging cache.
+
+    The reference's search graphs embed each data array under one
+    content-addressed key, so every candidate fit shares a single placement
+    of the training slice (reference: model_selection/utils.py:53-68
+    ``to_keys``). Our jax-native estimators stage their own inputs inside
+    ``fit``, which — uncached — re-uploads the same CV slice once per
+    candidate×split cell. Inside a ``with staging_memo():`` scope,
+    :func:`shard_rows` / :func:`prepare_data` memoize on the *identity* of
+    the source arrays (+ mesh + dtypes), so a grid search pays one transfer
+    per distinct (slice, role) no matter how many candidates share it.
+
+    Identity keying is safe only because the scope holds strong references
+    to every source object (no id reuse) and search CV slices are immutable
+    by convention; that is why the cache is scoped, not global.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: dict = {}
+        self.hits = 0
+
+    def get_or_stage(self, key, refs, compute):
+        with self._lock:
+            if key in self._entries:
+                self.hits += 1
+                return self._entries[key][1]
+        # staging itself runs outside the lock (device_put of a big array);
+        # a racing duplicate upload is possible but benign — last write wins
+        value = compute()
+        with self._lock:
+            self._entries.setdefault(key, (refs, value))
+            return self._entries[key][1]
+
+    @property
+    def n_stagings(self) -> int:
+        return len(self._entries)
+
+
+_memo_stack: list = []
+_memo_lock = threading.Lock()
+
+
+@contextlib.contextmanager
+def staging_memo():
+    """Enable staging memoization for the dynamic scope (see StagingMemo)."""
+    memo = StagingMemo()
+    with _memo_lock:
+        _memo_stack.append(memo)
+    try:
+        yield memo
+    finally:
+        with _memo_lock:
+            _memo_stack.remove(memo)
+
+
+def _current_memo() -> Optional[StagingMemo]:
+    return _memo_stack[-1] if _memo_stack else None
 
 
 def pad_rows(n: int, n_shards: int) -> int:
@@ -41,6 +104,17 @@ def shard_rows(
     :func:`row_weights` (or :func:`prepare_data`, which does both).
     """
     mesh = mesh or mesh_lib.default_mesh()
+    memo = _current_memo()
+    if memo is not None:
+        return memo.get_or_stage(
+            ("rows", id(x), id(mesh), str(dtype)),
+            (x, mesh),
+            lambda: _shard_rows_impl(x, mesh, dtype),
+        )
+    return _shard_rows_impl(x, mesh, dtype)
+
+
+def _shard_rows_impl(x, mesh, dtype):
     x = jnp.asarray(x, dtype=dtype)
     n = int(x.shape[0])
     pad = pad_rows(n, mesh_lib.n_data_shards(mesh))
@@ -121,8 +195,25 @@ def prepare_data(
     dtype=None,
     y_dtype=None,
 ) -> DeviceData:
-    """Stage ``(X, y, sample_weight)`` onto the mesh as a :class:`DeviceData`."""
+    """Stage ``(X, y, sample_weight)`` onto the mesh as a :class:`DeviceData`.
+
+    Inside a :func:`staging_memo` scope, repeated calls on the same source
+    objects return the already-staged ``DeviceData`` (one transfer per
+    distinct slice, however many search candidates share it)."""
     mesh = mesh or mesh_lib.default_mesh()
+    memo = _current_memo()
+    if memo is not None:
+        return memo.get_or_stage(
+            ("data", id(X), id(y), id(sample_weight), id(mesh),
+             str(dtype), str(y_dtype)),
+            (X, y, sample_weight, mesh),
+            lambda: _prepare_data_impl(X, y, sample_weight, mesh, dtype,
+                                       y_dtype),
+        )
+    return _prepare_data_impl(X, y, sample_weight, mesh, dtype, y_dtype)
+
+
+def _prepare_data_impl(X, y, sample_weight, mesh, dtype, y_dtype):
     Xs, n = shard_rows(X, mesh=mesh, dtype=dtype)
     ys = None
     if y is not None:

@@ -39,6 +39,26 @@ def check_array(
             "(reference rejects dask.dataframe the same way, "
             "cluster/k_means.py:153-160)"
         )
+    # Inside a staging_memo scope (the search driver), validation of the
+    # same source object is done once: it involves a host→device transfer
+    # and a finiteness sync, both worth sharing across candidates.
+    from dask_ml_tpu.parallel.sharding import _current_memo
+
+    memo = _current_memo()
+    if memo is not None:
+        return memo.get_or_stage(
+            ("check", id(X), ensure_2d, allow_nd, force_all_finite,
+             str(dtype), min_samples),
+            (X,),
+            lambda: _check_array_impl(X, ensure_2d, allow_nd,
+                                      force_all_finite, dtype, min_samples),
+        )
+    return _check_array_impl(X, ensure_2d, allow_nd, force_all_finite, dtype,
+                             min_samples)
+
+
+def _check_array_impl(X, ensure_2d, allow_nd, force_all_finite, dtype,
+                      min_samples):
     arr = np.asarray(X) if not isinstance(X, jax.Array) else X
     if ensure_2d and arr.ndim == 1:
         raise ValueError(
