@@ -19,6 +19,7 @@ from sklearn.base import BaseEstimator, TransformerMixin
 from dask_ml_tpu.models import kmeans as core
 from dask_ml_tpu.ops.pairwise import euclidean_distances
 from dask_ml_tpu.parallel.sharding import prepare_data, unpad_rows
+from dask_ml_tpu.utils._log import profile_phase
 from dask_ml_tpu.utils.validation import check_array, check_random_state
 
 logger = logging.getLogger(__name__)
@@ -110,10 +111,11 @@ class KMeans(TransformerMixin, BaseEstimator):
         logger.info("init (%s) finished in %.2fs", self.init, t_init - t0)
 
         tol = core.scaled_tolerance(data.X, data.weights, self.tol)
-        centers, _, n_iter, _ = core.lloyd_loop_fused(
-            data.X, data.weights, centers, tol,
-            mesh=data.mesh, max_iter=self.max_iter,
-        )
+        with profile_phase(logger, "kmeans-lloyd"):
+            centers, _, n_iter, _ = core.lloyd_loop_fused(
+                data.X, data.weights, centers, tol,
+                mesh=data.mesh, max_iter=self.max_iter,
+            )
         # Recompute cost against the *final* centers so inertia_ is consistent
         # with cluster_centers_/labels_ and score(X) — the reference likewise
         # re-assigns after the loop (reference: cluster/k_means.py:504-507).

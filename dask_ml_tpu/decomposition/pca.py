@@ -16,6 +16,7 @@ pca.py:278-292).
 
 from __future__ import annotations
 
+import logging
 from functools import partial
 
 import jax
@@ -26,7 +27,10 @@ from sklearn.base import BaseEstimator, TransformerMixin
 from dask_ml_tpu.ops import linalg
 from dask_ml_tpu.parallel import mesh as mesh_lib
 from dask_ml_tpu.parallel.sharding import prepare_data, shard_rows, unpad_rows
+from dask_ml_tpu.utils._log import profile_phase
 from dask_ml_tpu.utils.validation import check_array, check_random_state
+
+logger = logging.getLogger(__name__)
 
 
 @jax.jit
@@ -116,13 +120,15 @@ class PCA(BaseEstimator, TransformerMixin):
         Xc = _center_and_mask(data.X, data.weights, mean)
 
         if solver in ("full", "tsqr"):
-            U, S, Vt = linalg.tsvd(Xc, mesh=mesh, weights=data.weights)
+            with profile_phase(logger, "pca-tsvd"):
+                U, S, Vt = linalg.tsvd(Xc, mesh=mesh, weights=data.weights)
         else:
             key = check_random_state(self.random_state)
-            U, S, Vt = linalg.svd_compressed(
-                Xc, n_components, n_power_iter=int(self.iterated_power),
-                key=key, mesh=mesh, weights=data.weights,
-            )
+            with profile_phase(logger, "pca-randomized-svd"):
+                U, S, Vt = linalg.svd_compressed(
+                    Xc, n_components, n_power_iter=int(self.iterated_power),
+                    key=key, mesh=mesh, weights=data.weights,
+                )
         U, Vt = linalg.svd_flip(U, Vt)
 
         # tsvd on the padded array can return min(n_padded, d) singular

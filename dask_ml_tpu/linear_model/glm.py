@@ -28,6 +28,7 @@ from dask_ml_tpu.metrics import accuracy_score, r2_score
 from dask_ml_tpu.models import glm as core
 from dask_ml_tpu.parallel import mesh as mesh_lib
 from dask_ml_tpu.parallel.sharding import prepare_data, shard_rows, unpad_rows
+from dask_ml_tpu.utils._log import profile_phase
 from dask_ml_tpu.utils.validation import check_array
 
 logger = logging.getLogger(__name__)
@@ -112,10 +113,11 @@ class _GLM(BaseEstimator):
             mask[-1] = 0.0
         beta0 = jnp.zeros((d,), Xd.dtype)
         kwargs = self._get_solver_kwargs()
-        beta, n_iter = core.solve(
-            self.solver, Xd, data.y, data.weights, beta0,
-            jnp.asarray(mask), mesh=mesh, **kwargs,
-        )
+        with profile_phase(logger, f"glm-{self.solver}"):
+            beta, n_iter = core.solve(
+                self.solver, Xd, data.y, data.weights, beta0,
+                jnp.asarray(mask), mesh=mesh, **kwargs,
+            )
         self._coef = np.asarray(beta)
         self.n_iter_ = int(n_iter)
         if self.fit_intercept:

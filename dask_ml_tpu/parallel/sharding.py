@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import logging
 import threading
 from typing import Optional, Union
 
@@ -22,6 +23,9 @@ import numpy as np
 from jax.sharding import Mesh
 
 from dask_ml_tpu.parallel import mesh as mesh_lib
+from dask_ml_tpu.utils._log import log_array
+
+logger = logging.getLogger(__name__)
 
 ArrayLike = Union[np.ndarray, jax.Array]
 
@@ -224,4 +228,7 @@ def _prepare_data_impl(X, y, sample_weight, mesh, dtype, y_dtype):
             )
         ys, _ = shard_rows(y_arr, mesh=mesh)
     w = row_weights(int(Xs.shape[0]), n, mesh=mesh, sample_weight=sample_weight)
+    log_array(logger, "prepare_data: X", Xs)
+    if ys is not None:
+        log_array(logger, "prepare_data: y", ys, level=logging.DEBUG)
     return DeviceData(X=Xs, weights=w, n=n, y=ys, mesh=mesh)

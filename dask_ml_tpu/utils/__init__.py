@@ -4,10 +4,16 @@ Parity with the reference's L2 layer (reference: dask_ml/utils.py,
 _utils.py, _compat.py).
 """
 
+from dask_ml_tpu.utils._log import (  # noqa: F401
+    format_bytes,
+    log_array,
+    profile_phase,
+)
 from dask_ml_tpu.utils._utils import copy_learned_attributes  # noqa: F401
 from dask_ml_tpu.utils.validation import (  # noqa: F401
     check_array,
     check_random_state,
     check_random_state_np,
+    row_norms,
 )
 from dask_ml_tpu.utils.testing import assert_estimator_equal  # noqa: F401

@@ -1,0 +1,110 @@
+"""Structured array/placement logging and profiler hooks.
+
+The reference logs array geometry at INFO during expensive fits
+(reference: utils.py:217-241 ``_log_array`` / ``_format_bytes``; used from
+cluster/k_means.py:444-452). The TPU analogue reports what actually matters
+here — shape, dtype, host bytes, and the mesh placement (axis layout +
+PartitionSpec) — and adds ``jax.profiler`` hooks, which are the platform's
+native tracing story (reference's analogue is dask's scheduler dashboards).
+
+Profiling is opt-in two ways:
+
+- :func:`profile_phase` always emits a ``jax.profiler.TraceAnnotation`` so
+  phases show up named in any externally-captured trace, and logs wall time
+  at DEBUG.
+- Setting ``DASK_ML_TPU_PROFILE_DIR=/some/dir`` makes the *outermost*
+  :func:`profile_phase` capture a full ``jax.profiler.trace`` into that
+  directory (viewable in TensorBoard / xprof) with zero code changes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import threading
+import time
+
+__all__ = ["format_bytes", "log_array", "profile_phase"]
+
+PROFILE_DIR_ENV = "DASK_ML_TPU_PROFILE_DIR"
+
+
+def format_bytes(n: int) -> str:
+    """1234 → '1.23 kB' (reference: utils.py:222-241 ``_format_bytes``)."""
+    if n > 1e9:
+        return "%0.2f GB" % (n / 1e9)
+    if n > 1e6:
+        return "%0.2f MB" % (n / 1e6)
+    if n > 1e3:
+        return "%0.2f kB" % (n / 1e3)
+    return "%d B" % n
+
+
+def _placement(x) -> str:
+    """Describe where an array lives: mesh axes + PartitionSpec, or host."""
+    sharding = getattr(x, "sharding", None)
+    mesh = getattr(sharding, "mesh", None)
+    if mesh is not None:
+        axes = ",".join(
+            f"{name}={size}" for name, size in
+            zip(mesh.axis_names, mesh.devices.shape)
+        )
+        return f"mesh({axes}) spec={getattr(sharding, 'spec', None)}"
+    if sharding is not None:
+        return str(sharding)
+    return "host"
+
+
+def log_array(logger: logging.Logger, name: str, x,
+              level: int = logging.INFO) -> None:
+    """One structured line: name, shape, dtype, bytes, placement."""
+    if not logger.isEnabledFor(level):
+        return
+    shape = tuple(getattr(x, "shape", ()))
+    dtype = getattr(x, "dtype", None)
+    nbytes = getattr(x, "nbytes", None)
+    if nbytes is None and dtype is not None:
+        size = 1
+        for s in shape:
+            size *= int(s)
+        nbytes = size * getattr(dtype, "itemsize", 4)
+    logger.log(
+        level, "%s: shape=%s dtype=%s %s on %s",
+        name, shape, dtype,
+        format_bytes(int(nbytes)) if nbytes is not None else "?",
+        _placement(x),
+    )
+
+
+_trace_state = threading.local()
+
+
+@contextlib.contextmanager
+def profile_phase(logger: logging.Logger, name: str):
+    """Name a fit phase for profiling and log its wall time at DEBUG.
+
+    Inside the scope the phase appears as a ``TraceAnnotation`` in any
+    active profiler capture; when ``DASK_ML_TPU_PROFILE_DIR`` is set the
+    outermost phase in each thread also starts/stops a full
+    ``jax.profiler.trace`` capture into that directory.
+    """
+    import jax.profiler
+
+    trace_dir = os.environ.get(PROFILE_DIR_ENV)
+    own_trace = bool(trace_dir) and not getattr(_trace_state, "active", False)
+    if own_trace:
+        _trace_state.active = True
+        jax.profiler.start_trace(trace_dir)
+    t0 = time.perf_counter()
+    try:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    finally:
+        dt = time.perf_counter() - t0
+        if own_trace:
+            jax.profiler.stop_trace()
+            _trace_state.active = False
+            logger.info("phase %s: %.3fs (trace -> %s)", name, dt, trace_dir)
+        else:
+            logger.debug("phase %s: %.3fs", name, dt)

@@ -114,3 +114,13 @@ def check_random_state_np(
     if isinstance(seed, np.random.RandomState):
         return seed
     return np.random.RandomState(seed)
+
+
+def row_norms(X, squared: bool = False) -> jax.Array:
+    """Per-row L2 norms as one fused reduction (reference: utils.py:44-48,
+    which maps sklearn's ``row_norms`` over dask blocks). On TPU this is a
+    single jitted row reduction; padding rows (all-zero) get norm 0, so it
+    composes with the sharded/padded layout unchanged."""
+    X = jnp.asarray(X)
+    sq = jnp.sum(X * X, axis=-1)
+    return sq if squared else jnp.sqrt(sq)

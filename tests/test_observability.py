@@ -1,0 +1,86 @@
+"""Observability: row_norms, structured array logging, profiler phases.
+
+Mirrors the reference's utils coverage (reference: tests/test_utils.py and
+utils.py:44-48, 217-241): row_norms parity vs sklearn, one INFO line with
+shape/bytes/mesh per staged array, and named profiler phases on fit paths.
+"""
+
+import logging
+
+import numpy as np
+import pytest
+from sklearn.utils.extmath import row_norms as sk_row_norms
+
+from dask_ml_tpu.parallel.sharding import prepare_data
+from dask_ml_tpu.utils import format_bytes, log_array, profile_phase, row_norms
+
+
+def test_row_norms_matches_sklearn():
+    X = np.random.RandomState(0).randn(40, 7).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(row_norms(X)), sk_row_norms(X), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(row_norms(X, squared=True)),
+        sk_row_norms(X, squared=True),
+        rtol=1e-5,
+    )
+
+
+def test_row_norms_on_sharded_padded_data(mesh8):
+    # padding rows are zeros -> norm 0; real rows match the host result
+    X = np.random.RandomState(1).randn(13, 5).astype(np.float32)
+    data = prepare_data(X)
+    out = np.asarray(row_norms(data.X))
+    np.testing.assert_allclose(out[:13], sk_row_norms(X), rtol=1e-5)
+    assert (out[13:] == 0).all()
+
+
+@pytest.mark.parametrize(
+    "n,expected",
+    [(1, "1 B"), (1234, "1.23 kB"), (12345678, "12.35 MB"),
+     (1234567890, "1.23 GB")],
+)
+def test_format_bytes(n, expected):
+    assert format_bytes(n) == expected
+
+
+def test_log_array_reports_shape_bytes_mesh(mesh8, caplog):
+    X = np.zeros((16, 4), np.float32)
+    data = prepare_data(X)
+    logger = logging.getLogger("test_log_array")
+    with caplog.at_level(logging.INFO, logger="test_log_array"):
+        log_array(logger, "X", data.X)
+    [rec] = caplog.records
+    msg = rec.getMessage()
+    assert "shape=(16, 4)" in msg
+    assert "256 B" in msg
+    assert "data=8" in msg  # mesh axis layout
+    assert "PartitionSpec" in msg
+
+
+def test_prepare_data_emits_info_log(mesh8, caplog):
+    with caplog.at_level(logging.INFO, logger="dask_ml_tpu.parallel.sharding"):
+        prepare_data(np.zeros((8, 3), np.float32))
+    assert any("prepare_data: X" in r.getMessage() for r in caplog.records)
+
+
+def test_profile_phase_logs_and_annotates(caplog):
+    logger = logging.getLogger("test_profile_phase")
+    with caplog.at_level(logging.DEBUG, logger="test_profile_phase"):
+        with profile_phase(logger, "unit-test-phase"):
+            pass
+    assert any("unit-test-phase" in r.getMessage() for r in caplog.records)
+
+
+def test_profile_phase_captures_trace(tmp_path, monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("DASK_ML_TPU_PROFILE_DIR", str(tmp_path))
+    logger = logging.getLogger("test_profile_trace")
+    with profile_phase(logger, "traced-phase"):
+        jax.block_until_ready(jax.jit(lambda x: x * 2)(jnp.ones(8)))
+    # jax.profiler.trace writes TensorBoard plugin files under the dir
+    files = list(tmp_path.rglob("*"))
+    assert files, "profiler trace produced no output files"
