@@ -37,6 +37,7 @@ Internal layers:
 __version__ = "0.2.0"
 
 __all__ = [
+    "checkpoint",
     "cluster",
     "decomposition",
     "linear_model",

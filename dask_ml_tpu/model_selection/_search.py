@@ -65,6 +65,27 @@ def _index(a, idx):
     return np.asarray(a)[idx]
 
 
+def _content_array(a):
+    """A content-hashable stand-in for checkpoint keys: numeric data as the
+    actual array (tokenize hashes its bytes), object-dtype / exotic inputs as
+    their pickle bytes — so journal keys change whenever data VALUES change,
+    not just shapes."""
+    if a is None:
+        return None
+    try:
+        arr = np.asarray(a)
+    except Exception:
+        arr = None
+    if arr is not None and arr.dtype != object:
+        return arr
+    import pickle
+
+    try:
+        return pickle.dumps(a, protocol=4)
+    except Exception:
+        return repr(a)
+
+
 class CVCache:
     """Materialized train/test slices per split, cached per search
     (reference: methods.py:67-124). ``extract(..., pairwise=True)`` slices
@@ -561,7 +582,7 @@ class _CandidateRunner:
             fitted, X_test, y_test, X_train, y_train, self.scorers,
             self.error_score,
         )
-        return test, train, fit_time, score_time
+        return test, train, fit_time, score_time, fitted is FIT_FAILURE
 
 
 # ---------------------------------------------------------------------------
@@ -588,7 +609,7 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
 
     def __init__(self, estimator, scoring=None, iid=True, refit=True, cv=None,
                  error_score="raise", return_train_score=True, scheduler=None,
-                 n_jobs=-1, cache_cv=True):
+                 n_jobs=-1, cache_cv=True, checkpoint=None):
         self.estimator = estimator
         self.scoring = scoring
         self.iid = iid
@@ -600,6 +621,9 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
         self.scheduler = scheduler
         self.n_jobs = n_jobs
         self.cache_cv = cache_cv
+        # path to an append-only cell journal; fit() resumes from it
+        # (SURVEY §5.4 — capability-parity-plus over the reference)
+        self.checkpoint = checkpoint
 
     def _get_param_iterator(self):  # pragma: no cover - abstract
         raise NotImplementedError
@@ -637,6 +661,51 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
             for si in range(n_splits)
         ]
         n_workers = _normalize_n_jobs(self.n_jobs)
+
+        # Checkpoint/resume: completed cells live in an append-only journal
+        # keyed by content — estimator config + candidate params + the
+        # split's ACTUAL index arrays + the CONTENT of X/y/fit_params +
+        # scorer names — so a re-fit with the same checkpoint path restores
+        # finished cells and computes only the rest, while any change to
+        # grid, data values, sample weights, or scoring changes the keys and
+        # naturally misses. Cells that FAILED under a numeric error_score
+        # are never journaled: an interrupted run's transient failures (OOM,
+        # preemption) retry on resume instead of being restored as scores.
+        # (SURVEY §5.4; the reference can only re-run from zero.)
+        journal = done_cells = None
+        cell_keys = {}
+        if self.checkpoint:
+            from dask_ml_tpu.checkpoint import CellJournal
+
+            journal = CellJournal(self.checkpoint)
+            done_cells = journal.load()
+            est_token = tokenize(
+                type(estimator), estimator.get_params(deep=True),
+                _content_array(X), _content_array(y),
+                {k: _content_array(v) for k, v in fit_params.items()},
+            )
+            for ci, si in cells:
+                cell_keys[(ci, si)] = tokenize(
+                    "cell", est_token, candidate_params[ci],
+                    splits[si][0], splits[si][1], sorted(scorers),
+                    self.return_train_score,
+                )
+        self.n_resumed_cells_ = sum(
+            1 for k in cell_keys.values() if k in (done_cells or {})
+        )
+
+        def run_cell(ci, si):
+            if journal is not None:
+                key = cell_keys[(ci, si)]
+                hit = done_cells.get(key)
+                if hit is not None:
+                    return hit
+                result = runner.run(candidate_params[ci], si)
+                if not result[-1]:  # journal only non-failed cells
+                    journal.append(key, result)
+                return result
+            return runner.run(candidate_params[ci], si)
+
         # Device-staging memo: jax-native candidates re-stage their CV slice
         # inside fit; within this scope identical (slice, role) pairs upload
         # once for the whole search (the analogue of the reference's
@@ -645,18 +714,16 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
 
         with staging_memo() as dmemo:
             if n_workers == 1:
-                results = [
-                    runner.run(candidate_params[ci], si) for ci, si in cells
-                ]
+                results = [run_cell(ci, si) for ci, si in cells]
             else:
                 with ThreadPoolExecutor(max_workers=n_workers) as pool:
                     futs = [
-                        pool.submit(runner.run, candidate_params[ci], si)
-                        for ci, si in cells
+                        pool.submit(run_cell, ci, si) for ci, si in cells
                     ]
                     results = [f.result() for f in futs]
         self.n_device_stagings_ = dmemo.n_stagings
         self.n_staging_hits_ = dmemo.hits
+        results = [r[:4] for r in results]  # drop the cell failure flag
 
         test_weights = None
         if self.iid:
@@ -771,11 +838,12 @@ class GridSearchCV(TPUBaseSearchCV):
     def __init__(self, estimator, param_grid, scoring=None, iid=True,
                  refit=True, cv=None, error_score="raise",
                  return_train_score=True, scheduler=None, n_jobs=-1,
-                 cache_cv=True):
+                 cache_cv=True, checkpoint=None):
         super().__init__(
             estimator, scoring=scoring, iid=iid, refit=refit, cv=cv,
             error_score=error_score, return_train_score=return_train_score,
             scheduler=scheduler, n_jobs=n_jobs, cache_cv=cache_cv,
+            checkpoint=checkpoint,
         )
         self.param_grid = param_grid
 
@@ -792,11 +860,12 @@ class RandomizedSearchCV(TPUBaseSearchCV):
     def __init__(self, estimator, param_distributions, n_iter=10, scoring=None,
                  iid=True, refit=True, cv=None, random_state=None,
                  error_score="raise", return_train_score=True, scheduler=None,
-                 n_jobs=-1, cache_cv=True):
+                 n_jobs=-1, cache_cv=True, checkpoint=None):
         super().__init__(
             estimator, scoring=scoring, iid=iid, refit=refit, cv=cv,
             error_score=error_score, return_train_score=return_train_score,
             scheduler=scheduler, n_jobs=n_jobs, cache_cv=cache_cv,
+            checkpoint=checkpoint,
         )
         self.param_distributions = param_distributions
         self.n_iter = n_iter

@@ -296,9 +296,11 @@ def _lbfgs_direction(g, S, Y, rho, count, head, m):
     return lax.fori_loop(0, m, fwd, r)
 
 
-@partial(jax.jit, static_argnames=("family", "regularizer", "max_iter", "m"))
+@partial(jax.jit, static_argnames=("family", "regularizer", "max_iter", "m",
+                                   "return_state"))
 def lbfgs(X, y, w, beta0, mask, *, family="logistic", regularizer="l2",
-          lamduh=0.0, max_iter=100, tol=1e-4, m=10):
+          lamduh=0.0, max_iter=100, tol=1e-4, m=10, state=None,
+          return_state=False):
     """L-BFGS with an m-pair circular history, entirely on device.
 
     The reference shells out to scipy's Fortran L-BFGS-B via dask-glm; here
@@ -306,6 +308,13 @@ def lbfgs(X, y, w, beta0, mask, *, family="logistic", regularizer="l2",
     same ``lax.while_loop`` as the data passes, so multi-chip meshes never
     sync with the host mid-solve. Like dask-glm, an l1 penalty here is
     handled by subgradient (prefer ``proximal_grad``/``admm`` for sparsity).
+
+    Checkpoint/resume (SURVEY §5.4): ``state`` is the full optimizer carry
+    ``(beta, g, f, S, Y, rho, count, head)`` from a previous call with
+    ``return_state=True``; resuming from it preserves the curvature history
+    exactly, so a chunked run (:func:`dask_ml_tpu.checkpoint.solve_checkpointed`)
+    takes the same trajectory as an uninterrupted one. ``n_iter`` counts only
+    the iterations performed in THIS call.
     """
     obj_full = _make_objective(family, regularizer, smooth_penalty=True)
     sw = jnp.maximum(jnp.sum(w), 1.0)
@@ -347,13 +356,18 @@ def lbfgs(X, y, w, beta0, mask, *, family="logistic", regularizer="l2",
         done = jnp.logical_or(gnorm < tol, rel)
         return beta_new, g_new, f_new, S, Y, rho, count, head, it + 1, done
 
-    f0, g0 = value_and_grad(beta0)
-    init = (beta0, g0, f0,
-            jnp.zeros((m, d), X.dtype), jnp.zeros((m, d), X.dtype),
-            jnp.zeros((m,), X.dtype), jnp.asarray(0, jnp.int32),
-            jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
-            jnp.asarray(False))
+    if state is None:
+        f0, g0 = value_and_grad(beta0)
+        carry0 = (beta0, g0, f0,
+                  jnp.zeros((m, d), X.dtype), jnp.zeros((m, d), X.dtype),
+                  jnp.zeros((m,), X.dtype), jnp.asarray(0, jnp.int32),
+                  jnp.asarray(0, jnp.int32))
+    else:
+        carry0 = tuple(jnp.asarray(s) for s in state)
+    init = carry0 + (jnp.asarray(0, jnp.int32), jnp.asarray(False))
     out = lax.while_loop(cond, body, init)
+    if return_state:
+        return out[0], out[8], out[:8]
     return out[0], out[8]
 
 
@@ -410,11 +424,18 @@ def proximal_grad(X, y, w, beta0, mask, *, family="logistic",
 
 @partial(jax.jit, static_argnames=("mesh", "family", "regularizer",
                                    "max_iter", "inner_max_iter"))
-def _admm_impl(X, y, w, beta0, mask, lamduh, rho, abstol, reltol, inner_tol,
-               *, mesh, family, regularizer, max_iter, inner_max_iter):
+def _admm_impl(X, y, w, beta0, x0, u0, mask, lamduh, rho, abstol, reltol,
+               inner_tol, *, mesh, family, regularizer, max_iter,
+               inner_max_iter):
     """Jitted ADMM body: the hyperparameter scalars are traced arguments so
     repeated fits with the same shapes/mesh hit the compile cache (the other
-    four solvers get this via module-level ``@jax.jit``)."""
+    four solvers get this via module-level ``@jax.jit``).
+
+    ``x0``/``u0`` are the per-shard primal/dual variables stacked along the
+    data axis as ``(n_shards, d)`` arrays (sharded ``P('data', None)``, one
+    row per shard) so the whole solver carry can round-trip through a host
+    checkpoint (SURVEY §5.4); returns ``(z, n_iter, x, u)`` with x/u in the
+    same stacked layout."""
     loss_fn, hess_fn = FAMILIES[family]
     _, pen_prox = _penalty(regularizer)
     n_shards = mesh.shape[DATA_AXIS]
@@ -424,11 +445,12 @@ def _admm_impl(X, y, w, beta0, mask, lamduh, rho, abstol, reltol, inner_tol,
         jax.shard_map,
         mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
-                  P(), P(), P(), P(), P(), P(), P()),
-        out_specs=(P(), P()),
+                  P(), P(DATA_AXIS, None), P(DATA_AXIS, None),
+                  P(), P(), P(), P(), P(), P()),
+        out_specs=(P(), P(), P(DATA_AXIS, None), P(DATA_AXIS, None)),
     )
-    def run(X_loc, y_loc, w_loc, z0, mask_, lamduh, rho, abstol, reltol,
-            inner_tol):
+    def run(X_loc, y_loc, w_loc, z0, x0_loc, u0_loc, mask_, lamduh, rho,
+            abstol, reltol, inner_tol):
         sw = jnp.maximum(lax.psum(jnp.sum(w_loc), DATA_AXIS), 1.0)
         lam_eff = lamduh / sw
 
@@ -485,21 +507,21 @@ def _admm_impl(X, y, w, beta0, mask, lamduh, rho, abstol, reltol, inner_tol,
             done = jnp.logical_and(jnp.sqrt(pri2) < eps_pri, dual < eps_dual)
             return z_new, x, u, it + 1, done
 
-        # x and u are per-shard state: mark them varying over the data axis
-        # so the while_loop carry types line up under shard_map's vma checks.
-        x0 = lax.pcast(z0, (DATA_AXIS,), to="varying")
-        u0 = lax.pcast(jnp.zeros((d,), X_loc.dtype), (DATA_AXIS,), to="varying")
-        init = (z0, x0, u0,
+        # x and u are per-shard state, handed in stacked: each shard's block
+        # is its own (1, d) row — already "varying" over the data axis, which
+        # lines the while_loop carry types up under shard_map's vma checks.
+        init = (z0, x0_loc[0], u0_loc[0],
                 jnp.asarray(0, jnp.int32), jnp.asarray(False))
-        z, _, _, n_iter, _ = lax.while_loop(cond, body, init)
-        return z, n_iter
+        z, x, u, n_iter, _ = lax.while_loop(cond, body, init)
+        return z, n_iter, x[None, :], u[None, :]
 
-    return run(X, y, w, beta0, mask, lamduh, rho, abstol, reltol, inner_tol)
+    return run(X, y, w, beta0, x0, u0, mask, lamduh, rho, abstol, reltol,
+               inner_tol)
 
 
 def admm(X, y, w, beta0, mask, mesh, *, family="logistic", regularizer="l2",
          lamduh=0.0, rho=1.0, max_iter=250, abstol=1e-4, reltol=1e-2,
-         inner_max_iter=20, inner_tol=1e-8):
+         inner_max_iter=20, inner_tol=1e-8, state=None, return_state=False):
     """Consensus ADMM over the data mesh (Boyd et al. §7.1.1).
 
     The genuinely distributed solver: each shard keeps local primal/dual
@@ -513,13 +535,40 @@ def admm(X, y, w, beta0, mask, mesh, *, family="logistic", regularizer="l2",
     The z-update prox uses t = lamduh_eff/(rho·N); padding rows have w=0 and
     drop out of every local sum. Defaults mirror dask-glm's admm
     (rho=1, abstol=1e-4, reltol=1e-2, max_iter=250).
+
+    Checkpoint/resume (SURVEY §5.4): ``state = (z, x, u)`` with x/u the
+    per-shard primal/dual variables stacked ``(n_shards, d)``; pass a state
+    from a previous ``return_state=True`` call to continue the consensus
+    exactly where it stopped. ``n_iter`` counts this call's iterations only.
+    Unlike the L-BFGS carry, ADMM state is bound to the data-axis shard
+    count (each shard owns its consensus subproblem): resuming on a mesh
+    with a different number of shards is rejected.
     """
     dt = X.dtype
+    d = X.shape[1]
+    n_shards = mesh.shape[DATA_AXIS]
+    if state is None:
+        z0 = beta0
+        x0 = jnp.broadcast_to(beta0, (n_shards, d)).astype(dt)
+        u0 = jnp.zeros((n_shards, d), dt)
+    else:
+        z0, x0, u0 = (jnp.asarray(s, dt) for s in state)
+        if x0.shape != (n_shards, d) or u0.shape != (n_shards, d):
+            raise ValueError(
+                f"ADMM state has per-shard x/u of shape {x0.shape}, but this "
+                f"mesh has {n_shards} data shards (expected {(n_shards, d)}); "
+                "ADMM consensus state cannot move between meshes with "
+                "different shard counts"
+            )
     scalars = [jnp.asarray(v, dt) for v in (lamduh, rho, abstol, reltol,
                                             inner_tol)]
-    return _admm_impl(X, y, w, beta0, mask, *scalars, mesh=mesh,
-                      family=family, regularizer=regularizer,
-                      max_iter=int(max_iter), inner_max_iter=int(inner_max_iter))
+    z, n_iter, x, u = _admm_impl(
+        X, y, w, z0, x0, u0, mask, *scalars, mesh=mesh, family=family,
+        regularizer=regularizer, max_iter=int(max_iter),
+        inner_max_iter=int(inner_max_iter))
+    if return_state:
+        return z, n_iter, (z, x, u)
+    return z, n_iter
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,421 @@
+"""Checkpoint/resume: solver-state threading and search-cell journaling.
+
+The reference has no checkpointing (SURVEY §5.4: persistence = pickling a
+fitted estimator, test_model_selection_sklearn.py:892); these tests pin down
+the capability-parity-plus contract this build adds: a killed long-running
+fit or search resumes from disk and produces results identical to an
+uninterrupted run.
+"""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dask_ml_tpu import checkpoint as ckpt
+from dask_ml_tpu.models import glm as glm_core
+from dask_ml_tpu.parallel import mesh as mesh_lib
+from dask_ml_tpu.parallel.sharding import prepare_data
+
+
+def _logreg_problem(n=600, d=5, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    beta_true = rng.randn(d).astype(np.float32)
+    y = (X @ beta_true + 0.3 * rng.randn(n) > 0).astype(np.float32)
+    return X, y
+
+
+@pytest.fixture
+def staged(any_mesh):
+    X, y = _logreg_problem()
+    data = prepare_data(X, y=y, mesh=any_mesh)
+    mask = jnp.ones((X.shape[1],), jnp.float32)
+    beta0 = jnp.zeros((X.shape[1],), jnp.float32)
+    return data, beta0, mask, any_mesh
+
+
+# ---------------------------------------------------------------------------
+# solver-state threading: chunked == uninterrupted
+# ---------------------------------------------------------------------------
+
+
+def test_lbfgs_state_chunks_match_single_run(staged):
+    data, beta0, mask, _ = staged
+    kw = dict(family="logistic", regularizer="l2", lamduh=0.1, tol=0.0)
+
+    beta_full, _ = glm_core.lbfgs(
+        data.X, data.y, data.weights, beta0, mask, max_iter=30, **kw)
+
+    # same 30 iterations as 3 chunks of 10 with the carry threaded through
+    state = None
+    beta = beta0
+    for _ in range(3):
+        beta, _, state = glm_core.lbfgs(
+            data.X, data.y, data.weights, beta, mask, max_iter=10,
+            state=state, return_state=True, **kw)
+
+    # not bitwise: the 30-iter and 10-iter programs compile separately and
+    # XLA's fusion choices differ at f32 rounding level
+    np.testing.assert_allclose(np.asarray(beta), np.asarray(beta_full),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_admm_state_chunks_match_single_run(staged):
+    data, beta0, mask, mesh = staged
+    kw = dict(family="logistic", regularizer="l2", lamduh=0.5,
+              abstol=0.0, reltol=0.0)  # run every budgeted iteration
+
+    z_full, _ = glm_core.admm(
+        data.X, data.y, data.weights, beta0, mask, mesh, max_iter=12, **kw)
+
+    state = None
+    z = beta0
+    for _ in range(4):
+        z, _, state = glm_core.admm(
+            data.X, data.y, data.weights, z, mask, mesh, max_iter=3,
+            state=state, return_state=True, **kw)
+
+    np.testing.assert_allclose(np.asarray(z), np.asarray(z_full),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_admm_state_roundtrips_through_host(staged, tmp_path):
+    """The carry survives device→disk→device (a different run could place it
+    on a different mesh)."""
+    data, beta0, mask, mesh = staged
+    kw = dict(family="logistic", regularizer="l1", lamduh=0.5,
+              abstol=0.0, reltol=0.0)
+    z1, _, state = glm_core.admm(
+        data.X, data.y, data.weights, beta0, mask, mesh, max_iter=4,
+        state=None, return_state=True, **kw)
+
+    path = str(tmp_path / "admm.ckpt")
+    ckpt.save_pytree(path, {"state": state}, meta={"solver": "admm"})
+    tree, meta = ckpt.load_pytree(path)
+    assert meta["solver"] == "admm"
+    restored = tree["state"]
+    assert isinstance(restored[1], np.ndarray)  # host-side after save
+
+    z2a, _, _ = glm_core.admm(
+        data.X, data.y, data.weights, z1, mask, mesh, max_iter=3,
+        state=state, return_state=True, **kw)
+    z2b, _, _ = glm_core.admm(
+        data.X, data.y, data.weights, z1, mask, mesh, max_iter=3,
+        state=tuple(restored), return_state=True, **kw)
+    np.testing.assert_allclose(np.asarray(z2a), np.asarray(z2b),
+                               rtol=1e-6, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# solve_checkpointed: kill-and-resume
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("solver", ["lbfgs", "admm", "newton"])
+def test_solve_checkpointed_kill_and_resume(staged, tmp_path, solver):
+    data, beta0, mask, mesh = staged
+    path = str(tmp_path / f"{solver}.ckpt")
+    kw = dict(family="logistic", regularizer="l2", lamduh=0.1)
+    if solver in ("lbfgs", "newton"):
+        kw["tol"] = 0.0
+    else:
+        kw.update(abstol=0.0, reltol=0.0)
+
+    # uninterrupted oracle: same chunking, no kill (an exact-stationarity
+    # early exit, possible in f32 even at tol=0, affects both runs equally)
+    beta_full, it_full = ckpt.solve_checkpointed(
+        solver, data.X, data.y, data.weights, beta0, mask, mesh,
+        path=str(tmp_path / "oracle.ckpt"), chunk_iters=4, max_iter=16, **kw)
+
+    # "killed" run: at most the first two chunks happen
+    beta_part, it_part = ckpt.solve_checkpointed(
+        solver, data.X, data.y, data.weights, beta0, mask, mesh,
+        path=path, chunk_iters=4, max_iter=8, **kw)
+    assert it_part <= 8
+
+    # resume from the snapshot and finish
+    beta_res, it_res = ckpt.solve_checkpointed(
+        solver, data.X, data.y, data.weights, beta0, mask, mesh,
+        path=path, chunk_iters=4, max_iter=16, **kw)
+    assert it_res == it_full
+    np.testing.assert_allclose(np.asarray(beta_res), np.asarray(beta_full),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_solve_checkpointed_converged_short_circuits(staged, tmp_path):
+    data, beta0, mask, _ = staged
+    path = str(tmp_path / "conv.ckpt")
+    kw = dict(family="logistic", regularizer="l2", lamduh=0.1, tol=1e-3)
+    beta1, it1 = ckpt.solve_checkpointed(
+        "lbfgs", data.X, data.y, data.weights, beta0, mask,
+        path=path, chunk_iters=50, max_iter=200, **kw)
+    assert it1 < 200  # converged
+    _, meta = ckpt.load_pytree(path)
+    assert meta["converged"]
+    # a re-run loads the converged snapshot and does no more work
+    beta2, it2 = ckpt.solve_checkpointed(
+        "lbfgs", data.X, data.y, data.weights, beta0, mask,
+        path=path, chunk_iters=50, max_iter=200, **kw)
+    assert it2 == it1
+    np.testing.assert_array_equal(np.asarray(beta1), np.asarray(beta2))
+
+
+def test_solve_checkpointed_rejects_wrong_solver(staged, tmp_path):
+    data, beta0, mask, _ = staged
+    path = str(tmp_path / "mix.ckpt")
+    ckpt.solve_checkpointed(
+        "newton", data.X, data.y, data.weights, beta0, mask,
+        path=path, chunk_iters=2, max_iter=2, family="logistic",
+        regularizer="l2", lamduh=0.1, tol=0.0)
+    with pytest.raises(ValueError, match="written by solver"):
+        ckpt.solve_checkpointed(
+            "lbfgs", data.X, data.y, data.weights, beta0, mask,
+            path=path, chunk_iters=2, max_iter=4, family="logistic",
+            regularizer="l2", lamduh=0.1, tol=0.0)
+
+
+def test_save_pytree_atomic_overwrite(tmp_path):
+    path = str(tmp_path / "snap.ckpt")
+    ckpt.save_pytree(path, {"a": np.arange(3)}, meta={"step": 1})
+    ckpt.save_pytree(path, {"a": np.arange(4)}, meta={"step": 2})
+    tree, meta = ckpt.load_pytree(path)
+    assert meta["step"] == 2
+    np.testing.assert_array_equal(tree["a"], np.arange(4))
+    assert ckpt.load_pytree(str(tmp_path / "missing.ckpt")) is None
+
+
+# ---------------------------------------------------------------------------
+# search checkpointing: kill-and-resume with identical cv_results_
+# ---------------------------------------------------------------------------
+
+
+from sklearn.base import BaseEstimator
+
+
+class _FlakyKMeansLike(BaseEstimator):
+    """Minimal estimator whose fit can be made to fail after N calls,
+    simulating a mid-search kill under error_score='raise'."""
+
+    fail_after = None  # class-level switch: int or None
+    n_fits = 0
+
+    def __init__(self, c=1.0):
+        self.c = c
+
+    def fit(self, X, y=None):
+        cls = type(self)
+        cls.n_fits += 1
+        if cls.fail_after is not None and cls.n_fits > cls.fail_after:
+            raise RuntimeError("killed")
+        self.mean_ = float(np.mean(X)) + self.c
+        return self
+
+    def score(self, X, y=None):
+        return -abs(float(np.mean(X)) + self.c - self.mean_) - self.c**2
+
+
+@pytest.fixture(autouse=True)
+def _reset_flaky():
+    _FlakyKMeansLike.fail_after = None
+    _FlakyKMeansLike.n_fits = 0
+    yield
+    _FlakyKMeansLike.fail_after = None
+
+
+def _cv_results_equal(a, b):
+    assert set(a) == set(b)
+    for k in a:
+        if "_time" in k:  # wall-clock, differs between runs by nature
+            continue
+        va, vb = np.asarray(a[k]), np.asarray(b[k])
+        if va.dtype.kind in "fc":
+            np.testing.assert_allclose(va, vb, rtol=1e-12, equal_nan=True)
+        elif k != "params":
+            np.testing.assert_array_equal(va, vb)
+
+
+def test_search_kill_and_resume_identical_results(tmp_path):
+    from dask_ml_tpu.model_selection import GridSearchCV
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(60, 3)
+    grid = {"c": [0.1, 0.2, 0.3, 0.4, 0.5, 0.6]}
+    path = str(tmp_path / "search.journal")
+
+    # oracle: uninterrupted, no checkpoint
+    oracle = GridSearchCV(_FlakyKMeansLike(), grid, cv=2, refit=False,
+                          n_jobs=1)
+    oracle.fit(X)
+
+    # run 1: dies partway through (deterministic with n_jobs=1)
+    _FlakyKMeansLike.n_fits = 0
+    _FlakyKMeansLike.fail_after = 5
+    gs = GridSearchCV(_FlakyKMeansLike(), grid, cv=2, refit=False,
+                      n_jobs=1, checkpoint=path)
+    with pytest.raises(RuntimeError, match="killed"):
+        gs.fit(X)
+    assert os.path.exists(path)
+
+    # run 2: resume — completed cells come from the journal
+    _FlakyKMeansLike.fail_after = None
+    _FlakyKMeansLike.n_fits = 0
+    gs2 = GridSearchCV(_FlakyKMeansLike(), grid, cv=2, refit=False,
+                       n_jobs=1, checkpoint=path)
+    gs2.fit(X)
+    assert gs2.n_resumed_cells_ == 5
+    assert _FlakyKMeansLike.n_fits == 12 - 5  # only the remainder ran
+    _cv_results_equal(gs2.cv_results_, oracle.cv_results_)
+
+    # run 3: everything restored, zero fits
+    _FlakyKMeansLike.n_fits = 0
+    gs3 = GridSearchCV(_FlakyKMeansLike(), grid, cv=2, refit=False,
+                       n_jobs=1, checkpoint=path)
+    gs3.fit(X)
+    assert gs3.n_resumed_cells_ == 12
+    assert _FlakyKMeansLike.n_fits == 0
+    _cv_results_equal(gs3.cv_results_, oracle.cv_results_)
+
+
+def test_search_checkpoint_invalidates_on_grid_change(tmp_path):
+    from dask_ml_tpu.model_selection import GridSearchCV
+
+    rng = np.random.RandomState(1)
+    X = rng.randn(40, 3)
+    path = str(tmp_path / "search.journal")
+
+    GridSearchCV(_FlakyKMeansLike(), {"c": [0.1, 0.2]}, cv=2, refit=False,
+                 n_jobs=1, checkpoint=path).fit(X)
+
+    # different candidate values: no journal hits, fresh fits
+    _FlakyKMeansLike.n_fits = 0
+    gs = GridSearchCV(_FlakyKMeansLike(), {"c": [0.7, 0.9]}, cv=2,
+                      refit=False, n_jobs=1, checkpoint=path)
+    gs.fit(X)
+    assert gs.n_resumed_cells_ == 0
+    assert _FlakyKMeansLike.n_fits == 4  # 2 candidates x 2 splits, all fresh
+
+
+def test_search_checkpoint_threaded_matches(tmp_path):
+    """The journal is thread-safe: a threaded resumed search reproduces the
+    single-threaded oracle."""
+    from dask_ml_tpu.model_selection import GridSearchCV
+
+    rng = np.random.RandomState(2)
+    X = rng.randn(50, 3)
+    grid = {"c": [0.1, 0.2, 0.3, 0.4]}
+    path = str(tmp_path / "search.journal")
+
+    oracle = GridSearchCV(_FlakyKMeansLike(), grid, cv=2, refit=False,
+                          n_jobs=1).fit(X)
+    GridSearchCV(_FlakyKMeansLike(), grid, cv=2, refit=False,
+                 n_jobs=4, checkpoint=path).fit(X)
+    gs = GridSearchCV(_FlakyKMeansLike(), grid, cv=2, refit=False,
+                      n_jobs=4, checkpoint=path)
+    gs.fit(X)
+    assert gs.n_resumed_cells_ == 8
+    _cv_results_equal(gs.cv_results_, oracle.cv_results_)
+
+
+def test_search_checkpoint_invalidates_on_data_change(tmp_path):
+    """Same shapes, different values: journal keys hash data CONTENT, so a
+    re-fit on corrected data never restores stale results."""
+    from dask_ml_tpu.model_selection import GridSearchCV
+
+    rng = np.random.RandomState(3)
+    X1 = rng.randn(40, 3)
+    X2 = X1 + 1.0  # same shape, different content → same KFold indices
+    path = str(tmp_path / "search.journal")
+    grid = {"c": [0.1, 0.2]}
+
+    GridSearchCV(_FlakyKMeansLike(), grid, cv=2, refit=False, n_jobs=1,
+                 checkpoint=path).fit(X1)
+    gs = GridSearchCV(_FlakyKMeansLike(), grid, cv=2, refit=False, n_jobs=1,
+                      checkpoint=path)
+    gs.fit(X2)
+    assert gs.n_resumed_cells_ == 0
+
+
+def test_search_checkpoint_does_not_persist_failures(tmp_path):
+    """Transient failures under a numeric error_score retry on resume
+    instead of being restored as error scores."""
+    from dask_ml_tpu.model_selection import GridSearchCV
+
+    rng = np.random.RandomState(4)
+    X = rng.randn(40, 3)
+    grid = {"c": [0.1, 0.2, 0.3]}
+    path = str(tmp_path / "search.journal")
+
+    oracle = GridSearchCV(_FlakyKMeansLike(), grid, cv=2, refit=False,
+                          n_jobs=1, error_score=-99.0).fit(X)
+
+    # run 1: last 2 cells fail "transiently" and are scored error_score
+    _FlakyKMeansLike.n_fits = 0
+    _FlakyKMeansLike.fail_after = 4
+    gs1 = GridSearchCV(_FlakyKMeansLike(), grid, cv=2, refit=False,
+                       n_jobs=1, error_score=-99.0, checkpoint=path)
+    gs1.fit(X)
+    assert np.sum(gs1.cv_results_["split0_test_score"] == -99.0) + np.sum(
+        gs1.cv_results_["split1_test_score"] == -99.0) == 2
+
+    # run 2: failures were NOT journaled → they refit and now succeed
+    _FlakyKMeansLike.fail_after = None
+    _FlakyKMeansLike.n_fits = 0
+    gs2 = GridSearchCV(_FlakyKMeansLike(), grid, cv=2, refit=False,
+                       n_jobs=1, error_score=-99.0, checkpoint=path)
+    gs2.fit(X)
+    assert gs2.n_resumed_cells_ == 4
+    assert _FlakyKMeansLike.n_fits == 2
+    _cv_results_equal(gs2.cv_results_, oracle.cv_results_)
+
+
+def test_solve_checkpointed_rejects_changed_problem(staged, tmp_path):
+    data, beta0, mask, _ = staged
+    path = str(tmp_path / "fp.ckpt")
+    kw = dict(family="logistic", regularizer="l2", lamduh=0.1, tol=0.0)
+    ckpt.solve_checkpointed(
+        "lbfgs", data.X, data.y, data.weights, beta0, mask,
+        path=path, chunk_iters=2, max_iter=2, **kw)
+    # different data content at the same path → hard error, not a silent
+    # resume of the wrong problem
+    with pytest.raises(ValueError, match="different problem"):
+        ckpt.solve_checkpointed(
+            "lbfgs", data.X * 2.0, data.y, data.weights, beta0, mask,
+            path=path, chunk_iters=2, max_iter=4, **kw)
+    # different hyperparameters too
+    with pytest.raises(ValueError, match="different problem"):
+        ckpt.solve_checkpointed(
+            "lbfgs", data.X, data.y, data.weights, beta0, mask,
+            path=path, chunk_iters=2, max_iter=4, family="logistic",
+            regularizer="l2", lamduh=0.7, tol=0.0)
+
+
+def test_cell_journal_tolerates_torn_tail(tmp_path):
+    from dask_ml_tpu.checkpoint import CellJournal
+
+    path = str(tmp_path / "j.journal")
+    j = CellJournal(path)
+    j.append("k1", ({"score": 1.0}, None, 0.1, 0.2))
+    j.append("k2", ({"score": 2.0}, None, 0.1, 0.2))
+    # simulate a kill mid-append: truncate the last frame
+    with open(path, "rb") as f:
+        raw = f.read()
+    with open(path, "wb") as f:
+        f.write(raw[:-7])
+    done = CellJournal(path).load()
+    assert done == {"k1": ({"score": 1.0}, None, 0.1, 0.2)}
+
+
+def test_cell_journal_roundtrip_is_pickle_frames(tmp_path):
+    path = str(tmp_path / "j.journal")
+    j = ckpt.CellJournal(path)
+    records = {f"k{i}": ({"score": float(i)}, None, 0.0, 0.0)
+               for i in range(5)}
+    for k, v in records.items():
+        j.append(k, v)
+    assert ckpt.CellJournal(path).load() == records
+    with open(path, "rb") as f:  # frames are plain pickle
+        assert pickle.load(f)[0] == "k0"
