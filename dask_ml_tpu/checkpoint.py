@@ -60,13 +60,14 @@ def save_pytree(path: str, tree: Any, meta: Optional[dict] = None) -> None:
     """Atomically snapshot ``(tree, meta)`` to ``path``.
 
     The tree is pulled to host (numpy) first so the snapshot is
-    device-independent; a resumed run re-places it through its own jit
-    shardings. (Whether a carry is *meaningful* on a different mesh is the
-    solver's contract: L-BFGS state is mesh-independent, ADMM's per-shard
-    consensus state is bound to the data-axis shard count and rejected on
-    mismatch — see ``models/glm.py``.) Atomicity: write to a temp file in
-    the same directory, fsync, then ``os.replace`` — a kill mid-save leaves
-    the previous snapshot intact.
+    device-independent and a resumed run re-places it through its own jit
+    shardings. Note that :func:`solve_checkpointed` still binds a snapshot
+    to its *staged problem* (shapes include mesh padding, and the content
+    checksum reflects the staging's reduction order), so its resume path
+    expects the same mesh/data staging as the original run; the snapshot
+    FORMAT carries no device state. Atomicity: write to a temp file in the
+    same directory, fsync, then ``os.replace`` — a kill mid-save leaves the
+    previous snapshot intact.
     """
     payload = {"tree": _to_host(tree), "meta": meta or {}}
     d = os.path.dirname(os.path.abspath(path)) or "."
@@ -109,16 +110,19 @@ def load_pytree(path: str):
 STATEFUL_SOLVERS = ("lbfgs", "admm")
 
 
-def _problem_fingerprint(solver, X, y, w, mask, **kwargs) -> str:
+def _problem_fingerprint(solver, X, y, w, beta0, mask, **kwargs) -> str:
     """Cheap content fingerprint binding a snapshot to its fit problem.
 
     A full host hash of X would defeat the point on a real TPU (the data may
     be tens of GB behind a slow host link), so the checksum is computed ON
     DEVICE as a handful of weighted moments — one tiny fetch — plus shapes,
-    dtypes, and every hyperparameter. Any changed dataset/label/weight
-    content or solver config changes the fingerprint with overwhelming
-    probability, and a mismatched resume is rejected instead of silently
-    returning another problem's solution.
+    dtypes, the requested start point ``beta0``, and every hyperparameter.
+    Any changed dataset/label/weight content, warm start, or solver config
+    changes the fingerprint with overwhelming probability, and a mismatched
+    resume is rejected instead of silently returning another problem's
+    solution. The binding is to the problem AS STAGED: shapes include mesh
+    padding and f32 sums reflect the sharding's reduction order, so resume
+    expects the same mesh/data staging as the run that wrote the snapshot.
     """
     import hashlib
 
@@ -139,7 +143,7 @@ def _problem_fingerprint(solver, X, y, w, mask, **kwargs) -> str:
         solver,
         tuple(getattr(X, "shape", ())), str(getattr(X, "dtype", "")),
         tuple(getattr(y, "shape", ())) if y is not None else None,
-        moments(X), moments(y), moments(w), moments(mask),
+        moments(X), moments(y), moments(w), moments(beta0), moments(mask),
         sorted((k, repr(v)) for k, v in kwargs.items()),
     ):
         h.update(repr(part).encode())
@@ -170,7 +174,9 @@ def solve_checkpointed(solver: str, X, y, w, beta0, mask, mesh=None, *,
 
     if solver not in glm_core.SOLVERS:
         raise ValueError(f"unknown solver {solver!r}")
-    fingerprint = _problem_fingerprint(solver, X, y, w, mask, **kwargs)
+    if solver == "admm" and mesh is None:
+        raise ValueError("admm requires a mesh")
+    fingerprint = _problem_fingerprint(solver, X, y, w, beta0, mask, **kwargs)
 
     state = None
     iters_done = 0

@@ -103,14 +103,40 @@ class _GLM(BaseEstimator):
         X = check_array(X)
         y = self._encode_y(y)
         mesh = mesh_lib.default_mesh()
-        data = prepare_data(X, y=y, sample_weight=sample_weight, mesh=mesh,
-                            y_dtype=jnp.float32)
-        Xd = add_intercept(data.X) if self.fit_intercept else data.X
-        d = int(Xd.shape[1])
-        # Penalty mask: exclude the intercept column from regularization.
-        mask = np.ones(d, dtype=np.float32)
-        if self.fit_intercept:
-            mask[-1] = 0.0
+        # Feature-axis tensor parallelism (SURVEY §2.9): on a 2-D
+        # ('data', 'model') mesh the jit-compiled solvers shard X over BOTH
+        # axes — XLA partitions the O(n·d²) Hessian/Gram matmuls and their
+        # (d, d) outputs over the model axis, inserting the d-axis psums
+        # itself. ADMM is excluded: its shard_map program keeps per-shard
+        # d-vectors, a layout that is data-parallel by construction.
+        tensor_parallel = (
+            mesh_lib.n_model_shards(mesh) > 1 and self.solver != "admm"
+        )
+        if tensor_parallel:
+            # the intercept joins as a TRUE column (before feature padding)
+            # inside prepare_data, keeping the staging memo keyed on the
+            # caller's X so search cells share one staged copy per CV slice
+            data = prepare_data(X, y=y, sample_weight=sample_weight,
+                                mesh=mesh, y_dtype=jnp.float32,
+                                shard_features=True,
+                                append_ones=self.fit_intercept)
+            Xd = data.X
+            d = int(Xd.shape[1])  # padded width
+            d_true = data.n_features
+            n_feat = d_true - 1 if self.fit_intercept else d_true
+            # penalize only the real feature columns (not intercept, not
+            # zero padding — padded coords stay 0 under the ridge/prox)
+            mask = np.zeros(d, dtype=np.float32)
+            mask[:n_feat] = 1.0
+        else:
+            data = prepare_data(X, y=y, sample_weight=sample_weight,
+                                mesh=mesh, y_dtype=jnp.float32)
+            Xd = add_intercept(data.X) if self.fit_intercept else data.X
+            d = d_true = int(Xd.shape[1])
+            # Penalty mask: exclude the intercept column from regularization.
+            mask = np.ones(d, dtype=np.float32)
+            if self.fit_intercept:
+                mask[-1] = 0.0
         beta0 = jnp.zeros((d,), Xd.dtype)
         kwargs = self._get_solver_kwargs()
         with profile_phase(logger, f"glm-{self.solver}"):
@@ -118,7 +144,7 @@ class _GLM(BaseEstimator):
                 self.solver, Xd, data.y, data.weights, beta0,
                 jnp.asarray(mask), mesh=mesh, **kwargs,
             )
-        self._coef = np.asarray(beta)
+        self._coef = np.asarray(beta)[:d_true]  # drop feature padding
         self.n_iter_ = int(n_iter)
         if self.fit_intercept:
             self.coef_ = self._coef[:-1]

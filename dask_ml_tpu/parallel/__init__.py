@@ -13,8 +13,11 @@ from dask_ml_tpu.parallel.mesh import (  # noqa: F401
     MODEL_AXIS,
     data_sharding,
     default_mesh,
+    feature_sharding,
+    make_2d_mesh,
     make_mesh,
     n_data_shards,
+    n_model_shards,
     replicated_sharding,
     use_mesh,
 )
@@ -22,6 +25,7 @@ from dask_ml_tpu.parallel.sharding import (  # noqa: F401
     DeviceData,
     pad_rows,
     prepare_data,
+    shard_2d,
     shard_rows,
     unpad_rows,
 )

@@ -80,15 +80,51 @@ def use_mesh(mesh: Mesh):
         _mesh_stack.pop()
 
 
+def make_2d_mesh(
+    n_data: int,
+    n_model: int,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """An ``(n_data, n_model)`` mesh with axes ``('data', 'model')``.
+
+    The TPU analogue of SURVEY §2.9's 1-D tensor parallelism: the sample
+    axis shards over ``data`` as usual, and the FEATURE axis shards over
+    ``model`` so the O(n·d²) Gram/Hessian work (and its (d, d) outputs)
+    split across devices — parallelism the reference forbids outright
+    (reference: utils.py:120-125 "feature axis must be one chunk"). Keep
+    the model axis within a slice: its collectives (the d-axis psums of
+    ``X.T @ …``) are chattier than the data axis's.
+    """
+    return make_mesh(devices=devices, shape=(n_data, n_model),
+                     axis_names=(DATA_AXIS, MODEL_AXIS))
+
+
 def n_data_shards(mesh: Optional[Mesh] = None) -> int:
     mesh = mesh or default_mesh()
     return mesh.shape[DATA_AXIS]
+
+
+def n_model_shards(mesh: Optional[Mesh] = None) -> int:
+    """Size of the feature-parallel axis; 1 on a data-only mesh."""
+    mesh = mesh or default_mesh()
+    return mesh.shape.get(MODEL_AXIS, 1)
 
 
 def data_sharding(mesh: Optional[Mesh] = None, ndim: int = 2) -> NamedSharding:
     """Axis-0 ("sample"-axis) sharding: ``P('data', None, ...)``."""
     mesh = mesh or default_mesh()
     return NamedSharding(mesh, PartitionSpec(DATA_AXIS, *([None] * (ndim - 1))))
+
+
+def feature_sharding(mesh: Optional[Mesh] = None, ndim: int = 2) -> NamedSharding:
+    """Both-axes sharding for (n, d) data on a 2-D mesh:
+    ``P('data', 'model')`` (or ``P('model')`` for per-feature vectors)."""
+    mesh = mesh or default_mesh()
+    if ndim == 1:
+        return NamedSharding(mesh, PartitionSpec(MODEL_AXIS))
+    return NamedSharding(
+        mesh, PartitionSpec(DATA_AXIS, MODEL_AXIS, *([None] * (ndim - 2)))
+    )
 
 
 def replicated_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:

@@ -91,6 +91,24 @@ def _current_memo() -> Optional[StagingMemo]:
     return _memo_stack[-1] if _memo_stack else None
 
 
+def _content_key(a) -> Optional[str]:
+    """Content-hash key part for SMALL per-row arrays (y, sample_weight).
+
+    Estimator facades re-encode y on every fit (label remapping allocates a
+    fresh array), so identity keying would defeat the staging memo for every
+    supervised fit in a search. Hashing the bytes of a 1-D label/weight
+    vector is cheap next to staging X; X itself stays identity-keyed."""
+    if a is None:
+        return None
+    import hashlib
+
+    arr = np.ascontiguousarray(np.asarray(a))
+    if arr.dtype == object:  # unhashable content: fall back to identity
+        return f"id:{id(a)}"
+    h = hashlib.sha256(arr.tobytes())
+    return f"{arr.shape}:{arr.dtype}:{h.hexdigest()[:24]}"
+
+
 def pad_rows(n: int, n_shards: int) -> int:
     """Rows of padding needed to make ``n`` divisible by ``n_shards``."""
     return (-n) % n_shards
@@ -154,6 +172,32 @@ def row_weights(
     )
 
 
+def shard_2d(
+    x: ArrayLike,
+    mesh: Optional[Mesh] = None,
+    dtype=None,
+) -> tuple[jax.Array, int, int]:
+    """Pad BOTH axes of an (n, d) array and place it ``P('data', 'model')``
+    on a 2-D mesh — sample shards over ``data``, features over ``model``
+    (SURVEY §2.9 1-D tensor parallelism; the reference forbids feature
+    chunking, utils.py:120-125). Returns ``(sharded, n_valid, d_valid)``.
+
+    Padding columns are zeros: zero features contribute nothing to linear
+    predictors, gradients, or Gram matrices, so weight-aware algorithm
+    cores need no extra masking for them (their coefficients stay 0 under
+    any ridge/prox that fixes 0 at 0; callers slice results back to
+    ``d_valid``).
+    """
+    mesh = mesh or mesh_lib.default_mesh()
+    x = jnp.asarray(x, dtype=dtype)
+    n, d = int(x.shape[0]), int(x.shape[1])
+    pad_n = pad_rows(n, mesh_lib.n_data_shards(mesh))
+    pad_d = pad_rows(d, mesh_lib.n_model_shards(mesh))
+    if pad_n or pad_d:
+        x = jnp.pad(x, [(0, pad_n), (0, pad_d)])
+    return jax.device_put(x, mesh_lib.feature_sharding(mesh)), n, d
+
+
 def unpad_rows(x: ArrayLike, n_valid: int) -> jax.Array:
     """Drop padding rows from a padded per-row result (labels, transforms)."""
     return jnp.asarray(x)[:n_valid]
@@ -176,11 +220,13 @@ class DeviceData:
     sees a ``DeviceData`` the layout and dtype invariants hold.
     """
 
-    X: jax.Array  # (n_padded, d), sharded P('data', None)
+    X: jax.Array  # (n_padded, d_padded), sharded P('data', None) or
+    #               P('data', 'model') when feature-sharded
     weights: jax.Array  # (n_padded,), sharded P('data'); 0 on padding
     n: int  # true number of rows
     y: Optional[jax.Array] = None  # (n_padded, ...), sharded, 0-padded
     mesh: Optional[Mesh] = None
+    d: Optional[int] = None  # true feature count when columns are padded
 
     @property
     def n_padded(self) -> int:
@@ -188,7 +234,8 @@ class DeviceData:
 
     @property
     def n_features(self) -> int:
-        return int(self.X.shape[1])
+        """TRUE feature count (excludes feature-axis padding columns)."""
+        return int(self.X.shape[1]) if self.d is None else self.d
 
 
 def prepare_data(
@@ -198,8 +245,18 @@ def prepare_data(
     mesh: Optional[Mesh] = None,
     dtype=None,
     y_dtype=None,
+    shard_features: bool = False,
+    append_ones: bool = False,
 ) -> DeviceData:
     """Stage ``(X, y, sample_weight)`` onto the mesh as a :class:`DeviceData`.
+
+    ``shard_features=True`` on a mesh with a ``model`` axis additionally
+    shards the feature axis (``P('data', 'model')`` via :func:`shard_2d`);
+    on a data-only mesh it is a no-op, so callers can pass it
+    unconditionally. ``append_ones=True`` appends an intercept column as a
+    TRUE column before any feature padding — done HERE (not by the caller)
+    so the staging memo still keys on the identity of the caller's original
+    array and search cells sharing a CV slice share one staged copy.
 
     Inside a :func:`staging_memo` scope, repeated calls on the same source
     objects return the already-staged ``DeviceData`` (one transfer per
@@ -208,17 +265,28 @@ def prepare_data(
     memo = _current_memo()
     if memo is not None:
         return memo.get_or_stage(
-            ("data", id(X), id(y), id(sample_weight), id(mesh),
-             str(dtype), str(y_dtype)),
+            ("data", id(X), _content_key(y), _content_key(sample_weight),
+             id(mesh), str(dtype), str(y_dtype), bool(shard_features),
+             bool(append_ones)),
             (X, y, sample_weight, mesh),
             lambda: _prepare_data_impl(X, y, sample_weight, mesh, dtype,
-                                       y_dtype),
+                                       y_dtype, shard_features, append_ones),
         )
-    return _prepare_data_impl(X, y, sample_weight, mesh, dtype, y_dtype)
+    return _prepare_data_impl(X, y, sample_weight, mesh, dtype, y_dtype,
+                              shard_features, append_ones)
 
 
-def _prepare_data_impl(X, y, sample_weight, mesh, dtype, y_dtype):
-    Xs, n = shard_rows(X, mesh=mesh, dtype=dtype)
+def _prepare_data_impl(X, y, sample_weight, mesh, dtype, y_dtype,
+                       shard_features=False, append_ones=False):
+    if append_ones:
+        Xa = np.asarray(X)
+        X = np.concatenate(
+            [Xa, np.ones((Xa.shape[0], 1), Xa.dtype)], axis=1)
+    d = None
+    if shard_features and mesh_lib.n_model_shards(mesh) > 1:
+        Xs, n, d = shard_2d(X, mesh=mesh, dtype=dtype)
+    else:
+        Xs, n = shard_rows(X, mesh=mesh, dtype=dtype)
     ys = None
     if y is not None:
         y_arr = jnp.asarray(y, dtype=y_dtype)
@@ -231,4 +299,4 @@ def _prepare_data_impl(X, y, sample_weight, mesh, dtype, y_dtype):
     log_array(logger, "prepare_data: X", Xs)
     if ys is not None:
         log_array(logger, "prepare_data: y", ys, level=logging.DEBUG)
-    return DeviceData(X=Xs, weights=w, n=n, y=ys, mesh=mesh)
+    return DeviceData(X=Xs, weights=w, n=n, y=ys, mesh=mesh, d=d)

@@ -393,6 +393,28 @@ def test_solve_checkpointed_rejects_changed_problem(staged, tmp_path):
             regularizer="l2", lamduh=0.7, tol=0.0)
 
 
+def test_solve_checkpointed_rejects_changed_warm_start(staged, tmp_path):
+    data, beta0, mask, _ = staged
+    path = str(tmp_path / "ws.ckpt")
+    kw = dict(family="logistic", regularizer="l2", lamduh=0.1, tol=0.0)
+    ckpt.solve_checkpointed(
+        "lbfgs", data.X, data.y, data.weights, beta0, mask,
+        path=path, chunk_iters=2, max_iter=2, **kw)
+    with pytest.raises(ValueError, match="different problem"):
+        ckpt.solve_checkpointed(
+            "lbfgs", data.X, data.y, data.weights, beta0 + 1.0, mask,
+            path=path, chunk_iters=2, max_iter=4, **kw)
+
+
+def test_solve_checkpointed_admm_requires_mesh(staged, tmp_path):
+    data, beta0, mask, _ = staged
+    with pytest.raises(ValueError, match="admm requires a mesh"):
+        ckpt.solve_checkpointed(
+            "admm", data.X, data.y, data.weights, beta0, mask,
+            path=str(tmp_path / "m.ckpt"), family="logistic",
+            regularizer="l2", lamduh=0.1)
+
+
 def test_cell_journal_tolerates_torn_tail(tmp_path):
     from dask_ml_tpu.checkpoint import CellJournal
 

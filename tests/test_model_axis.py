@@ -1,0 +1,186 @@
+"""Feature-axis ("model") tensor parallelism on 2-D ('data','model') meshes.
+
+SURVEY §2.9 / §5.7: the reference forbids feature chunking outright
+(reference: utils.py:120-125 "feature axis must be one chunk"); here the
+jit-compiled GLM solvers run with X sharded over BOTH mesh axes — XLA's SPMD
+partitioner splits the O(n·d²) Hessian/Gram matmuls and their (d, d) outputs
+over the model axis and inserts the d-axis collectives itself. The contract
+pinned down: a d-sharded fit matches the 1-D data-parallel result.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dask_ml_tpu.linear_model import LinearRegression, LogisticRegression
+from dask_ml_tpu.parallel import mesh as mesh_lib
+from dask_ml_tpu.parallel.sharding import prepare_data, shard_2d
+
+
+@pytest.fixture(params=[(4, 2), (2, 4)], ids=["mesh4x2", "mesh2x4"])
+def mesh2d(request):
+    n_data, n_model = request.param
+    return mesh_lib.make_2d_mesh(n_data, n_model)
+
+
+def _problem(n=200, d=10, seed=0, classify=True):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    beta = rng.randn(d).astype(np.float32)
+    eta = X @ beta + 0.5
+    y = (eta + 0.3 * rng.randn(n) > 0).astype(np.int32) if classify \
+        else (eta + 0.1 * rng.randn(n)).astype(np.float32)
+    return X, y
+
+
+# ---------------------------------------------------------------------------
+# substrate: shard_2d / prepare_data(shard_features=True)
+# ---------------------------------------------------------------------------
+
+
+def test_shard_2d_pads_and_places_both_axes(mesh2d):
+    X = np.arange(21 * 10, dtype=np.float32).reshape(21, 10)
+    Xs, n, d = shard_2d(X, mesh=mesh2d)
+    assert (n, d) == (21, 10)
+    n_data = mesh2d.shape[mesh_lib.DATA_AXIS]
+    n_model = mesh2d.shape[mesh_lib.MODEL_AXIS]
+    assert Xs.shape[0] % n_data == 0 and Xs.shape[1] % n_model == 0
+    assert Xs.sharding.spec == P("data", "model")
+    # values intact, padding zero
+    np.testing.assert_array_equal(np.asarray(Xs)[:21, :10], X)
+    assert float(np.abs(np.asarray(Xs)[21:, :]).sum()) == 0.0
+    assert float(np.abs(np.asarray(Xs)[:, 10:]).sum()) == 0.0
+
+
+def test_prepare_data_shard_features(mesh2d):
+    X, y = _problem(n=50, d=7)
+    data = prepare_data(X, y=y, mesh=mesh2d, shard_features=True,
+                        y_dtype=jnp.float32)
+    assert data.n_features == 7  # true d, not padded width
+    assert data.X.sharding.spec == P("data", "model")
+    # y / weights stay data-sharded (replicated over the model axis)
+    assert data.y.sharding.spec in (P("data"), P("data", None))
+    assert data.n == 50
+
+
+def test_prepare_data_shard_features_noop_on_1d_mesh():
+    X, _ = _problem(n=30, d=5)
+    m = mesh_lib.make_mesh()  # 1-D data mesh
+    data = prepare_data(X, mesh=m, shard_features=True)
+    assert data.d is None and data.n_features == 5
+
+
+# ---------------------------------------------------------------------------
+# core: d-sharded Newton == data-parallel Newton (the VERDICT #10 contract)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("solver", ["newton", "lbfgs"])
+def test_core_solver_2d_matches_1d(mesh2d, solver):
+    from dask_ml_tpu.models import glm as core
+
+    X, y = _problem(n=240, d=12)
+    kw = dict(family="logistic", regularizer="l2", lamduh=0.1, tol=1e-6,
+              max_iter=50)
+
+    mesh1d = mesh_lib.make_mesh()
+    data1 = prepare_data(X, y=y.astype(np.float32), mesh=mesh1d)
+    beta0 = jnp.zeros((12,), jnp.float32)
+    mask = jnp.ones((12,), jnp.float32)
+    fn = core.newton if solver == "newton" else core.lbfgs
+    beta1, _ = fn(data1.X, data1.y, data1.weights, beta0, mask, **kw)
+
+    data2 = prepare_data(X, y=y.astype(np.float32), mesh=mesh2d,
+                         shard_features=True)
+    d_pad = int(data2.X.shape[1])
+    beta0p = jnp.zeros((d_pad,), jnp.float32)
+    maskp = jnp.zeros((d_pad,), jnp.float32).at[:12].set(1.0)
+    beta2, _ = fn(data2.X, data2.y, data2.weights, beta0p, maskp, **kw)
+
+    np.testing.assert_allclose(np.asarray(beta2)[:12], np.asarray(beta1),
+                               rtol=2e-3, atol=2e-4)
+    # padded coordinates never move off zero
+    assert float(np.abs(np.asarray(beta2)[12:]).max(initial=0.0)) < 1e-6
+
+
+def test_core_newton_2d_hessian_is_model_sharded(mesh2d):
+    """The point of the exercise: the (d, d) Hessian work is split over the
+    model axis, not replicated. Checked via the compiled sharding of an
+    isolated Hessian computation."""
+    X, _ = _problem(n=240, d=16)
+    data = prepare_data(X, mesh=mesh2d, shard_features=True)
+
+    @jax.jit
+    def hessian(Xs):
+        return Xs.T @ Xs
+
+    H = hessian(data.X)
+    # contraction over the data axis leaves a (d, d) result partitioned
+    # over 'model' on one side — NOT fully replicated
+    assert "model" in str(H.sharding.spec)
+
+
+# ---------------------------------------------------------------------------
+# facade: LogisticRegression/LinearRegression under a 2-D mesh
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("solver", ["newton", "lbfgs", "proximal_grad"])
+def test_facade_2d_matches_1d(mesh2d, solver):
+    X, y = _problem(n=300, d=11)  # 11 indivisible by 2 and 4: padding path
+    kw = dict(solver=solver, C=2.0, max_iter=60, tol=1e-6)
+
+    ref = LogisticRegression(**kw)
+    with mesh_lib.use_mesh(mesh_lib.make_mesh()):
+        ref.fit(X, y)
+
+    tp = LogisticRegression(**kw)
+    with mesh_lib.use_mesh(mesh2d):
+        tp.fit(X, y)
+        pred = tp.predict(X[:32])
+
+    assert tp.coef_.shape == (11,)
+    np.testing.assert_allclose(tp.coef_, ref.coef_, rtol=5e-3, atol=5e-4)
+    np.testing.assert_allclose(tp.intercept_, ref.intercept_,
+                               rtol=5e-3, atol=5e-4)
+    assert pred.shape == (32,)
+
+
+def test_facade_2d_linear_regression_no_intercept(mesh2d):
+    X, y = _problem(n=200, d=8, classify=False)
+    ref = LinearRegression(solver="newton", fit_intercept=False,
+                           max_iter=30).fit(X, y)
+    with mesh_lib.use_mesh(mesh2d):
+        tp = LinearRegression(solver="newton", fit_intercept=False,
+                              max_iter=30).fit(X, y)
+    np.testing.assert_allclose(tp.coef_, ref.coef_, rtol=2e-3, atol=2e-4)
+
+
+def test_facade_2d_search_shares_staged_slices(mesh2d):
+    """The intercept column is appended INSIDE prepare_data, keyed on the
+    caller's original array — so under a search's staging memo, candidates
+    sharing a CV slice share ONE staged copy on the 2-D mesh too."""
+    from dask_ml_tpu.parallel.sharding import staging_memo
+
+    X, y = _problem(n=120, d=6)
+    with mesh_lib.use_mesh(mesh2d), staging_memo() as memo:
+        for C in (0.5, 1.0, 2.0):
+            LogisticRegression(solver="newton", C=C, max_iter=5).fit(X, y)
+    # 3 entries total: check_array(X), the prepared dataset (X is keyed by
+    # identity, the re-encoded y by CONTENT), and y's inner row staging —
+    # fits 2 and 3 hit check + data, so X/y transfer exactly once
+    assert memo.n_stagings == 3
+    assert memo.hits == 4
+
+
+def test_facade_2d_admm_falls_back_to_data_parallel(mesh2d):
+    """ADMM keeps its per-shard shard_map layout on a 2-D mesh (documented:
+    consensus state is data-parallel by construction) and still converges."""
+    X, y = _problem(n=160, d=6)
+    with mesh_lib.use_mesh(mesh2d):
+        est = LogisticRegression(solver="admm", C=1.0, max_iter=50).fit(X, y)
+    assert est.coef_.shape == (6,)
+    assert est.score(X, y) > 0.8
