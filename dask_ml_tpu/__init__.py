@@ -36,8 +36,18 @@ Internal layers:
 
 __version__ = "0.2.0"
 
+from dask_ml_tpu.config import (  # noqa: F401
+    config_context,
+    get_config,
+    set_config,
+)
+
 __all__ = [
     "checkpoint",
+    "config",
+    "config_context",
+    "get_config",
+    "set_config",
     "cluster",
     "decomposition",
     "linear_model",

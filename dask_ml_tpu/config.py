@@ -1,0 +1,107 @@
+"""Global + scoped execution configuration.
+
+The reference has no global config object — its knobs are scattered over
+estimator params, ``scheduler=``/``n_jobs=`` kwargs, ``compute=`` flags, and
+dask's own ``dask.config`` scoping (SURVEY §5.6). The TPU rebuild gets one
+small sklearn-style config: process-wide :func:`set_config`, scoped
+:func:`config_context` (thread-local, nestable), read by the staging layer.
+
+Knobs (all also overridable per-call at the API they configure):
+
+- ``dtype`` — default staging dtype for ``X`` (e.g. ``jnp.bfloat16`` to run
+  every fit in bf16 on the MXU without touching estimator code). ``None``
+  keeps the input dtype as validated by ``check_array`` (float32 policy).
+  Thread-local under :func:`config_context`.
+- ``mesh`` — the mesh fits run on: ``set_config(mesh=...)`` sets the
+  process-wide default (consulted by ``default_mesh()``), and
+  ``config_context(mesh=...)`` scopes it via
+  :func:`dask_ml_tpu.parallel.mesh.use_mesh`. Mesh scoping is deliberately
+  PROCESS-VISIBLE, not thread-local: the search driver's worker threads
+  must resolve the same mesh as the thread that opened the scope.
+
+(Feature-axis sharding is NOT a config knob: staging layout changes the
+shape of fitted state, so only estimators written for it — the GLMs —
+enable it, automatically, on meshes with a ``model`` axis.)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Optional
+
+_DEFAULTS: dict[str, Any] = {
+    "dtype": None,
+    "mesh": None,
+}
+
+_global_config = dict(_DEFAULTS)
+_local = threading.local()
+
+
+def _stack() -> list:
+    if not hasattr(_local, "stack"):
+        _local.stack = []
+    return _local.stack
+
+
+def get_config() -> dict:
+    """The effective configuration: process-wide settings overlaid by every
+    active :func:`config_context` scope on this thread (innermost wins)."""
+    cfg = dict(_global_config)
+    for layer in _stack():
+        cfg.update(layer)
+    return cfg
+
+
+def get_option(name: str):
+    if name not in _DEFAULTS:
+        raise KeyError(
+            f"unknown config option {name!r}; valid: {sorted(_DEFAULTS)}"
+        )
+    return get_config()[name]
+
+
+def set_config(**options) -> None:
+    """Set process-wide defaults (``set_config(dtype=jnp.bfloat16)``)."""
+    for k in options:
+        if k not in _DEFAULTS:
+            raise KeyError(
+                f"unknown config option {k!r}; valid: {sorted(_DEFAULTS)}"
+            )
+    _global_config.update(options)
+
+
+def reset_config() -> None:
+    """Restore the built-in defaults (mainly for tests)."""
+    _global_config.clear()
+    _global_config.update(_DEFAULTS)
+
+
+@contextlib.contextmanager
+def config_context(**options):
+    """Scoped, nestable override — the dask.config-style scoping the
+    reference leans on, without a global dict of strings. ``dtype`` (and
+    future value-knobs) are thread-local; ``mesh=`` pushes onto the parallel
+    layer's process-visible mesh stack (see the module docstring for why)
+    so ``default_mesh()`` resolves to it inside the scope — including from
+    search worker threads.
+    """
+    for k in options:
+        if k not in _DEFAULTS:
+            raise KeyError(
+                f"unknown config option {k!r}; valid: {sorted(_DEFAULTS)}"
+            )
+    mesh: Optional[Any] = options.get("mesh")
+    stack = _stack()
+    stack.append(dict(options))
+    try:
+        if mesh is not None:
+            from dask_ml_tpu.parallel.mesh import use_mesh
+
+            with use_mesh(mesh):
+                yield
+        else:
+            yield
+    finally:
+        stack.pop()

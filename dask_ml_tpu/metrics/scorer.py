@@ -20,9 +20,12 @@ from dask_ml_tpu.metrics.regression import (
 # obvious extensions its users get from sklearn.
 SCORERS = {
     "accuracy": make_scorer(accuracy_score),
-    "neg_mean_squared_error": make_scorer(mean_squared_error, greater_is_better=False),
-    "neg_mean_absolute_error": make_scorer(mean_absolute_error, greater_is_better=False),
-    "neg_log_loss": make_scorer(log_loss, greater_is_better=False, response_method="predict_proba"),
+    "neg_mean_squared_error": make_scorer(mean_squared_error,
+                                          greater_is_better=False),
+    "neg_mean_absolute_error": make_scorer(mean_absolute_error,
+                                           greater_is_better=False),
+    "neg_log_loss": make_scorer(log_loss, greater_is_better=False,
+                                response_method="predict_proba"),
     "r2": make_scorer(r2_score),
 }
 
@@ -63,7 +66,8 @@ def check_scoring(estimator, scoring=None, **kwargs):
         return None
     if callable(scoring) and getattr(scoring, "__module__", "").startswith(
         ("dask_ml_tpu.metrics", "sklearn.metrics")
-    ) and not hasattr(scoring, "_score_func") and not hasattr(scoring, "_response_method"):
+    ) and not hasattr(scoring, "_score_func") and not hasattr(
+            scoring, "_response_method"):
         raise ValueError(
             "scoring value looks like a raw metric function; wrap it with "
             "sklearn.metrics.make_scorer (same rule as the reference, "

@@ -694,17 +694,29 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
             1 for k in cell_keys.values() if k in (done_cells or {})
         )
 
+        # Thread-local config (dtype etc.) set on the CALLING thread must
+        # reach the pool's worker threads, or `config_context(dtype=bf16):
+        # search.fit(...)` would silently stage f32 under n_jobs > 1. The
+        # mesh knob is excluded: mesh scoping is already process-visible
+        # (and re-pushing it per worker would race on the mesh stack).
+        from dask_ml_tpu import config as config_lib
+
+        caller_cfg = {
+            k: v for k, v in config_lib.get_config().items() if k != "mesh"
+        }
+
         def run_cell(ci, si):
-            if journal is not None:
-                key = cell_keys[(ci, si)]
-                hit = done_cells.get(key)
-                if hit is not None:
-                    return hit
-                result = runner.run(candidate_params[ci], si)
-                if not result[-1]:  # journal only non-failed cells
-                    journal.append(key, result)
-                return result
-            return runner.run(candidate_params[ci], si)
+            with config_lib.config_context(**caller_cfg):
+                if journal is not None:
+                    key = cell_keys[(ci, si)]
+                    hit = done_cells.get(key)
+                    if hit is not None:
+                        return hit
+                    result = runner.run(candidate_params[ci], si)
+                    if not result[-1]:  # journal only non-failed cells
+                        journal.append(key, result)
+                    return result
+                return runner.run(candidate_params[ci], si)
 
         # Device-staging memo: jax-native candidates re-stage their CV slice
         # inside fit; within this scope identical (slice, role) pairs upload

@@ -153,6 +153,18 @@ def _make_objective(family, regularizer, smooth_penalty: bool):
     return objective
 
 
+def _state_dtype(X):
+    """Optimizer-state dtype for data of X's dtype: at least float32.
+
+    Mixed precision the TPU way — X may be staged bf16 (the matmuls read it
+    on the MXU and accumulate f32), but the carries (beta, objective values,
+    step sizes, curvature history, ADMM consensus state) stay f32: bf16's 8
+    mantissa bits cannot represent line-search/convergence arithmetic, and
+    ops like linalg.solve promote anyway (which would break while_loop carry
+    typing if state started as bf16)."""
+    return jnp.promote_types(X.dtype, jnp.float32)
+
+
 # ---------------------------------------------------------------------------
 # Shared line search: Armijo backtracking as an on-device while_loop
 # ---------------------------------------------------------------------------
@@ -193,8 +205,9 @@ def gradient_descent(X, y, w, beta0, mask, *, family="logistic",
     regularizer for this solver, linear_model/glm.py:120-122, so the facade
     passes ``lamduh=0``). Whole optimization is one ``lax.while_loop``."""
     obj_full = _make_objective(family, regularizer, smooth_penalty=True)
+    sdt = _state_dtype(X)
     sw = jnp.maximum(jnp.sum(w), 1.0)
-    lam_eff = jnp.asarray(lamduh, X.dtype)
+    lam_eff = jnp.asarray(lamduh, sdt)
 
     def obj(b):
         return obj_full(b, X, y, w, lam_eff, mask) / sw
@@ -214,8 +227,8 @@ def gradient_descent(X, y, w, beta0, mask, *, family="logistic",
         done = jnp.abs(f0 - f_new) <= tol * jnp.maximum(jnp.abs(f0), 1e-10)
         return beta_new, f_new, jnp.minimum(t * 4.0, 1e3), it + 1, done
 
-    init = (beta0, jnp.asarray(jnp.inf, X.dtype),
-            jnp.asarray(1.0, X.dtype), jnp.asarray(0, jnp.int32),
+    init = (beta0.astype(sdt), jnp.asarray(jnp.inf, sdt),
+            jnp.asarray(1.0, sdt), jnp.asarray(0, jnp.int32),
             jnp.asarray(False))
     beta, f, _, n_iter, _ = lax.while_loop(cond, body, init)
     return beta, n_iter
@@ -229,9 +242,11 @@ def newton(X, y, w, beta0, mask, *, family="logistic", regularizer="l2",
     Reference facade strips the regularizer here too (glm.py:120-122)."""
     loss_fn, hess_fn = FAMILIES[family]
     obj_full = _make_objective(family, regularizer, smooth_penalty=True)
+    sdt = _state_dtype(X)
     sw = jnp.maximum(jnp.sum(w), 1.0)
-    lam_eff = jnp.asarray(lamduh, X.dtype)
+    lam_eff = jnp.asarray(lamduh, sdt)
     d = X.shape[1]
+    beta0 = beta0.astype(sdt)
 
     def obj(b):
         return obj_full(b, X, y, w, lam_eff, mask) / sw
@@ -253,7 +268,7 @@ def newton(X, y, w, beta0, mask, *, family="logistic", regularizer="l2",
         H = H + jnp.diag(lam_eff / sw * mask + 1e-8)
         direction = -jnp.linalg.solve(H, g)
         f0 = obj(beta)
-        t, _, _ = _backtrack(obj, beta, f0, g, direction, jnp.asarray(1.0, X.dtype))
+        t, _, _ = _backtrack(obj, beta, f0, g, direction, jnp.asarray(1.0, sdt))
         step = t * direction
         beta_new = beta + step
         # Stop on step size OR gradient norm: on rank-deficient designs the
@@ -317,9 +332,11 @@ def lbfgs(X, y, w, beta0, mask, *, family="logistic", regularizer="l2",
     the iterations performed in THIS call.
     """
     obj_full = _make_objective(family, regularizer, smooth_penalty=True)
+    sdt = _state_dtype(X)
     sw = jnp.maximum(jnp.sum(w), 1.0)
-    lam_eff = jnp.asarray(lamduh, X.dtype)
+    lam_eff = jnp.asarray(lamduh, sdt)
     d = X.shape[1]
+    beta0 = beta0.astype(sdt)
 
     def obj(b):
         return obj_full(b, X, y, w, lam_eff, mask) / sw
@@ -359,8 +376,8 @@ def lbfgs(X, y, w, beta0, mask, *, family="logistic", regularizer="l2",
     if state is None:
         f0, g0 = value_and_grad(beta0)
         carry0 = (beta0, g0, f0,
-                  jnp.zeros((m, d), X.dtype), jnp.zeros((m, d), X.dtype),
-                  jnp.zeros((m,), X.dtype), jnp.asarray(0, jnp.int32),
+                  jnp.zeros((m, d), sdt), jnp.zeros((m, d), sdt),
+                  jnp.zeros((m,), sdt), jnp.asarray(0, jnp.int32),
                   jnp.asarray(0, jnp.int32))
     else:
         carry0 = tuple(jnp.asarray(s) for s in state)
@@ -379,8 +396,9 @@ def proximal_grad(X, y, w, beta0, mask, *, family="logistic",
     penalized coords (``mask``)."""
     obj_smooth = _make_objective(family, regularizer, smooth_penalty=False)
     _, pen_prox = _penalty(regularizer)
+    sdt = _state_dtype(X)
     sw = jnp.maximum(jnp.sum(w), 1.0)
-    lam_eff = jnp.asarray(lamduh, X.dtype) / sw
+    lam_eff = jnp.asarray(lamduh, sdt) / sw
 
     def fsmooth(b):
         return obj_smooth(b, X, y, w, 0.0, mask) / sw
@@ -416,8 +434,9 @@ def proximal_grad(X, y, w, beta0, mask, *, family="logistic",
         done = step <= tol * jnp.maximum(jnp.max(jnp.abs(beta)), 1e-10)
         return beta_new, f_new, jnp.minimum(t * 2.0, 1e3), it + 1, done
 
-    init = (beta0, jnp.asarray(jnp.inf, X.dtype), jnp.asarray(1.0, X.dtype),
-            jnp.asarray(0, jnp.int32), jnp.asarray(False))
+    init = (beta0.astype(sdt), jnp.asarray(jnp.inf, sdt),
+            jnp.asarray(1.0, sdt), jnp.asarray(0, jnp.int32),
+            jnp.asarray(False))
     beta, _, _, n_iter, _ = lax.while_loop(cond, body, init)
     return beta, n_iter
 
@@ -475,7 +494,7 @@ def _admm_impl(X, y, w, beta0, x0, u0, mask, lamduh, rho, abstol, reltol,
                 g = local_grad(xx)
                 h = w_loc * hess_fn(eta, y_loc)
                 H = (X_loc.T @ (h[:, None] * X_loc)) / sw
-                H = H + rho * jnp.eye(d, dtype=X_loc.dtype)
+                H = H + rho * jnp.eye(d, dtype=xx.dtype)
                 return xx - jnp.linalg.solve(H, g), it + 1
 
             xx, _ = lax.while_loop(nt_cond, nt_body,
@@ -544,11 +563,11 @@ def admm(X, y, w, beta0, mask, mesh, *, family="logistic", regularizer="l2",
     count (each shard owns its consensus subproblem): resuming on a mesh
     with a different number of shards is rejected.
     """
-    dt = X.dtype
+    dt = _state_dtype(X)  # consensus state stays >= f32 even for bf16 data
     d = X.shape[1]
     n_shards = mesh.shape[DATA_AXIS]
     if state is None:
-        z0 = beta0
+        z0 = beta0.astype(dt)
         x0 = jnp.broadcast_to(beta0, (n_shards, d)).astype(dt)
         u0 = jnp.zeros((n_shards, d), dt)
     else:

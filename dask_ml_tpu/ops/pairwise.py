@@ -5,7 +5,8 @@ inside delayed tasks (reference: metrics/pairwise.py:20-50) and restricts ``Y``
 to an in-memory NumPy array (reference: metrics/pairwise.py:53-59 — centers are
 replicated into every task). The TPU-native version keeps the same contract —
 ``X`` is sample-axis sharded, ``Y`` is small and replicated — but the whole
-computation is one jitted ``‖x‖² + ‖y‖² − 2·X@Yᵀ`` expression: the X@Yᵀ term
+computation is one jitted ``|x|² + |y|² − 2·X@Yᵀ`` expression: the X@Yᵀ
+term
 lands on the MXU and XLA fuses the norm/clamp/argmin epilogue, so
 assignment-style ops never materialize more than an (n_shard × k) block
 per device.

@@ -57,10 +57,16 @@ def make_mesh(
 
 
 def default_mesh() -> Mesh:
-    """The active mesh: innermost :func:`use_mesh` override, else a lazily
-    created 1-D mesh over every visible device."""
+    """The active mesh: innermost :func:`use_mesh` override, else the
+    process-wide ``set_config(mesh=...)`` default, else a lazily created
+    1-D mesh over every visible device."""
     if _mesh_stack:
         return _mesh_stack[-1]
+    from dask_ml_tpu import config as config_lib
+
+    configured = config_lib.get_config()["mesh"]
+    if configured is not None:
+        return configured
     global _default_mesh
     if _default_mesh is None:
         with _lock:

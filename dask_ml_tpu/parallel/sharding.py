@@ -245,7 +245,7 @@ def prepare_data(
     mesh: Optional[Mesh] = None,
     dtype=None,
     y_dtype=None,
-    shard_features: bool = False,
+    shard_features: Optional[bool] = None,
     append_ones: bool = False,
 ) -> DeviceData:
     """Stage ``(X, y, sample_weight)`` onto the mesh as a :class:`DeviceData`.
@@ -258,15 +258,30 @@ def prepare_data(
     so the staging memo still keys on the identity of the caller's original
     array and search cells sharing a CV slice share one staged copy.
 
+    ``dtype`` left unset falls back to the global/scoped config
+    (:mod:`dask_ml_tpu.config`): ``config_context(dtype=bfloat16)`` runs
+    every staged fit in bf16 without touching estimator code.
+    ``shard_features`` is deliberately NOT config-driven — feature padding
+    changes the shape of fitted state, so only cores written for it (the
+    GLMs, which slice back to the true width) may enable it.
+
     Inside a :func:`staging_memo` scope, repeated calls on the same source
     objects return the already-staged ``DeviceData`` (one transfer per
     distinct slice, however many search candidates share it)."""
+    from dask_ml_tpu import config as config_lib
+
+    if dtype is None:
+        dtype = config_lib.get_config()["dtype"]
     mesh = mesh or mesh_lib.default_mesh()
+    # EFFECTIVE flag: on a data-only mesh feature sharding is a no-op, so
+    # the memo key must not distinguish callers that pass it unconditionally
+    # from callers that don't — they produce identical staged data
+    shard_features = bool(shard_features) and mesh_lib.n_model_shards(mesh) > 1
     memo = _current_memo()
     if memo is not None:
         return memo.get_or_stage(
             ("data", id(X), _content_key(y), _content_key(sample_weight),
-             id(mesh), str(dtype), str(y_dtype), bool(shard_features),
+             id(mesh), str(dtype), str(y_dtype), shard_features,
              bool(append_ones)),
             (X, y, sample_weight, mesh),
             lambda: _prepare_data_impl(X, y, sample_weight, mesh, dtype,

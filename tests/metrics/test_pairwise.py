@@ -43,7 +43,8 @@ def test_argmin_min(XY, any_mesh):
     [
         ("linear", skp.linear_kernel, {}),
         ("rbf", skp.rbf_kernel, {"gamma": 0.5}),
-        ("polynomial", skp.polynomial_kernel, {"degree": 2, "gamma": 0.3, "coef0": 1.5}),
+        ("polynomial", skp.polynomial_kernel,
+         {"degree": 2, "gamma": 0.3, "coef0": 1.5}),
         ("sigmoid", skp.sigmoid_kernel, {"gamma": 0.1, "coef0": 0.2}),
     ],
 )

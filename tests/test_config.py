@@ -1,0 +1,179 @@
+"""Config system: process-wide set_config + scoped config_context wired
+into the staging layer and mesh resolution (SURVEY §5.6 rebuild note)."""
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dask_ml_tpu import config as config_lib
+from dask_ml_tpu import config_context, get_config, set_config
+from dask_ml_tpu.parallel import mesh as mesh_lib
+from dask_ml_tpu.parallel.sharding import prepare_data
+
+
+@pytest.fixture(autouse=True)
+def _clean_config():
+    config_lib.reset_config()
+    yield
+    config_lib.reset_config()
+
+
+def test_defaults():
+    cfg = get_config()
+    assert cfg == {"dtype": None, "mesh": None}
+
+
+def test_set_config_is_process_wide():
+    set_config(dtype=jnp.bfloat16)
+    assert get_config()["dtype"] == jnp.bfloat16
+    config_lib.reset_config()
+    assert get_config()["dtype"] is None
+
+
+def test_unknown_option_rejected():
+    with pytest.raises(KeyError, match="unknown config option"):
+        set_config(precision="bf16")
+    with pytest.raises(KeyError, match="unknown config option"):
+        with config_context(nope=1):
+            pass
+    with pytest.raises(KeyError, match="unknown config option"):
+        config_lib.get_option("nope")
+
+
+def test_context_nests_and_restores():
+    set_config(dtype=jnp.float32)
+    with config_context(dtype=jnp.bfloat16):
+        assert get_config()["dtype"] == jnp.bfloat16
+        with config_context(dtype=None):
+            assert get_config()["dtype"] is None
+        assert get_config()["dtype"] == jnp.bfloat16
+    assert get_config()["dtype"] == jnp.float32
+
+
+def test_dtype_context_is_thread_local():
+    seen = {}
+
+    def worker():
+        seen["worker"] = get_config()["dtype"]
+
+    with config_context(dtype=jnp.bfloat16):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert seen["worker"] is None  # scope did not leak across threads
+
+
+def test_dtype_flows_into_staging():
+    X = np.random.RandomState(0).randn(32, 4).astype(np.float32)
+    with config_context(dtype=jnp.bfloat16):
+        data = prepare_data(X)
+    assert data.X.dtype == jnp.bfloat16
+    # explicit call-site dtype still wins
+    with config_context(dtype=jnp.bfloat16):
+        data = prepare_data(X, dtype=jnp.float32)
+    assert data.X.dtype == jnp.float32
+    # and outside the scope nothing changed
+    assert prepare_data(X).X.dtype == jnp.float32
+
+
+def test_mesh_context_scopes_default_mesh():
+    m3 = mesh_lib.make_mesh(n_devices=3)
+    with config_context(mesh=m3):
+        assert mesh_lib.default_mesh() is m3
+        data = prepare_data(np.ones((10, 2), np.float32))
+        assert data.X.shape[0] % 3 == 0
+    assert mesh_lib.default_mesh() is not m3
+
+
+def test_mesh_context_is_visible_to_worker_threads():
+    """Mesh scoping is deliberately process-visible: search worker threads
+    must resolve the same mesh as the thread that opened the scope."""
+    m3 = mesh_lib.make_mesh(n_devices=3)
+    seen = {}
+
+    def worker():
+        seen["mesh"] = mesh_lib.default_mesh()
+
+    with config_context(mesh=m3):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert seen["mesh"] is m3
+
+
+def test_set_config_mesh_is_honored():
+    """set_config(mesh=...) changes what default_mesh() resolves to — not
+    just config_context."""
+    m2 = mesh_lib.make_mesh(n_devices=2)
+    set_config(mesh=m2)
+    try:
+        assert mesh_lib.default_mesh() is m2
+        data = prepare_data(np.ones((10, 2), np.float32))
+        assert data.X.shape[0] % 2 == 0
+        # an explicit use_mesh scope still wins over the config default
+        m3 = mesh_lib.make_mesh(n_devices=3)
+        with mesh_lib.use_mesh(m3):
+            assert mesh_lib.default_mesh() is m3
+    finally:
+        config_lib.reset_config()
+    assert mesh_lib.default_mesh() is not m2
+
+
+def test_dtype_config_reaches_threaded_search_workers():
+    """config_context(dtype=...) on the calling thread is propagated into
+    the search driver's worker threads — a threaded search stages the same
+    dtype a sequential one would."""
+    from sklearn.base import BaseEstimator
+
+    from dask_ml_tpu.model_selection import GridSearchCV
+
+    seen = []
+
+    class DtypeProbe(BaseEstimator):
+        def __init__(self, c=1.0):
+            self.c = c
+
+        def fit(self, X, y=None):
+            seen.append(prepare_data(np.asarray(X)).X.dtype)
+            return self
+
+        def score(self, X, y=None):
+            return self.c
+
+    X = np.random.RandomState(0).randn(40, 3).astype(np.float32)
+    with config_context(dtype=jnp.bfloat16):
+        GridSearchCV(DtypeProbe(), {"c": [1.0, 2.0]}, cv=2, refit=False,
+                     n_jobs=4).fit(X)
+    assert seen and all(dt == jnp.bfloat16 for dt in seen)
+
+
+def test_shard_features_flag_is_noop_in_memo_key_on_1d_mesh():
+    """On a data-only mesh, shard_features=True and =False stage identical
+    data — the staging memo must share one entry across both spellings."""
+    from dask_ml_tpu.parallel.sharding import staging_memo
+
+    X = np.random.RandomState(0).randn(24, 4).astype(np.float32)
+    with staging_memo() as memo:
+        a = prepare_data(X, shard_features=True)
+        b = prepare_data(X, shard_features=False)
+    assert a is b
+    # 2 entries: the prepared dataset + X's inner row staging; the second
+    # prepare_data call is a pure hit
+    assert memo.n_stagings == 2 and memo.hits == 1
+
+
+def test_bf16_fit_via_config_only():
+    """The headline use: run a whole fit in bf16 without touching estimator
+    code — config plumbs the dtype through staging into the solver."""
+    from dask_ml_tpu.linear_model import LinearRegression
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(200, 5).astype(np.float32)
+    y = (X @ rng.randn(5)).astype(np.float32)
+    with config_context(dtype=jnp.bfloat16):
+        est = LinearRegression(solver="newton", max_iter=20).fit(X, y)
+    ref = LinearRegression(solver="newton", max_iter=20).fit(X, y)
+    # bf16 ~ 3 decimal digits: coarse agreement with the f32 fit
+    np.testing.assert_allclose(est.coef_, ref.coef_, rtol=0.1, atol=0.05)
