@@ -94,3 +94,28 @@ def _copy_partial_doc(cls):
         + (base.__doc__ or "")
     )
     return cls
+
+
+# Functional surface parity (reference: _partial.py:104-182 ``fit``,
+# :189-212 ``predict``): ``fit`` is the sequential partial_fit block chain
+# (re-exported from wrappers, where the jax-native fused-scan fast path
+# lives); ``predict`` applies a fitted model blockwise on the host.
+from dask_ml_tpu.wrappers import DEFAULT_BLOCK_SIZE, fit  # noqa: F401,E402
+
+
+def predict(model, x, block_size: int = DEFAULT_BLOCK_SIZE):
+    """Blockwise predict with a fitted sklearn-style model
+    (reference: _partial.py:189-212). The mesh-parallel inference path is
+    :class:`dask_ml_tpu.wrappers.ParallelPostFit`; this is the plain
+    host-block loop for reference-API compatibility."""
+    import numpy as np
+
+    if getattr(x, "ndim", 2) != 2:
+        raise ValueError("predict expects a 2-D input")
+    n = int(x.shape[0])
+    parts = [
+        model.predict(x[i:i + block_size]) for i in range(0, n, block_size)
+    ]
+    if not parts:  # zero-row input is legal: empty predictions out
+        return np.empty((0,))
+    return np.concatenate([np.asarray(p) for p in parts])

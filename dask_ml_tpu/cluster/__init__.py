@@ -1,7 +1,20 @@
 """Clustering estimators (reference: dask_ml/cluster/__init__.py)."""
 
-from dask_ml_tpu.cluster.k_means import KMeans  # noqa: F401
+from dask_ml_tpu.cluster.k_means import (  # noqa: F401
+    KMeans,
+    compute_inertia,
+    evaluate_cost,
+    k_means,
+)
 from dask_ml_tpu.cluster.minibatch import PartialMiniBatchKMeans  # noqa: F401
-from dask_ml_tpu.cluster.spectral import SpectralClustering  # noqa: F401
+from dask_ml_tpu.cluster.spectral import SpectralClustering, embed  # noqa: F401
+from dask_ml_tpu.models.kmeans import (  # noqa: F401
+    init_pp,
+    init_random,
+    init_scalable,
+    k_init,
+)
 
-__all__ = ["KMeans", "SpectralClustering", "PartialMiniBatchKMeans"]
+__all__ = ["KMeans", "SpectralClustering", "PartialMiniBatchKMeans",
+           "k_means", "compute_inertia", "evaluate_cost", "embed",
+           "k_init", "init_pp", "init_random", "init_scalable"]

@@ -163,3 +163,58 @@ class KMeans(TransformerMixin, BaseEstimator):
                 data.X, data.weights, jnp.asarray(self.cluster_centers_)
             )
         )
+
+
+def k_means(X, n_clusters, init="k-means||", precompute_distances="auto",
+            n_init=1, max_iter=300, verbose=False, tol=1e-4,
+            random_state=None, copy_x=True, n_jobs=-1, algorithm="full",
+            return_n_iter=False, oversampling_factor=2, init_max_iter=None):
+    """Functional K-means (reference: cluster/k_means.py:219-240).
+
+    Thin wrapper over :class:`KMeans` — like the reference, ``n_init`` is
+    effectively 1 (k-means|| makes restarts unnecessary) and the extra
+    sklearn knobs are accepted for signature parity.
+    Returns ``(centroids, labels, inertia[, n_iter])``.
+    """
+    est = KMeans(
+        n_clusters=n_clusters, init=init,
+        oversampling_factor=oversampling_factor, max_iter=max_iter, tol=tol,
+        precompute_distances=precompute_distances, random_state=random_state,
+        copy_x=copy_x, n_jobs=n_jobs, algorithm=algorithm,
+        init_max_iter=init_max_iter,
+    ).fit(X)
+    if return_n_iter:
+        return est.cluster_centers_, est.labels_, est.inertia_, est.n_iter_
+    return est.cluster_centers_, est.labels_, est.inertia_
+
+
+def compute_inertia(X, labels, centers):
+    """Sum of squared distances of rows to their ASSIGNED center
+    (reference: cluster/k_means.py:243-247) — one jitted gather + fused
+    reduce over the sharded rows. Deliberate deviation, documented: the
+    reference's code sums RAW differences (``(X - reindexed).sum()``, no
+    square — a bug that can go negative); inertia here is the standard
+    squared quantity, matching sklearn and this class's ``inertia_``."""
+    import jax
+
+    data = prepare_data(X)
+    labels = jnp.asarray(np.asarray(labels))
+    centers = jnp.asarray(np.asarray(centers))
+
+    @jax.jit
+    def _inertia(Xs, w, labels_padded, centers):
+        assigned = centers[labels_padded]
+        return jnp.sum(w * jnp.sum((Xs - assigned) ** 2, axis=1))
+
+    pad = data.n_padded - data.n
+    if pad:
+        labels = jnp.concatenate([labels, jnp.zeros((pad,), labels.dtype)])
+    return float(_inertia(data.X, data.weights, labels, centers))
+
+
+def evaluate_cost(X, centers):
+    """Σ min-squared-distance of each row to its nearest center — the
+    k-means|| sampling cost (reference: cluster/k_means.py:425-428)."""
+    data = prepare_data(X)
+    return float(core.compute_inertia(
+        data.X, data.weights, jnp.asarray(np.asarray(centers))))

@@ -116,16 +116,10 @@ class SpectralClustering(BaseEstimator, ClusterMixin):
         rest_mask[keep] = False
         rest = np.arange(n)[rest_mask]
 
-        X_keep = replicate(X[keep])  # (l, d) on every device
-        Xr, m_valid = shard_rows(X[rest])  # (m, d) sharded
-
+        m_valid = len(rest)
         # Exact kernel blocks (reference: embed, spectral.py:293-316) — Bt is
         # the big one, sharded by rows; A is small and replicated.
-        A = self._kernel(X_keep, X_keep, params)  # (l, l)
-        Bt = self._kernel(Xr, X_keep, params)  # (m, l) sharded
-        # Zero padding rows so column sums over the sharded axis are exact.
-        wmask = (jnp.arange(Bt.shape[0]) < m_valid)[:, None]
-        Bt = jnp.where(wmask, Bt, 0.0)
+        A, Bt = embed(X[keep], X[rest], l, self.affinity, params)
 
         # Approximate degree normalization (reference: spectral.py:225-246).
         a = A.sum(0)  # (l,)
@@ -170,13 +164,39 @@ class SpectralClustering(BaseEstimator, ClusterMixin):
         self.eigenvalues_ = np.asarray(S_A[:k])
         return self
 
-    def _kernel(self, X, Y, params):
-        if callable(self.affinity):
-            # Callables receive the merged params (gamma/degree/coef0
-            # included), as in the reference (spectral.py:307-308).
-            return self.affinity(X, Y, **params)
-        return pairwise_kernels(X, Y, metric=self.affinity, **params)
-
     def fit_predict(self, X, y=None):
         self.fit(X)
         return self.labels_
+
+
+def embed(X_keep, X_rest, n_components, metric, kernel_params):
+    """Kernel blocks of the Nyström embedding
+    (reference: spectral.py:293-316 ``embed``).
+
+    Stages the sampled rows replicated and the rest row-sharded over the
+    mesh, then computes ``A = K(X_keep, X_keep)`` (small, replicated) and
+    ``Bt = K(X_rest, X_keep)`` — the TRANSPOSE of the reference's ``B``,
+    laid out (m, l) so the big block shards along the sample axis and each
+    device computes only its rows' kernel strip on the MXU. Padding rows of
+    ``Bt`` are zeroed so column sums over the sharded axis stay exact.
+
+    Callable metrics receive ``(X, Y, **kernel_params)`` — two operands,
+    unlike the reference's one-or-two convention — matching this class's
+    ``affinity`` contract.
+    """
+    if isinstance(metric, str) and metric not in PAIRWISE_KERNEL_FUNCTIONS:
+        raise ValueError(
+            f"Unknown affinity metric name '{metric}'. Expected one of "
+            f"{sorted(PAIRWISE_KERNEL_FUNCTIONS)}"
+        )
+    params = dict(kernel_params or {})
+    Xk = replicate(np.asarray(X_keep))
+    Xr, m_valid = shard_rows(np.asarray(X_rest))
+    if callable(metric):
+        A = metric(Xk, Xk, **params)
+        Bt = metric(Xr, Xk, **params)
+    else:
+        A = pairwise_kernels(Xk, Xk, metric=metric, **params)
+        Bt = pairwise_kernels(Xr, Xk, metric=metric, **params)
+    wmask = (jnp.arange(Bt.shape[0]) < m_valid)[:, None]
+    return A, jnp.where(wmask, Bt, 0.0)

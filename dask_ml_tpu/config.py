@@ -54,21 +54,22 @@ def get_config() -> dict:
     return cfg
 
 
+def _validate_options(names) -> None:
+    for k in names:
+        if k not in _DEFAULTS:
+            raise KeyError(
+                f"unknown config option {k!r}; valid: {sorted(_DEFAULTS)}"
+            )
+
+
 def get_option(name: str):
-    if name not in _DEFAULTS:
-        raise KeyError(
-            f"unknown config option {name!r}; valid: {sorted(_DEFAULTS)}"
-        )
+    _validate_options([name])
     return get_config()[name]
 
 
 def set_config(**options) -> None:
     """Set process-wide defaults (``set_config(dtype=jnp.bfloat16)``)."""
-    for k in options:
-        if k not in _DEFAULTS:
-            raise KeyError(
-                f"unknown config option {k!r}; valid: {sorted(_DEFAULTS)}"
-            )
+    _validate_options(options)
     _global_config.update(options)
 
 
@@ -86,12 +87,20 @@ def config_context(**options):
     layer's process-visible mesh stack (see the module docstring for why)
     so ``default_mesh()`` resolves to it inside the scope — including from
     search worker threads.
+
+    ``mesh=None`` inside a scope is rejected: popping back to "no mesh"
+    cannot be expressed on the process-visible mesh stack, and silently
+    letting ``get_config()`` claim None while staging still used the
+    enclosing mesh would lie. Clear the process default with
+    ``set_config(mesh=None)`` instead.
     """
-    for k in options:
-        if k not in _DEFAULTS:
-            raise KeyError(
-                f"unknown config option {k!r}; valid: {sorted(_DEFAULTS)}"
-            )
+    _validate_options(options)
+    if "mesh" in options and options["mesh"] is None:
+        raise ValueError(
+            "config_context(mesh=None) cannot clear an enclosing mesh "
+            "scope; use set_config(mesh=None) to clear the process-wide "
+            "default, or pass an explicit Mesh"
+        )
     mesh: Optional[Any] = options.get("mesh")
     stack = _stack()
     stack.append(dict(options))

@@ -50,7 +50,7 @@ class _GLM(BaseEstimator):
                  fit_intercept=True, intercept_scaling=1.0, class_weight=None,
                  random_state=None, solver="admm", multiclass="ovr",
                  verbose=0, warm_start=False, n_jobs=1, max_iter=100,
-                 solver_kwargs=None):
+                 solver_kwargs=None, checkpoint=None, checkpoint_every=50):
         self.penalty = penalty
         self.dual = dual
         self.tol = tol
@@ -66,6 +66,11 @@ class _GLM(BaseEstimator):
         self.n_jobs = n_jobs
         self.max_iter = max_iter
         self.solver_kwargs = solver_kwargs
+        # checkpoint: snapshot path making fit() resumable in chunks of
+        # checkpoint_every device iterations (SURVEY §5.4;
+        # see dask_ml_tpu.checkpoint.solve_checkpointed)
+        self.checkpoint = checkpoint
+        self.checkpoint_every = checkpoint_every
 
     def _get_solver_kwargs(self):
         """``lamduh = 1/C`` mapping + per-solver pruning
@@ -140,10 +145,22 @@ class _GLM(BaseEstimator):
         beta0 = jnp.zeros((d,), Xd.dtype)
         kwargs = self._get_solver_kwargs()
         with profile_phase(logger, f"glm-{self.solver}"):
-            beta, n_iter = core.solve(
-                self.solver, Xd, data.y, data.weights, beta0,
-                jnp.asarray(mask), mesh=mesh, **kwargs,
-            )
+            if self.checkpoint:
+                from dask_ml_tpu.checkpoint import solve_checkpointed
+
+                ck_kwargs = dict(kwargs)
+                ck_max_iter = ck_kwargs.pop("max_iter")
+                beta, n_iter = solve_checkpointed(
+                    self.solver, Xd, data.y, data.weights, beta0,
+                    jnp.asarray(mask), mesh, path=self.checkpoint,
+                    chunk_iters=int(self.checkpoint_every),
+                    max_iter=ck_max_iter, **ck_kwargs,
+                )
+            else:
+                beta, n_iter = core.solve(
+                    self.solver, Xd, data.y, data.weights, beta0,
+                    jnp.asarray(mask), mesh=mesh, **kwargs,
+                )
         self._coef = np.asarray(beta)[:d_true]  # drop feature padding
         self.n_iter_ = int(n_iter)
         if self.fit_intercept:

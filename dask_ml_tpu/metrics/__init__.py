@@ -2,10 +2,15 @@
 (reference: dask_ml/metrics/__init__.py)."""
 
 from dask_ml_tpu.ops.pairwise import (  # noqa: F401
+    check_pairwise_arrays,
     euclidean_distances,
+    linear_kernel,
     pairwise_distances,
     pairwise_distances_argmin_min,
     pairwise_kernels,
+    polynomial_kernel,
+    rbf_kernel,
+    sigmoid_kernel,
 )
 from dask_ml_tpu.metrics.classification import accuracy_score, log_loss  # noqa: F401
 from dask_ml_tpu.metrics.regression import (  # noqa: F401

@@ -54,6 +54,50 @@ def get_scorer(scoring, compute: bool = True):
     raise ValueError(f"Invalid scoring: {scoring!r}")
 
 
+def _looks_like_raw_metric(fn) -> bool:
+    """Structural test for a metric-style callable ``f(y_true, y_pred)``
+    passed where a scorer ``s(estimator, X, y)`` is required.
+
+    The rule is structural, like the reference's (a scorer is something
+    ``make_scorer`` produced or an sklearn ``_BaseScorer``; reference:
+    metrics/scorer.py:53-69) — NOT a module-name sniff, which both misses
+    user-defined metrics and falsely rejects scorer-shaped functions that
+    happen to live in a metrics module:
+
+    - made scorers carry ``_score_func``/``_response_method`` → scorer;
+    - otherwise inspect the signature: a first parameter named for a
+      ground-truth vector (``y_true``/``y``/``labels``) or a two-positional
+      ``(y_true, y_pred)`` shape marks a raw metric, while scorer-shaped
+      callables lead with an estimator parameter.
+    """
+    if hasattr(fn, "_score_func") or hasattr(fn, "_response_method"):
+        return False
+    # plain functions living in a metrics module are metrics: libraries
+    # never define scorer-shaped bare functions there (their scorers are
+    # make_scorer products, caught above). This catches metrics whose
+    # signatures don't look y-shaped, e.g. silhouette_score(X, labels).
+    if getattr(fn, "__module__", "").startswith(
+            ("dask_ml_tpu.metrics", "sklearn.metrics")):
+        return True
+    import inspect
+
+    try:
+        params = list(inspect.signature(fn).parameters.values())
+    except (TypeError, ValueError):  # builtins/C callables: can't tell
+        return False
+    positional = [
+        p for p in params
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+    ]
+    if not positional:
+        return False
+    first = positional[0].name.lower()
+    if first in ("y_true", "y", "labels", "labels_true"):
+        return True
+    second = positional[1].name.lower() if len(positional) > 1 else ""
+    return second in ("y_pred", "y_score", "y_prob", "labels_pred")
+
+
 def check_scoring(estimator, scoring=None, **kwargs):
     """Validate scoring for an estimator (reference: metrics/scorer.py:53-69).
     Raw metric functions (e.g. ``accuracy_score`` itself) are rejected — pass
@@ -64,13 +108,11 @@ def check_scoring(estimator, scoring=None, **kwargs):
                 f"estimator {estimator!r} has no score method; pass scoring="
             )
         return None
-    if callable(scoring) and getattr(scoring, "__module__", "").startswith(
-        ("dask_ml_tpu.metrics", "sklearn.metrics")
-    ) and not hasattr(scoring, "_score_func") and not hasattr(
-            scoring, "_response_method"):
+    if callable(scoring) and _looks_like_raw_metric(scoring):
         raise ValueError(
-            "scoring value looks like a raw metric function; wrap it with "
-            "sklearn.metrics.make_scorer (same rule as the reference, "
-            "metrics/scorer.py:53-69)"
+            "scoring value looks like a raw metric function "
+            "(signature starts with y_true/y_pred, not an estimator); "
+            "wrap it with sklearn.metrics.make_scorer (same rule as the "
+            "reference, metrics/scorer.py:53-69)"
         )
     return get_scorer(scoring)

@@ -128,3 +128,46 @@ class CheckXClassifier(BaseEstimator, ClassifierMixin):
 
     def score(self, X=None, y=None):
         return 1.0
+
+
+class CheckingClassifier(BaseEstimator, ClassifierMixin):
+    """Probe classifier asserting properties of X/y/fit_params at fit and
+    predict time — for testing that pipelines, CV, and meta-estimators do
+    not alter their inputs (reference: utils_test.py:137-175; the test
+    contract, not the implementation, is what is mirrored)."""
+
+    def __init__(self, check_y=None, check_X=None, foo_param=0,
+                 expected_fit_params=None):
+        self.check_y = check_y
+        self.check_X = check_X
+        self.foo_param = foo_param
+        self.expected_fit_params = expected_fit_params
+
+    def fit(self, X, y, **fit_params):
+        assert len(X) == len(y)
+        if self.check_X is not None:
+            assert self.check_X(X)
+        if self.check_y is not None:
+            assert self.check_y(y)
+        self.classes_ = np.unique(np.asarray(y))
+        if self.expected_fit_params:
+            missing = set(self.expected_fit_params) - set(fit_params)
+            assert not missing, (
+                f"Expected fit parameter(s) {sorted(missing)} not seen."
+            )
+            for key, value in fit_params.items():
+                assert len(value) == len(X), (
+                    f"Fit parameter {key} has length {len(value)}; "
+                    f"expected {len(X)}."
+                )
+        return self
+
+    def predict(self, X):
+        if self.check_X is not None:
+            assert self.check_X(X)
+        return self.classes_[np.zeros(len(np.asarray(X)), dtype=np.int64)]
+
+    def score(self, X=None, y=None):
+        # the reference scores foo_param > 1 as 1. vs 0. via its mock
+        # convention; keep that shape so grid tests can rank on foo_param
+        return 1.0 if self.foo_param > 1 else 0.0

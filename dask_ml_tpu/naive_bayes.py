@@ -23,7 +23,15 @@ from sklearn.base import BaseEstimator, ClassifierMixin
 from dask_ml_tpu.parallel.sharding import prepare_data, shard_rows, unpad_rows
 from dask_ml_tpu.utils.validation import check_array
 
-__all__ = ["GaussianNB", "PartialMultinomialNB", "PartialBernoulliNB"]
+__all__ = ["GaussianNB", "PartialMultinomialNB", "PartialBernoulliNB",
+           "logsumexp"]
+
+
+def logsumexp(arr, axis=0):
+    """Stable ``log(sum(exp(arr)))`` along ``axis``
+    (reference: naive_bayes.py:123-147, itself a vendored sklearn helper).
+    Jitted device reduction rather than a chunked max/exp/sum pipeline."""
+    return jax.nn.logsumexp(jnp.asarray(arr), axis=axis)
 
 
 @jax.jit

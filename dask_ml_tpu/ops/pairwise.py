@@ -20,6 +20,43 @@ import jax
 import jax.numpy as jnp
 
 
+def check_pairwise_arrays(X, Y, precomputed: bool = False):
+    """Validate/align a pair of operands for a pairwise op
+    (reference: metrics/pairwise.py:53-59, which wraps sklearn's checker
+    per-block). Returns ``(X, Y)`` as float arrays with ``Y = X`` when None;
+    raises on feature-dimension mismatch (or, for ``precomputed=True``, when
+    ``X.shape[1] != Y.shape[0]``)."""
+    X = jnp.asarray(X)
+    if X.ndim != 2:
+        raise ValueError(
+            f"Expected a 2-D array for X, got {X.ndim}-D shape {X.shape}"
+        )
+    X = X.astype(jnp.float32) if not jnp.issubdtype(X.dtype, jnp.floating) \
+        else X
+    if Y is None:
+        Y = X
+    else:
+        Y = jnp.asarray(Y)
+        if Y.ndim != 2:
+            raise ValueError(
+                f"Expected a 2-D array for Y, got {Y.ndim}-D shape {Y.shape}"
+            )
+        Y = Y.astype(jnp.float32) \
+            if not jnp.issubdtype(Y.dtype, jnp.floating) else Y
+    if precomputed:
+        if X.shape[1] != Y.shape[0]:
+            raise ValueError(
+                "Precomputed metric requires shape (n_queries, n_indexed). "
+                f"Got ({X.shape[0]}, {X.shape[1]}) for {Y.shape[0]} indexed."
+            )
+    elif X.shape[1] != Y.shape[1]:
+        raise ValueError(
+            "Incompatible dimension for X and Y matrices: "
+            f"X.shape[1] == {X.shape[1]} while Y.shape[1] == {Y.shape[1]}"
+        )
+    return X, Y
+
+
 @jax.jit
 def sq_euclidean(X: jax.Array, Y: jax.Array) -> jax.Array:
     """Squared Euclidean distance matrix, clamped at 0 against cancellation

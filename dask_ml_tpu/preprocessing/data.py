@@ -38,13 +38,9 @@ from dask_ml_tpu.utils.validation import check_array
 
 BOUNDS_THRESHOLD = 1e-7
 
-
-def handle_zeros_in_scale(scale):
-    """Zero scales mean constant features: divide by 1 instead
-    (reference: imported from dask_ml.utils at data.py:18)."""
-    scale = np.asarray(scale, dtype=float).copy()
-    scale[scale == 0.0] = 1.0
-    return scale
+# canonical home is the utils layer, as in the reference (imported from
+# dask_ml.utils at data.py:18); re-exported here for backward compat
+from dask_ml_tpu.utils._utils import handle_zeros_in_scale  # noqa: E402,F401
 
 
 @jax.jit

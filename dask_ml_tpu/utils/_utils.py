@@ -1,6 +1,55 @@
-"""Learned-attribute copying (reference: dask_ml/_utils.py:1-5)."""
+"""Small L2 helpers (reference: dask_ml/_utils.py, dask_ml/utils.py)."""
 
 from __future__ import annotations
+
+
+def slice_columns(X, columns):
+    """Column subset for frame-likes, pass-through for arrays
+    (reference: utils.py:147-151 — it slices dask DataFrames only; arrays
+    pass through untouched, and so do they here)."""
+    if hasattr(X, "iloc"):  # pandas frame
+        return X[list(X.columns) if columns is None else list(columns)]
+    return X
+
+
+def check_chunks(n_samples: int, n_features: int, chunks=None) -> tuple:
+    """Validate/normalize a row-partition request
+    (reference: utils.py:177-214).
+
+    The reference picks dask chunk sizes (one block per CPU core, >= 100
+    rows each); the mesh analogue is rows-per-shard over the data axis —
+    same signature and return convention ``(rows_per_block, n_features)``,
+    with the device count standing in for the core count. The staging layer
+    (``parallel.sharding``) doesn't need this — shards are always even —
+    but host-side block loops (``Incremental``-style streaming) use it to
+    pick a block size.
+    """
+    from collections.abc import Sequence
+    from numbers import Integral
+
+    import jax
+
+    if chunks is None:
+        chunks = (max(100, n_samples // jax.device_count()), n_features)
+    elif isinstance(chunks, Integral):
+        chunks = (max(100, n_samples // int(chunks)), n_features)
+    elif isinstance(chunks, Sequence) and not isinstance(chunks, str):
+        chunks = tuple(chunks)
+        if len(chunks) != 2:
+            raise AssertionError("Chunks should be a 2-tuple.")
+    else:
+        raise ValueError(f"Unknown type of chunks: '{type(chunks)}'")
+    return chunks
+
+
+def handle_zeros_in_scale(scale):
+    """Zero scales mean constant features: divide by 1 instead
+    (reference: utils.py:154-161)."""
+    import numpy as np
+
+    scale = np.asarray(scale, dtype=float).copy()
+    scale[scale == 0.0] = 1.0
+    return scale
 
 
 def copy_learned_attributes(from_estimator, to_estimator) -> None:

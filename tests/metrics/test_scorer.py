@@ -47,3 +47,41 @@ def test_check_scoring_none_requires_score():
     with pytest.raises(TypeError, match="score"):
         check_scoring(NoScore())
     assert check_scoring(SKLogisticRegression()) is None
+
+
+def test_check_scoring_rejects_user_defined_raw_metric():
+    """The rejection rule is structural (signature shape), so it also
+    catches raw metrics NOT defined in a metrics module — where the old
+    module-prefix sniff was blind."""
+
+    def my_metric(y_true, y_pred):
+        return float(np.mean(y_true == y_pred))
+
+    with pytest.raises(ValueError, match="raw metric"):
+        check_scoring(SKLogisticRegression(), scoring=my_metric)
+
+
+def test_check_scoring_rejects_non_y_shaped_library_metrics():
+    """Library metrics whose signatures aren't y-shaped (silhouette-style
+    (X, labels)) are still rejected via the metrics-module rule."""
+    import sklearn.metrics
+
+    with pytest.raises(ValueError, match="raw metric"):
+        check_scoring(SKLogisticRegression(),
+                      scoring=sklearn.metrics.silhouette_score)
+
+
+def test_check_scoring_accepts_scorer_shaped_callables():
+    """Scorer-shaped callables pass wherever they're defined — including
+    sklearn-metrics-module residents the old sniff falsely rejected."""
+    import sklearn.metrics
+
+    def my_scorer(estimator, X, y):
+        return float(estimator.score(X, y))
+
+    assert check_scoring(SKLogisticRegression(), scoring=my_scorer) is my_scorer
+    made = sklearn.metrics.make_scorer(metrics.accuracy_score)
+    assert check_scoring(SKLogisticRegression(), scoring=made) is made
+    # sklearn's registry scorers (module sklearn.metrics._scorer) pass too
+    reg = sklearn.metrics.get_scorer("accuracy")
+    assert check_scoring(SKLogisticRegression(), scoring=reg) is reg

@@ -415,6 +415,29 @@ def test_solve_checkpointed_admm_requires_mesh(staged, tmp_path):
             regularizer="l2", lamduh=0.1)
 
 
+def test_glm_facade_checkpoint_param(tmp_path, any_mesh):
+    """checkpoint= on the sklearn facade routes fit through
+    solve_checkpointed: an interrupted fit (small max_iter) resumes to the
+    full solution on re-fit."""
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    X, y = _logreg_problem()
+    path = str(tmp_path / "facade.ckpt")
+
+    full = LogisticRegression(solver="lbfgs", max_iter=40, tol=0.0,
+                              checkpoint=str(tmp_path / "full.ckpt"),
+                              checkpoint_every=8).fit(X, y)
+    # "killed" after 16 iterations, then resumed with the full budget
+    part = LogisticRegression(solver="lbfgs", max_iter=16, tol=0.0,
+                              checkpoint=path, checkpoint_every=8).fit(X, y)
+    assert part.n_iter_ <= 16
+    resumed = LogisticRegression(solver="lbfgs", max_iter=40, tol=0.0,
+                                 checkpoint=path, checkpoint_every=8).fit(X, y)
+    assert resumed.n_iter_ == full.n_iter_
+    np.testing.assert_allclose(resumed.coef_, full.coef_,
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_cell_journal_tolerates_torn_tail(tmp_path):
     from dask_ml_tpu.checkpoint import CellJournal
 

@@ -103,6 +103,14 @@ def test_mesh_context_is_visible_to_worker_threads():
     assert seen["mesh"] is m3
 
 
+def test_context_mesh_none_rejected():
+    """mesh=None inside a scope cannot pop the process-visible mesh stack,
+    so it errors instead of letting get_config() lie about placement."""
+    with pytest.raises(ValueError, match="cannot clear an enclosing mesh"):
+        with config_context(mesh=None):
+            pass
+
+
 def test_set_config_mesh_is_honored():
     """set_config(mesh=...) changes what default_mesh() resolves to — not
     just config_context."""
