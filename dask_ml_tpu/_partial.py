@@ -116,6 +116,12 @@ def predict(model, x, block_size: int = DEFAULT_BLOCK_SIZE):
     parts = [
         model.predict(x[i:i + block_size]) for i in range(0, n, block_size)
     ]
-    if not parts:  # zero-row input is legal: empty predictions out
-        return np.empty((0,))
+    if not parts:
+        # zero-row input is legal: let the model shape/type the empty
+        # output (preserves n_targets and label dtype); fall back to a
+        # bare empty array for models that reject empty batches
+        try:
+            return np.asarray(model.predict(x[:0]))
+        except Exception:
+            return np.empty((0,))
     return np.concatenate([np.asarray(p) for p in parts])

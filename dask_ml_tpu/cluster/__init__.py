@@ -4,16 +4,14 @@ from dask_ml_tpu.cluster.k_means import (  # noqa: F401
     KMeans,
     compute_inertia,
     evaluate_cost,
-    k_means,
-)
-from dask_ml_tpu.cluster.minibatch import PartialMiniBatchKMeans  # noqa: F401
-from dask_ml_tpu.cluster.spectral import SpectralClustering, embed  # noqa: F401
-from dask_ml_tpu.models.kmeans import (  # noqa: F401
     init_pp,
     init_random,
     init_scalable,
     k_init,
+    k_means,
 )
+from dask_ml_tpu.cluster.minibatch import PartialMiniBatchKMeans  # noqa: F401
+from dask_ml_tpu.cluster.spectral import SpectralClustering, embed  # noqa: F401
 
 __all__ = ["KMeans", "SpectralClustering", "PartialMiniBatchKMeans",
            "k_means", "compute_inertia", "evaluate_cost", "embed",

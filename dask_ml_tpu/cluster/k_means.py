@@ -12,6 +12,7 @@ from __future__ import annotations
 import logging
 from timeit import default_timer as tic
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from sklearn.base import BaseEstimator, TransformerMixin
@@ -188,6 +189,12 @@ def k_means(X, n_clusters, init="k-means||", precompute_distances="auto",
     return est.cluster_centers_, est.labels_, est.inertia_
 
 
+@jax.jit
+def _assigned_inertia(Xs, w, labels_padded, centers):
+    assigned = centers[labels_padded]
+    return jnp.sum(w * jnp.sum((Xs - assigned) ** 2, axis=1))
+
+
 def compute_inertia(X, labels, centers):
     """Sum of squared distances of rows to their ASSIGNED center
     (reference: cluster/k_means.py:243-247) — one jitted gather + fused
@@ -195,21 +202,13 @@ def compute_inertia(X, labels, centers):
     reference's code sums RAW differences (``(X - reindexed).sum()``, no
     square — a bug that can go negative); inertia here is the standard
     squared quantity, matching sklearn and this class's ``inertia_``."""
-    import jax
-
     data = prepare_data(X)
     labels = jnp.asarray(np.asarray(labels))
     centers = jnp.asarray(np.asarray(centers))
-
-    @jax.jit
-    def _inertia(Xs, w, labels_padded, centers):
-        assigned = centers[labels_padded]
-        return jnp.sum(w * jnp.sum((Xs - assigned) ** 2, axis=1))
-
     pad = data.n_padded - data.n
     if pad:
         labels = jnp.concatenate([labels, jnp.zeros((pad,), labels.dtype)])
-    return float(_inertia(data.X, data.weights, labels, centers))
+    return float(_assigned_inertia(data.X, data.weights, labels, centers))
 
 
 def evaluate_cost(X, centers):
@@ -218,3 +217,45 @@ def evaluate_cost(X, centers):
     data = prepare_data(X)
     return float(core.compute_inertia(
         data.X, data.weights, jnp.asarray(np.asarray(centers))))
+
+
+def _staged_for_init(X, random_state):
+    from dask_ml_tpu.utils.validation import check_random_state
+
+    data = prepare_data(check_array(X))
+    return data, check_random_state(random_state)
+
+
+def k_init(X, n_clusters, init="k-means||", random_state=None, max_iter=None,
+           oversampling_factor=2):
+    """Choose initial centers — reference-signature facade
+    (reference: cluster/k_means.py:254-325) over the functional core
+    (``models.kmeans.k_init``, which works on pre-staged weighted shards).
+    Returns a host ``(n_clusters, n_features)`` array."""
+    data, key = _staged_for_init(X, random_state)
+    return np.asarray(core.k_init(
+        data.X, data.weights, data.n, int(n_clusters), key, init=init,
+        oversampling_factor=oversampling_factor, max_iter=max_iter))
+
+
+def init_scalable(X, n_clusters, random_state=None, max_iter=None,
+                  oversampling_factor=2):
+    """k-means|| init (reference: cluster/k_means.py:357-422)."""
+    data, key = _staged_for_init(X, random_state)
+    return np.asarray(core.init_scalable(
+        data.X, data.weights, data.n, int(n_clusters), key,
+        oversampling_factor=oversampling_factor, max_iter=max_iter))
+
+
+def init_random(X, n_clusters, random_state=None):
+    """Random-row init (reference: cluster/k_means.py:344-354)."""
+    data, key = _staged_for_init(X, random_state)
+    return np.asarray(core.init_random(
+        data.X, data.weights, data.n, int(n_clusters), key))
+
+
+def init_pp(X, n_clusters, random_state=None):
+    """k-means++ init on gathered data — only sensible for modest n, the
+    reference carries the same caveat (cluster/k_means.py:328-341)."""
+    data, key = _staged_for_init(X, random_state)
+    return np.asarray(core.init_pp(data.X, data.n, int(n_clusters), key))

@@ -95,12 +95,7 @@ class SpectralClustering(BaseEstimator, ClusterMixin):
                 "'n_components' must be smaller than the number of samples."
                 f" Got {l} components and {n} samples"
             )
-        if isinstance(self.affinity, str) \
-                and self.affinity not in PAIRWISE_KERNEL_FUNCTIONS:
-            raise ValueError(
-                f"Unknown affinity metric name '{self.affinity}'. Expected "
-                f"one of {sorted(PAIRWISE_KERNEL_FUNCTIONS)}"
-            )
+        # affinity-name validation lives in embed() (single authority)
         rng = check_random_state_np(self.random_state)
         km = self._make_km(rng)
 
@@ -188,6 +183,11 @@ def embed(X_keep, X_rest, n_components, metric, kernel_params):
         raise ValueError(
             f"Unknown affinity metric name '{metric}'. Expected one of "
             f"{sorted(PAIRWISE_KERNEL_FUNCTIONS)}"
+        )
+    if n_components != len(X_keep):
+        raise ValueError(
+            f"n_components={n_components} must equal the number of sampled "
+            f"rows len(X_keep)={len(X_keep)}"
         )
     params = dict(kernel_params or {})
     Xk = replicate(np.asarray(X_keep))

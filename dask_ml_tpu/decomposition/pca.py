@@ -115,7 +115,19 @@ class PCA(BaseEstimator, TransformerMixin):
             )
 
         mesh = mesh_lib.default_mesh()
-        data = prepare_data(X, mesh=mesh)
+        # Feature-axis tensor parallelism (SURVEY §2.9): on a 2-D
+        # ('data', 'model') mesh stage X over BOTH axes when n_features
+        # divides the model axis — GSPMD then splits every d-axis
+        # contraction (the Gram work of the power iterations, the Qᵀ·X
+        # projections) across devices. The even-division restriction keeps
+        # the variance bookkeeping exact (zero padding columns would enter
+        # n_features-dependent formulas); GLMs, whose coefficients slice
+        # cleanly, take the padded path instead.
+        shard_features = (
+            mesh_lib.n_model_shards(mesh) > 1
+            and n_features % mesh_lib.n_model_shards(mesh) == 0
+        )
+        data = prepare_data(X, mesh=mesh, shard_features=shard_features)
         mean = _weighted_mean(data.X, data.weights)
         Xc = _center_and_mask(data.X, data.weights, mean)
 

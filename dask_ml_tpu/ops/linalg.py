@@ -156,19 +156,8 @@ def svd_compressed(X, k: int, n_power_iter: int = 0, key=None,
                                 n_oversamples=int(n_oversamples))
 
 
-@partial(jax.jit, static_argnames=("u_based_decision",))
-def svd_flip(u, v, u_based_decision: bool = False):
-    """Deterministic SVD signs (the reference wraps sklearn's via a delayed
-    task, utils.py:18-25). Default is the v-based convention — the max-|v|
-    entry of each right singular vector made positive — matching modern
-    sklearn (≥1.5) PCA/TruncatedSVD so differential tests compare signed
-    components. v-based is also the cheap choice here: v is the small
-    replicated factor, so the sign decision involves no sharded reduction."""
-    if u_based_decision:
-        max_rows = jnp.argmax(jnp.abs(u), axis=0)
-        signs = jnp.sign(u[max_rows, jnp.arange(u.shape[1])])
-    else:
-        max_cols = jnp.argmax(jnp.abs(v), axis=1)
-        signs = jnp.sign(v[jnp.arange(v.shape[0]), max_cols])
-    signs = jnp.where(signs == 0, 1.0, signs)
-    return u * signs[None, :], v * signs[:, None]
+# canonical home is the utils layer (as in the reference, utils.py:18-25);
+# re-exported here because every decomposition caller reaches it as
+# linalg.svd_flip. Living in utils.validation (a leaf module) keeps
+# utils/__init__ from importing ops at package-init time (circular).
+from dask_ml_tpu.utils.validation import svd_flip  # noqa: E402,F401

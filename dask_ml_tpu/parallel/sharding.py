@@ -262,8 +262,12 @@ def prepare_data(
     (:mod:`dask_ml_tpu.config`): ``config_context(dtype=bfloat16)`` runs
     every staged fit in bf16 without touching estimator code.
     ``shard_features`` is deliberately NOT config-driven — feature padding
-    changes the shape of fitted state, so only cores written for it (the
-    GLMs, which slice back to the true width) may enable it.
+    changes the shape of fitted state, so only cores written for it may
+    enable it. Current callers and their padding-safety arguments: the
+    GLMs (slice coefficients back to the true width) and PCA (passes it
+    only when d divides the model axis, so no padding columns enter its
+    n_features-dependent variance formulas). A new caller must satisfy one
+    of those two disciplines.
 
     Inside a :func:`staging_memo` scope, repeated calls on the same source
     objects return the already-staged ``DeviceData`` (one transfer per

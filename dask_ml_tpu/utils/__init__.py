@@ -9,13 +9,13 @@ from dask_ml_tpu.utils._log import (  # noqa: F401
     log_array,
     profile_phase,
 )
-from dask_ml_tpu.ops.linalg import svd_flip  # noqa: F401
 from dask_ml_tpu.utils._utils import (  # noqa: F401
     check_chunks,
     copy_learned_attributes,
     handle_zeros_in_scale,
     slice_columns,
 )
+from dask_ml_tpu.utils.validation import svd_flip  # noqa: F401
 from dask_ml_tpu.utils.validation import (  # noqa: F401
     check_array,
     check_random_state,

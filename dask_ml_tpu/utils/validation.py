@@ -10,6 +10,7 @@ splittable per shard.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional, Union
 
 import jax
@@ -124,3 +125,21 @@ def row_norms(X, squared: bool = False) -> jax.Array:
     X = jnp.asarray(X)
     sq = jnp.sum(X * X, axis=-1)
     return sq if squared else jnp.sqrt(sq)
+
+
+@partial(jax.jit, static_argnames=("u_based_decision",))
+def svd_flip(u, v, u_based_decision: bool = False):
+    """Deterministic SVD signs (the reference wraps sklearn's via a delayed
+    task, utils.py:18-25). Default is the v-based convention — the max-|v|
+    entry of each right singular vector made positive — matching modern
+    sklearn (≥1.5) PCA/TruncatedSVD so differential tests compare signed
+    components. v-based is also the cheap choice here: v is the small
+    replicated factor, so the sign decision involves no sharded reduction."""
+    if u_based_decision:
+        max_rows = jnp.argmax(jnp.abs(u), axis=0)
+        signs = jnp.sign(u[max_rows, jnp.arange(u.shape[1])])
+    else:
+        max_cols = jnp.argmax(jnp.abs(v), axis=1)
+        signs = jnp.sign(v[jnp.arange(v.shape[0]), max_cols])
+    signs = jnp.where(signs == 0, 1.0, signs)
+    return u * signs[None, :], v * signs[:, None]

@@ -523,3 +523,59 @@ def test_device_staging_shared_across_candidates(mesh8, monkeypatch):
     # per split x {train, test}: one check-array entry, one prepare_data
     # entry, one inner shard_rows entry → 6 per split
     assert gs.n_device_stagings_ <= 6 * n_splits
+
+
+def test_cache_cv_false_matches(clf_data):
+    """cache_cv only controls slice materialization caching, never results
+    (reference: _search.py:979-999 cache_cv semantics)."""
+    X, y = clf_data
+    grid = {"C": [0.1, 1.0, 10.0]}
+    a = GridSearchCV(SKLogisticRegression(), grid, cv=3, refit=False,
+                     iid=False, cache_cv=True).fit(X, y)
+    b = GridSearchCV(SKLogisticRegression(), grid, cv=3, refit=False,
+                     iid=False, cache_cv=False).fit(X, y)
+    np.testing.assert_allclose(a.cv_results_["mean_test_score"],
+                               b.cv_results_["mean_test_score"], rtol=1e-12)
+
+
+def test_sequential_vs_threaded_equivalence(clf_data):
+    """n_jobs=1 and a thread pool produce identical cv_results_ (ordering
+    and CSE are deterministic under the future-memo)."""
+    X, y = clf_data
+    pipe = Pipeline([("scale", SKStandardScaler()),
+                     ("clf", SKLogisticRegression())])
+    grid = {"clf__C": [0.1, 1.0, 10.0, 100.0]}
+    seq = GridSearchCV(pipe, grid, cv=3, refit=False, iid=False,
+                       n_jobs=1).fit(X, y)
+    par = GridSearchCV(pipe, grid, cv=3, refit=False, iid=False,
+                       n_jobs=8).fit(X, y)
+    for key in ("mean_test_score", "rank_test_score",
+                "split0_test_score", "split2_test_score"):
+        np.testing.assert_allclose(np.asarray(seq.cv_results_[key]),
+                                   np.asarray(par.cv_results_[key]),
+                                   rtol=1e-12)
+    assert seq.n_shared_fits_ == par.n_shared_fits_
+
+
+def test_multimetric_with_error_score(clf_data):
+    """A failing candidate under multimetric scoring gets error_score in
+    EVERY metric column while healthy candidates score normally
+    (reference: test_model_selection.py multimetric + FIT_FAILURE)."""
+    X, y = clf_data
+    gs = GridSearchCV(
+        FailingClassifier(),
+        {"parameter": [0, 1, FailingClassifier.FAILING_PARAMETER]},
+        cv=3,
+        scoring={"acc": "accuracy",
+                 "half": lambda est, X, y: 0.5},  # FailingClassifier has no
+        refit=False, iid=False, error_score=-7.5,  # predict_proba
+    )
+    with pytest.warns(FitFailedWarning):
+        gs.fit(X, y)
+    res = gs.cv_results_
+    fail_idx = 2
+    for m in ("acc", "half"):
+        for si in range(3):
+            assert res[f"split{si}_test_{m}"][fail_idx] == -7.5
+        assert np.isfinite(res[f"mean_test_{m}"][:2]).all()
+        assert (res[f"mean_test_{m}"][:2] != -7.5).all()

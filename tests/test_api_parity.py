@@ -77,6 +77,32 @@ def test_reference_surface_resolves(module):
     assert not missing, f"{module} missing: {missing}"
 
 
+def test_every_subpackage_imports_first_in_fresh_process():
+    """Each public module must import as the FIRST dask_ml_tpu import of a
+    process. pytest imports everything through conftest in one order, which
+    masks circular imports that a user's single `from dask_ml_tpu.X import
+    Y` hits — this caught two real cycles (utils↔preprocessing,
+    utils↔ops.linalg)."""
+    import subprocess
+    import sys
+
+    mods = [
+        "cluster", "decomposition", "linear_model", "metrics",
+        "model_selection", "naive_bayes", "preprocessing", "wrappers",
+        "datasets", "parallel", "ops", "utils", "checkpoint", "config",
+        "interop", "_partial", "neural_network",
+    ]
+    failures = []
+    for m in mods:
+        r = subprocess.run(
+            [sys.executable, "-c", f"import dask_ml_tpu.{m}"],
+            capture_output=True, text=True, timeout=120,
+        )
+        if r.returncode != 0:
+            failures.append((m, r.stderr.strip().splitlines()[-1:]))
+    assert not failures, f"first-import failures: {failures}"
+
+
 # -- functional smoke checks for the parity-tail helpers --------------------
 
 
@@ -106,6 +132,23 @@ def test_k_means_functional():
     assert len(set(labels[:40])) == 1 and labels[0] != labels[-1]
     out3 = k_means(X, 2, random_state=0)
     assert len(out3) == 3
+
+
+def test_init_wrappers_reference_signatures():
+    """k_init/init_* are callable with the reference's documented
+    signatures (X, n_clusters, ...), not the staged-core ones."""
+    from dask_ml_tpu.cluster import init_pp, init_random, init_scalable, k_init
+
+    rng = np.random.RandomState(5)
+    X = rng.randn(60, 3).astype(np.float32)
+    for fn in (k_init, init_scalable, init_random, init_pp):
+        centers = fn(X, 4, random_state=0) if fn is not k_init else fn(
+            X, 4, init="k-means||", random_state=0)
+        assert centers.shape == (4, 3)
+        assert isinstance(centers, np.ndarray)
+    # array passthrough via k_init
+    arr = X[:4].copy()
+    np.testing.assert_array_equal(k_init(X, 4, init=arr), arr)
 
 
 def test_compute_inertia_and_evaluate_cost():

@@ -176,6 +176,53 @@ def test_facade_2d_search_shares_staged_slices(mesh2d):
     assert memo.hits == 4
 
 
+def test_core_svd_2d_matches_1d(mesh2d):
+    """Decomposition cores run transparently feature-sharded: randomized
+    SVD and exact tsvd on a 2-D mesh match the 1-D data-parallel result."""
+    from dask_ml_tpu.ops import linalg
+
+    rng = np.random.RandomState(3)
+    X = (rng.randn(256, 16) @ np.diag(np.linspace(3, 0.1, 16))).astype(
+        np.float32)
+    m1 = mesh_lib.make_mesh()
+    d1 = prepare_data(X, mesh=m1)
+    _, S1, _ = linalg.svd_compressed(d1.X, 4, 2, jax.random.key(0), mesh=m1)
+    d2 = prepare_data(X, mesh=mesh2d, shard_features=True)
+    _, S2, _ = linalg.svd_compressed(d2.X, 4, 2, jax.random.key(0),
+                                     mesh=mesh2d)
+    np.testing.assert_allclose(np.asarray(S2), np.asarray(S1),
+                               rtol=1e-3, atol=1e-4)
+    _, St1, _ = linalg.tsvd(d1.X, mesh=m1)
+    _, St2, _ = linalg.tsvd(d2.X, mesh=mesh2d)
+    np.testing.assert_allclose(np.asarray(St2), np.asarray(St1),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_facade_2d_pca_matches_1d(mesh2d):
+    """PCA under a 2-D mesh (d divisible by the model axis) matches the
+    1-D fit: components, variances, and transforms."""
+    from dask_ml_tpu.decomposition import PCA
+
+    rng = np.random.RandomState(4)
+    X = (rng.randn(300, 8) @ np.diag(np.linspace(2, 0.3, 8))).astype(
+        np.float32)
+    with mesh_lib.use_mesh(mesh_lib.make_mesh()):
+        ref = PCA(n_components=3, svd_solver="tsqr").fit(X)
+    with mesh_lib.use_mesh(mesh2d):
+        tp = PCA(n_components=3, svd_solver="tsqr").fit(X)
+        Xt = tp.transform(X[:16])
+    np.testing.assert_allclose(tp.explained_variance_,
+                               ref.explained_variance_, rtol=1e-3)
+    np.testing.assert_allclose(np.abs(tp.components_),
+                               np.abs(ref.components_), rtol=1e-2, atol=1e-3)
+    assert Xt.shape == (16, 3)
+    # indivisible d falls back to plain data-parallel staging and still works
+    X9 = rng.randn(120, 9).astype(np.float32)
+    with mesh_lib.use_mesh(mesh2d):
+        est9 = PCA(n_components=2).fit(X9)
+    assert est9.components_.shape == (2, 9)
+
+
 def test_facade_2d_admm_falls_back_to_data_parallel(mesh2d):
     """ADMM keeps its per-shard shard_map layout on a 2-D mesh (documented:
     consensus state is data-parallel by construction) and still converges."""
