@@ -109,6 +109,15 @@ def bench_kmeans(rtt):
         t = max(measure(f, Xd, w, centers0, tol) - rtt, 1e-9)
         out[dtype_name] = n * iters / t / jax.device_count()
 
+    # the opt-in single-pass pallas variant, for the record: halves logical
+    # HBM traffic but Mosaic's pipeline doesn't saturate the bandwidth the
+    # whole-shard XLA path reaches, so auto keeps XLA (models/kmeans.py
+    # _lloyd_iter_pallas docstring has the analysis)
+    fp = partial(core.lloyd_loop_fused, mesh=mesh, max_iter=iters,
+                 kernel="pallas")
+    t_pallas = max(measure(fp, X, w, centers0, tol) - rtt, 1e-9)
+    out["pallas"] = n * iters / t_pallas / jax.device_count()
+
     # streaming floor: bare distance matmul + min over the same data,
     # feature-major, same rep count — the kernel's bandwidth floor
     XT = jnp.asarray(np.asarray(X).T.copy())
@@ -149,6 +158,7 @@ def bench_kmeans(rtt):
         "vs_baseline": round(out["float32"] * 1.0 / sk_rate, 2),
         "dtype": "float32 (f32 accumulation)",
         "bf16_samples_per_sec_per_chip": round(out["bfloat16"], 1),
+        "pallas_single_pass_samples_per_sec_per_chip": round(out["pallas"], 1),
         "effective_gbps_logical": round(gbps, 1),
         "spec_frac_of_v5e_819gbps": round(gbps / HBM_V5E_SPEC_GBPS, 3),
         "floor_us_per_iter": round(t_floor * 1e6, 1),

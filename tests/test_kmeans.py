@@ -119,3 +119,48 @@ def test_determinism(blobs):
     a = KMeans(n_clusters=3, random_state=7).fit(X)
     b = KMeans(n_clusters=3, random_state=7).fit(X)
     np.testing.assert_array_equal(a.cluster_centers_, b.cluster_centers_)
+
+
+def test_pallas_lloyd_matches_xla(blobs):
+    """The opt-in single-pass Pallas iteration (interpret mode off-TPU)
+    reproduces the XLA path bit-for-bit-ish: same trajectory, same final
+    centers/inertia — weighted, multi-block, and padded-shard cases."""
+    import jax
+    import jax.numpy as jnp
+
+    from dask_ml_tpu.models import kmeans as core
+    from dask_ml_tpu.parallel import mesh as mesh_lib
+    from dask_ml_tpu.parallel.sharding import prepare_data
+
+    X, _ = blobs
+    rng = np.random.RandomState(3)
+    sw = rng.uniform(0.5, 2.0, X.shape[0]).astype(np.float32)
+    mesh = mesh_lib.make_mesh(n_devices=3)  # uneven shards: padding path
+    data = prepare_data(X, sample_weight=sw, mesh=mesh)
+    c0 = core.init_random(data.X, data.weights, data.n, 3, jax.random.key(0))
+    tol = jnp.asarray(0.0, jnp.float32)
+    out_x = core.lloyd_loop_fused(data.X, data.weights, c0, tol, mesh=mesh,
+                                  max_iter=7, kernel="xla")
+    # shrink the block so the grid has several steps per shard — otherwise
+    # the scratch-accumulator init/+=/finalize sequence degenerates to one
+    # block and a cross-block regression would pass unnoticed
+    old_blk, core._LLOYD_BLK = core._LLOYD_BLK, 32
+    try:
+        assert data.X.shape[0] // 3 > 2 * core._LLOYD_BLK  # grid >= 3
+        jax.clear_caches()  # the block size is baked in at trace time
+        out_p = core.lloyd_loop_fused(data.X, data.weights, c0, tol,
+                                      mesh=mesh, max_iter=7, kernel="pallas")
+    finally:
+        core._LLOYD_BLK = old_blk
+    np.testing.assert_allclose(np.asarray(out_p[0]), np.asarray(out_x[0]),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(out_p[1]), float(out_x[1]), rtol=1e-4)
+
+    with pytest.raises(ValueError, match="pallas"):
+        core.lloyd_loop_fused(
+            data.X, data.weights,
+            jnp.zeros((3, 600), jnp.float32),  # d beyond the supported bound
+            tol, mesh=mesh, max_iter=1, kernel="pallas")
+    with pytest.raises(ValueError, match="kernel"):
+        core.lloyd_loop_fused(data.X, data.weights, c0, tol, mesh=mesh,
+                              max_iter=1, kernel="nope")
