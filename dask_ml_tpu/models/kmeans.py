@@ -296,11 +296,11 @@ def lloyd_loop_fused(X, w, centers0, tol, *, mesh, max_iter: int,
         # the transpose into each iteration's reads (which would re-pad d
         # back onto the lane dimension).
         XT = jax.lax.optimization_barrier(X_loc.T)  # (d, n_loc)
-        kidx = jnp.arange(k, dtype=jnp.int32)[:, None]
         if use_pallas:
             w2d = w_loc[None, :].astype(jnp.float32)
         else:
             x2 = jnp.sum(XT.astype(jnp.float32) ** 2, axis=0)  # invariant
+            kidx = jnp.arange(k, dtype=jnp.int32)[:, None]
 
         def local_stats_xla(centers):
             cx = centers.astype(XT.dtype)
