@@ -252,10 +252,19 @@ class Incremental(ParallelPostFit):
         return self._fit_for_estimator(estimator, X, y, **fit_kwargs)
 
 
-def fit(model, X, y=None, block_size: int = DEFAULT_BLOCK_SIZE, **kwargs):
+def fit(model, X, y=None, compute: bool = True,
+        block_size: int = DEFAULT_BLOCK_SIZE, **kwargs):
     """Functional sequential-chain fit — API parity with the reference's
-    ``_partial.fit`` (reference: _partial.py:110-182). Returns the fitted
-    model (the same object, mutated, as sklearn's partial_fit does)."""
+    ``_partial.fit`` (reference: _partial.py:110-182, whose ``compute=``
+    picks lazy vs eager graph execution; it sits in the reference's
+    positional slot so ported ``fit(model, x, y, False)`` calls bind
+    correctly). Returns the fitted model (the same object, mutated, as
+    sklearn's partial_fit does). ``compute`` itself is a no-op: the chain
+    here is inherently eager — each block's update is the next block's
+    input — and jax's async dispatch already overlaps device work with
+    the host loop, which is the capability ``compute=False`` bought the
+    reference."""
+    del compute
     if not hasattr(model, "partial_fit"):
         raise TypeError(f"{model!r} does not implement partial_fit")
     X = _as_rowsliceable(X)

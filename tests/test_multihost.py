@@ -84,7 +84,12 @@ def test_two_process_runtime(tmp_path):
 
     repo_root = os.path.dirname(os.path.dirname(dask_ml_tpu.__file__))
     env = dict(os.environ)
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    # replace only the device-count flag, preserving any other XLA flags
+    # the environment carries (matching conftest.py's append discipline)
+    kept = [f for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f]
+    env["XLA_FLAGS"] = " ".join(
+        kept + ["--xla_force_host_platform_device_count=2"])
     env.pop("JAX_PLATFORMS", None)  # worker pins cpu via jax.config
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
@@ -95,10 +100,16 @@ def test_two_process_runtime(tmp_path):
         )
         for pid in (0, 1)
     ]
+    outs = []
     try:
-        outs = [p.communicate(timeout=180)[0] for p in procs]
+        for p in procs:
+            try:
+                outs.append(p.communicate(timeout=180)[0])
+            except subprocess.TimeoutExpired:
+                p.kill()  # collect whatever it printed before the hang
+                outs.append(p.communicate()[0] + "\n<timed out>")
     finally:
-        for p in procs:  # never leak live workers on timeout/assert paths
+        for p in procs:  # never leak live workers on any failure path
             if p.poll() is None:
                 p.kill()
                 p.wait()
@@ -114,7 +125,6 @@ def test_two_process_runtime(tmp_path):
     ]
     assert len(betas) == 2 and betas[0] == betas[1]
 
-    import jax
     import jax.numpy as jnp
 
     from dask_ml_tpu.models import glm as core
@@ -129,4 +139,3 @@ def test_two_process_runtime(tmp_path):
     got = np.array([float(v) for v in betas[0].split()[1:]])
     np.testing.assert_allclose(got, np.asarray(beta_oracle),
                                rtol=1e-3, atol=1e-4)
-    del jax

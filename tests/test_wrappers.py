@@ -139,6 +139,11 @@ def test_functional_fit_parity(Xy):
     np.testing.assert_allclose(m.coef_, manual.coef_)
     with pytest.raises(TypeError, match="partial_fit"):
         wrappers.fit(SKPCA(), X)
+    # the reference's positional compute slot binds harmlessly:
+    # fit(model, x, y, compute) ported verbatim must not hit block_size
+    m2 = wrappers.fit(SGDClassifier(random_state=0, tol=1e-3), X, y, False,
+                      block_size=100, classes=[0, 1])
+    np.testing.assert_allclose(m2.coef_, manual.coef_)
 
 
 def test_incremental_scan_matches_host_loop(mesh8):
