@@ -470,6 +470,12 @@ class _CandidateRunner:
             return token, FIT_FAILURE, FIT_FAILURE, total_time, True
         out = methods.copy_estimator(pipe)
         out.steps = fitted_steps
+        if need_transform and Xt is None:
+            # every stage was identity (passthrough/dropped): the pipeline's
+            # transform output IS its input — resolve it so a FeatureUnion
+            # parent has a real array to concatenate, like sklearn's
+            # identity branch
+            Xt = self._resolve_input(token, split_idx, root_pairwise)
         # `token` is the last real stage's token; its memo entry already holds
         # Xt, but for a fit-only tail there is no transform output to expose.
         return token, out, Xt, total_time, False
@@ -503,6 +509,15 @@ class _CandidateRunner:
             if _is_dropped(trans):
                 sub_tokens.append("drop")
                 sub_fitted.append((name, trans))
+                continue
+            if trans == "passthrough":
+                # identity member (sklearn accepts the sentinel here too):
+                # contributes the union's INPUT columns unchanged
+                sub_tokens.append(upstream)
+                sub_fitted.append((name, trans))
+                if need_transform:
+                    sub_parts.append((name, self._resolve_input(
+                        upstream, split_idx, root_pairwise)))
                 continue
             if need_transform:
                 tok, fitted, Xt, t, f = self._fit_transform_any(

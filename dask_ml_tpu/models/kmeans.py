@@ -95,7 +95,10 @@ def lloyd_loop(X, w, centers, tol, max_iter: int):
         return (new_centers, inertia.astype(jnp.float32), it + 1,
                 shift.astype(jnp.float32))
 
-    init = (centers, jnp.asarray(jnp.inf, jnp.float32),
+    # centers carry in f32 regardless of the caller's dtype: the M-step's
+    # f32-accumulated sums promote new_centers, and a bf16 init would
+    # type-mismatch the while_loop carry (lloyd_loop_fused does the same)
+    init = (centers.astype(jnp.float32), jnp.asarray(jnp.inf, jnp.float32),
             jnp.asarray(0, jnp.int32), jnp.asarray(jnp.inf, jnp.float32))
     return jax.lax.while_loop(cond, body, init)
 

@@ -265,3 +265,39 @@ def test_union_refit_delegation(clf_data):
     ).fit(X, y)
     assert gs.predict(X).shape == (len(y),)
     assert gs.best_estimator_.score(X, y) > 0.5
+
+
+def test_union_passthrough_member(clf_data):
+    """A 'passthrough' union member (sklearn-legal) contributes the input
+    columns unchanged; results match sklearn's GridSearchCV."""
+    X, y = clf_data
+    pipe = Pipeline([
+        ("u", FeatureUnion([("pt", "passthrough"),
+                            ("sc", SKStandardScaler())])),
+        ("clf", SKLogisticRegression()),
+    ])
+    grid = {"clf__C": [0.5, 1.0]}
+    ours = GridSearchCV(pipe, grid, cv=3, iid=False, refit=False).fit(X, y)
+    theirs = SkGridSearchCV(pipe, grid, cv=3, refit=False).fit(X, y)
+    np.testing.assert_allclose(ours.cv_results_["mean_test_score"],
+                               theirs.cv_results_["mean_test_score"],
+                               rtol=1e-6)
+
+
+def test_union_member_identity_pipeline(clf_data):
+    """A union member that is a pipeline of ONLY passthrough stages
+    transforms to its input (sklearn's identity branch)."""
+    X, y = clf_data
+    pipe = Pipeline([
+        ("u", FeatureUnion([
+            ("p", Pipeline([("id", "passthrough")])),
+            ("sc", SKStandardScaler()),
+        ])),
+        ("clf", SKLogisticRegression()),
+    ])
+    grid = {"clf__C": [0.5, 1.0]}
+    ours = GridSearchCV(pipe, grid, cv=3, iid=False, refit=False).fit(X, y)
+    theirs = SkGridSearchCV(pipe, grid, cv=3, refit=False).fit(X, y)
+    np.testing.assert_allclose(ours.cv_results_["mean_test_score"],
+                               theirs.cv_results_["mean_test_score"],
+                               rtol=1e-6)

@@ -164,3 +164,20 @@ def test_pallas_lloyd_matches_xla(blobs):
     with pytest.raises(ValueError, match="kernel"):
         core.lloyd_loop_fused(data.X, data.weights, c0, tol, mesh=mesh,
                               max_iter=1, kernel="nope")
+
+
+def test_lloyd_loop_accepts_bf16(blobs):
+    """The non-fused loop's carry is f32 regardless of input dtype — bf16
+    X/centers must not type-mismatch the while_loop."""
+    import jax.numpy as jnp
+
+    from dask_ml_tpu.models import kmeans as core
+
+    X, _ = blobs
+    Xb = jnp.asarray(X, jnp.bfloat16)
+    w = jnp.ones((X.shape[0],), jnp.float32)
+    c0 = Xb[:3]
+    out = core.lloyd_loop(Xb, w, c0, jnp.asarray(0.0, jnp.float32),
+                          max_iter=3)
+    assert out[0].dtype == jnp.float32
+    assert np.isfinite(np.asarray(out[0], dtype=np.float32)).all()
