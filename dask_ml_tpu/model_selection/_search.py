@@ -512,7 +512,17 @@ class _CandidateRunner:
                 continue
             if trans == "passthrough":
                 # identity member (sklearn accepts the sentinel here too):
-                # contributes the union's INPUT columns unchanged
+                # contributes the union's INPUT columns unchanged. Candidate
+                # params targeting it cannot apply — hard error, as
+                # sklearn's set_params would raise (never a silent drop
+                # that would also collapse distinct candidates' memo keys)
+                stray = dict(per_sub.get(name) or {})
+                stray.update(per_sub_fp.get(name) or {})
+                if stray:
+                    raise ValueError(
+                        f"parameters {sorted(stray)} target union member "
+                        f"'{name}', which is 'passthrough'"
+                    )
                 sub_tokens.append(upstream)
                 sub_fitted.append((name, trans))
                 if need_transform:

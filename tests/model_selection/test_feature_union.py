@@ -284,6 +284,22 @@ def test_union_passthrough_member(clf_data):
                                rtol=1e-6)
 
 
+def test_union_passthrough_member_rejects_params(clf_data):
+    """Candidate params targeting a 'passthrough' member error loudly (a
+    silent drop would also collapse distinct candidates into one memoized
+    result); sklearn's set_params raises for the same spelling."""
+    X, y = clf_data
+    pipe = Pipeline([
+        ("u", FeatureUnion([("pt", "passthrough"),
+                            ("sc", SKStandardScaler())])),
+        ("clf", SKLogisticRegression()),
+    ])
+    gs = GridSearchCV(pipe, {"u__pt__copy": [True, False],
+                             "clf__C": [1.0]}, cv=3, iid=False, refit=False)
+    with pytest.raises(ValueError, match="passthrough"):
+        gs.fit(X, y)
+
+
 def test_union_member_identity_pipeline(clf_data):
     """A union member that is a pipeline of ONLY passthrough stages
     transforms to its input (sklearn's identity branch)."""

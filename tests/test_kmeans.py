@@ -181,3 +181,18 @@ def test_lloyd_loop_accepts_bf16(blobs):
                           max_iter=3)
     assert out[0].dtype == jnp.float32
     assert np.isfinite(np.asarray(out[0], dtype=np.float32)).all()
+
+
+def test_kmeans_compile_cache(blobs):
+    """Identical-shape refits hit the jit cache (the §4 'laziness
+    assertion' analogue: count compilations, not graph materializations).
+    A search over KMeans candidates depends on this — every candidate
+    shares one compiled Lloyd program per (shape, max_iter)."""
+    from dask_ml_tpu.models import kmeans as core
+
+    X, _ = blobs
+    KMeans(n_clusters=3, random_state=0).fit(X)  # warm
+    before = core.lloyd_loop_fused._cache_size()
+    KMeans(n_clusters=3, random_state=1).fit(X)
+    KMeans(n_clusters=3, random_state=2, tol=1e-3).fit(X)
+    assert core.lloyd_loop_fused._cache_size() == before
