@@ -36,6 +36,13 @@ class TruncatedSVD(BaseEstimator, TransformerMixin):
                 "n_components must be < n_features; "
                 f"got {self.n_components} >= {X.shape[1]}"
             )
+        if self.n_components > X.shape[0]:
+            # same guard PCA applies (pca.py): beyond n_samples the extra
+            # directions would be zero-singular-value padding artifacts
+            raise ValueError(
+                "n_components must be <= n_samples; "
+                f"got {self.n_components} > {X.shape[0]}"
+            )
         return X
 
     def fit(self, X, y=None):

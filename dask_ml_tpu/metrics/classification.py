@@ -51,7 +51,12 @@ def accuracy_score(
 
 
 @jax.jit
-def _log_loss(y_true, proba, sample_weight, eps: float = 1e-15):
+def _log_loss(y_true, proba, sample_weight):
+    # dtype-aware clip (sklearn uses finfo(dtype).eps too): a fixed 1e-15
+    # is below f32 machine epsilon, so 1 - eps == 1 exactly and a confident
+    # p == 1.0 prediction would hit log(0)·0 = NaN
+    eps = jnp.finfo(proba.dtype).eps if jnp.issubdtype(
+        proba.dtype, jnp.floating) else jnp.float32(1e-7)
     p = jnp.clip(proba, eps, 1.0 - eps)
     if p.ndim == 1:
         ll = -(y_true * jnp.log(p) + (1.0 - y_true) * jnp.log(1.0 - p))
@@ -61,11 +66,40 @@ def _log_loss(y_true, proba, sample_weight, eps: float = 1e-15):
     return jnp.average(ll, weights=sample_weight)
 
 
-def log_loss(y_true, y_pred, sample_weight=None, compute: bool = True):
+def log_loss(y_true, y_pred, sample_weight=None, labels=None,
+             compute: bool = True):
     """Cross-entropy loss over probability predictions (capability-parity-plus:
-    the reference has no dask log_loss, but its GLM scoring needs one)."""
-    y_true = jnp.asarray(y_true)
+    the reference has no dask log_loss, but its GLM scoring needs one).
+
+    Labels are encoded positionally against the sorted class set (sklearn's
+    column convention), so arbitrary label values — {-1, 1}, {5, 7, 9} —
+    score correctly instead of being treated as raw 0..K-1 codes."""
+    import numpy as np
+
+    y_arr = np.asarray(y_true)
+    classes = np.unique(y_arr) if labels is None else np.asarray(labels)
+    if len(classes) < 2:
+        raise ValueError(
+            "y_true contains a single label; pass labels= with the full "
+            "class set"
+        )
+    codes = np.searchsorted(classes, y_arr)
+    in_range = codes < len(classes)
+    if not (in_range.all()
+            and np.array_equal(classes[codes], y_arr)):
+        raise ValueError("y_true contains labels not in `labels`")
     y_pred = jnp.asarray(y_pred)
+    if y_pred.ndim == 2 and y_pred.shape[1] != len(classes):
+        raise ValueError(
+            f"y_pred has {y_pred.shape[1]} columns but there are "
+            f"{len(classes)} classes"
+        )
+    if y_pred.ndim == 1 and len(classes) != 2:
+        raise ValueError(
+            "1-D y_pred (probability of the positive class) requires "
+            f"exactly 2 classes, got {len(classes)}"
+        )
+    y_true = jnp.asarray(codes)
     if sample_weight is None:
         sample_weight = jnp.ones(y_true.shape[0], dtype=jnp.float32)
     else:

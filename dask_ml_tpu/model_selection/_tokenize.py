@@ -36,9 +36,18 @@ def _normalize(obj, h):
             _normalize(v, h)
             _update(h, ",")
         _update(h, ")")
-    elif isinstance(obj, np.ndarray):
-        _update(h, f"nd:{obj.shape}:{obj.dtype}:")
-        h.update(np.ascontiguousarray(obj).tobytes())
+    elif isinstance(obj, np.ndarray) or (
+        hasattr(obj, "shape") and hasattr(obj, "dtype")
+        and hasattr(obj, "__array__")
+    ):
+        # covers jax Arrays and other ndarray-likes too: repr() would
+        # truncate large arrays ('...') and collide distinct contents
+        arr = np.ascontiguousarray(np.asarray(obj))
+        _update(h, f"nd:{arr.shape}:{arr.dtype}:")
+        if arr.dtype == object:
+            _update(h, repr(arr.tolist()))
+        else:
+            h.update(arr.tobytes())
     elif isinstance(obj, (list, tuple)):
         _update(h, f"{type(obj).__name__}[")
         for v in obj:

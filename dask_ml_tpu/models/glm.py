@@ -251,7 +251,7 @@ def newton(X, y, w, beta0, mask, *, family="logistic", regularizer="l2",
     def obj(b):
         return obj_full(b, X, y, w, lam_eff, mask) / sw
 
-    grad = jax.grad(obj)
+    value_and_grad = jax.value_and_grad(obj)
 
     def cond(state):
         _, it, done = state
@@ -260,14 +260,15 @@ def newton(X, y, w, beta0, mask, *, family="logistic", regularizer="l2",
     def body(state):
         beta, it, _ = state
         eta = X @ beta
-        g = grad(beta)
+        # value+gradient in ONE data pass (gd/lbfgs do the same); a separate
+        # obj(beta) call would add a redundant O(n·d) traversal per iteration
+        f0, g = value_and_grad(beta)
         h = w * hess_fn(eta, y)
         H = (X.T @ (h[:, None] * X)) / sw
         # Smooth-l2 curvature for the penalized coords + a tiny ridge so the
         # solve never blows up on collinear features.
         H = H + jnp.diag(lam_eff / sw * mask + 1e-8)
         direction = -jnp.linalg.solve(H, g)
-        f0 = obj(beta)
         t, _, _ = _backtrack(obj, beta, f0, g, direction, jnp.asarray(1.0, sdt))
         step = t * direction
         beta_new = beta + step

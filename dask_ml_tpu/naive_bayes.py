@@ -137,6 +137,11 @@ class GaussianNB(BaseEstimator, ClassifierMixin):
             priors = np.asarray(self.priors, dtype=np.float64)
             if len(priors) != len(classes):
                 raise ValueError("Number of priors must match number of classes")
+            # sklearn's validation messages, same checks
+            if not np.isclose(priors.sum(), 1.0):
+                raise ValueError("The sum of the priors should be 1.")
+            if (priors < 0).any():
+                raise ValueError("Priors must be non-negative.")
             self.class_prior_ = priors
         else:
             self.class_prior_ = self.class_count_ / self.class_count_.sum()

@@ -407,7 +407,15 @@ class DummyEncoder(BaseEstimator, TransformerMixin):
             )
         # Restrict encoding to the fitted column subset so the block slices
         # recorded in fit stay aligned even when other categorical columns
-        # exist.
+        # exist — and coerce every categorical column to the dtype recorded
+        # at fit: independently-categorized chunks would otherwise emit a
+        # different dummy-column count and silently shift all later columns
+        # (values outside the fitted categories become NaN → all-zero rows,
+        # column layout intact).
+        X = X.assign(**{
+            col: X[col].astype(self.dtypes_[col])
+            for col in self.categorical_columns_
+        })
         return pd.get_dummies(X, columns=list(self.categorical_columns_),
                               drop_first=self.drop_first)
 
@@ -465,7 +473,10 @@ class OrdinalEncoder(BaseEstimator, TransformerMixin):
             )
         X = X.copy()
         for col in self.categorical_columns_:
-            X[col] = X[col].cat.codes
+            # codes against the FITTED category set: an independently
+            # categorized chunk would otherwise produce different codes for
+            # the same values (unseen values become -1, pandas' NaN code)
+            X[col] = X[col].astype(self.dtypes_[col]).cat.codes
         return X
 
     def inverse_transform(self, X):

@@ -64,3 +64,46 @@ def test_log_loss_multiclass(rng):
     assert metrics.log_loss(y_true, proba) == pytest.approx(
         skm.log_loss(y_true, proba, labels=[0, 1, 2]), rel=1e-4
     )
+
+
+def test_log_loss_arbitrary_labels(rng):
+    """Labels are positionally encoded against the sorted class set, so
+    {-1,1} and {5,7,9} score identically to their 0..K-1 spellings."""
+    from sklearn.metrics import log_loss as sk_log_loss
+
+    from dask_ml_tpu.metrics import log_loss
+
+    p1 = rng.uniform(0.05, 0.95, 40)
+    y01 = (rng.uniform(size=40) > 0.5).astype(int)
+    ypm = np.where(y01 == 1, 1, -1)
+    np.testing.assert_allclose(log_loss(ypm, p1),
+                               sk_log_loss(ypm, p1), rtol=1e-5)
+    np.testing.assert_allclose(log_loss(ypm, p1), log_loss(y01, p1),
+                               rtol=1e-6)
+
+    P = rng.uniform(0.1, 1.0, (40, 3))
+    P /= P.sum(1, keepdims=True)
+    labels579 = np.array([5, 7, 9])[rng.randint(0, 3, 40)]
+    np.testing.assert_allclose(
+        log_loss(labels579, P),
+        sk_log_loss(labels579, P, labels=[5, 7, 9]), rtol=1e-5)
+
+    with pytest.raises(ValueError, match="single label"):
+        log_loss(np.zeros(5), p1[:5])
+    with pytest.raises(ValueError, match="not in"):
+        log_loss(labels579, P, labels=[5, 7])
+    with pytest.raises(ValueError, match="columns"):
+        log_loss(labels579, P[:, :2], labels=[5, 7, 9])
+
+
+def test_log_loss_saturated_probabilities(rng):
+    """p == 1.0 exactly (f32-confident model) must not produce NaN: the
+    clip is dtype-aware (a fixed 1e-15 vanishes at f32 precision)."""
+    from dask_ml_tpu.metrics import log_loss
+
+    y = np.array([1, 0, 1, 0])
+    p = np.array([1.0, 0.0, 0.9, 0.1], np.float32)
+    out = log_loss(y, p)
+    assert np.isfinite(out)
+    P = np.array([[1.0, 0.0], [0.0, 1.0]], np.float32)
+    assert np.isfinite(log_loss(np.array([0, 1]), P))

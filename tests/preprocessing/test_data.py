@@ -230,3 +230,42 @@ def test_min_max_scaler_no_clip_default(X, mesh8):
     X_out = X.copy()
     X_out[0, 0] = 100.0
     assert a.transform(X_out).max() > 1.0
+
+
+def test_dummy_encoder_recategorized_chunk():
+    """transform coerces to the FITTED category set: a chunk whose column
+    was categorized independently (fewer categories) still emits the full
+    fitted dummy layout instead of silently shifting columns."""
+    import pandas as pd
+
+    from dask_ml_tpu.preprocessing import DummyEncoder
+
+    df = pd.DataFrame({
+        "c": pd.Categorical(["a", "b", "c", "a"]),
+        "x": [1.0, 2.0, 3.0, 4.0],
+    })
+    enc = DummyEncoder().fit(df)
+    full = enc.transform(df)
+    chunk = pd.DataFrame({
+        "c": pd.Categorical(["a", "b"]),  # re-categorized: only 2 cats
+        "x": [1.0, 2.0],
+    })
+    got = enc.transform(chunk)
+    assert list(got.columns) == list(full.columns)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(full.iloc[:2]))
+
+
+def test_ordinal_encoder_recategorized_chunk():
+    import pandas as pd
+
+    from dask_ml_tpu.preprocessing import OrdinalEncoder
+
+    df = pd.DataFrame({"c": pd.Categorical(["a", "b", "c", "b"])})
+    enc = OrdinalEncoder().fit(df)
+    # chunk categorized in a DIFFERENT order: codes must follow the fitted
+    # dtype, not the chunk's
+    chunk = pd.DataFrame({
+        "c": pd.Categorical(["b", "c"], categories=["c", "b"]),
+    })
+    got = enc.transform(chunk)
+    np.testing.assert_array_equal(np.asarray(got["c"]), [1, 2])

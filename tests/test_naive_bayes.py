@@ -119,3 +119,11 @@ def test_all_constant_features_finite(any_mesh):
     m = GaussianNB().fit(X, y)
     assert m.epsilon_ > 0
     assert np.isfinite(m._jll(X)).all()
+
+
+def test_invalid_priors_rejected(xy_classification):
+    X, y = xy_classification
+    with pytest.raises(ValueError, match="sum of the priors"):
+        GaussianNB(priors=[0.9, 0.9]).fit(X, y)
+    with pytest.raises(ValueError, match="non-negative"):
+        GaussianNB(priors=[1.5, -0.5]).fit(X, y)
