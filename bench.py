@@ -208,7 +208,7 @@ def bench_pca(rtt):
         def body(i, acc):
             Xi = X + acc * 1e-30
             _U, S, _Vt = linalg._svd_compressed_impl(
-                Xi, key, mesh=mesh, k=k, n_power_iter=2, n_oversamples=10)
+                Xi, key, k=k, n_power_iter=2, n_oversamples=10)
             return acc + S[0]
         return jax.lax.fori_loop(0, reps, body, jnp.asarray(0.0, jnp.float32))
 

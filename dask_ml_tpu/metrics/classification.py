@@ -72,12 +72,29 @@ def log_loss(y_true, y_pred, sample_weight=None, labels=None,
     the reference has no dask log_loss, but its GLM scoring needs one).
 
     Labels are encoded positionally against the sorted class set (sklearn's
-    column convention), so arbitrary label values — {-1, 1}, {5, 7, 9} —
-    score correctly instead of being treated as raw 0..K-1 codes."""
+    column convention — an unsorted ``labels`` list is sorted first, as
+    sklearn's LabelBinarizer does), so arbitrary label values — {-1, 1},
+    {5, 7, 9} — score correctly instead of being treated as raw 0..K-1
+    codes. Exception, for the module's ``compute=False`` on-device
+    contract: a DEVICE-resident integer ``y_true`` with ``labels=None``
+    skips host encoding entirely and must already be 0..K-1 codes (pulling
+    it to host for np.unique would force the device sync the lazy path
+    exists to avoid)."""
     import numpy as np
 
+    if isinstance(y_true, jax.Array) and labels is None \
+            and jnp.issubdtype(y_true.dtype, jnp.integer):
+        y_true = jnp.asarray(y_true)
+        y_pred = jnp.asarray(y_pred)
+        if sample_weight is None:
+            sample_weight = jnp.ones(y_true.shape[0], dtype=jnp.float32)
+        else:
+            sample_weight = jnp.asarray(sample_weight, dtype=jnp.float32)
+        out = _log_loss(y_true, y_pred, sample_weight)
+        return float(out) if compute else out
+
     y_arr = np.asarray(y_true)
-    classes = np.unique(y_arr) if labels is None else np.asarray(labels)
+    classes = np.unique(y_arr) if labels is None else np.unique(labels)
     if len(classes) < 2:
         raise ValueError(
             "y_true contains a single label; pass labels= with the full "

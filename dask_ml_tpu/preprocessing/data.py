@@ -412,10 +412,9 @@ class DummyEncoder(BaseEstimator, TransformerMixin):
         # different dummy-column count and silently shift all later columns
         # (values outside the fitted categories become NaN → all-zero rows,
         # column layout intact).
-        X = X.assign(**{
-            col: X[col].astype(self.dtypes_[col])
-            for col in self.categorical_columns_
-        })
+        X = X.copy()
+        for col in self.categorical_columns_:  # (not assign(**...): column
+            X[col] = X[col].astype(self.dtypes_[col])  # labels may be ints)
         return pd.get_dummies(X, columns=list(self.categorical_columns_),
                               drop_first=self.drop_first)
 

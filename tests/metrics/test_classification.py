@@ -107,3 +107,33 @@ def test_log_loss_saturated_probabilities(rng):
     assert np.isfinite(out)
     P = np.array([[1.0, 0.0], [0.0, 1.0]], np.float32)
     assert np.isfinite(log_loss(np.array([0, 1]), P))
+
+
+def test_log_loss_unsorted_labels(rng):
+    """An unsorted labels= list is sorted to sklearn's column convention."""
+    from sklearn.metrics import log_loss as sk_log_loss
+
+    from dask_ml_tpu.metrics import log_loss
+
+    P = rng.uniform(0.1, 1.0, (20, 3))
+    P /= P.sum(1, keepdims=True)
+    y = np.array([5, 7, 9])[rng.randint(0, 3, 20)]
+    np.testing.assert_allclose(log_loss(y, P, labels=[9, 5, 7]),
+                               sk_log_loss(y, P, labels=[9, 5, 7]),
+                               rtol=1e-5)
+
+
+def test_log_loss_device_codes_fast_path(rng):
+    """Device-resident integer y_true skips host encoding (the lazy
+    compute=False contract) and is treated as 0..K-1 codes."""
+    import jax.numpy as jnp
+
+    from dask_ml_tpu.metrics import log_loss
+
+    P = rng.uniform(0.1, 1.0, (20, 3)).astype(np.float32)
+    P /= P.sum(1, keepdims=True)
+    codes = rng.randint(0, 3, 20)
+    host = log_loss(codes, P)
+    dev = log_loss(jnp.asarray(codes), jnp.asarray(P), compute=False)
+    assert not isinstance(dev, float)  # stayed on device
+    np.testing.assert_allclose(float(dev), host, rtol=1e-6)

@@ -269,3 +269,15 @@ def test_ordinal_encoder_recategorized_chunk():
     })
     got = enc.transform(chunk)
     np.testing.assert_array_equal(np.asarray(got["c"]), [1, 2])
+
+
+def test_dummy_encoder_integer_column_labels():
+    """Non-string column labels survive transform (assign(**...) would
+    have required string keys)."""
+    import pandas as pd
+
+    from dask_ml_tpu.preprocessing import DummyEncoder
+
+    df = pd.DataFrame({0: pd.Categorical(["a", "b"]), 1: [1.0, 2.0]})
+    out = DummyEncoder().fit(df).transform(df)
+    assert out.shape[0] == 2
