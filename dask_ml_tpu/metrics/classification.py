@@ -58,11 +58,17 @@ def _log_loss(y_true, proba, sample_weight):
     eps = jnp.finfo(proba.dtype).eps if jnp.issubdtype(
         proba.dtype, jnp.floating) else jnp.float32(1e-7)
     p = jnp.clip(proba, eps, 1.0 - eps)
+    n_classes = 2 if p.ndim == 1 else p.shape[1]
     if p.ndim == 1:
         ll = -(y_true * jnp.log(p) + (1.0 - y_true) * jnp.log(1.0 - p))
     else:
         onehot = jax.nn.one_hot(y_true.astype(jnp.int32), p.shape[1], dtype=p.dtype)
         ll = -jnp.sum(onehot * jnp.log(p), axis=1)
+    # out-of-range codes poison the result loudly (NaN) instead of
+    # contributing a silent zero loss — the device fast path has no host
+    # validation, and raising is impossible under lazy semantics
+    code = y_true.astype(jnp.int32)
+    ll = jnp.where((code >= 0) & (code < n_classes), ll, jnp.nan)
     return jnp.average(ll, weights=sample_weight)
 
 
@@ -76,13 +82,16 @@ def log_loss(y_true, y_pred, sample_weight=None, labels=None,
     sklearn's LabelBinarizer does), so arbitrary label values — {-1, 1},
     {5, 7, 9} — score correctly instead of being treated as raw 0..K-1
     codes. Exception, for the module's ``compute=False`` on-device
-    contract: a DEVICE-resident integer ``y_true`` with ``labels=None``
-    skips host encoding entirely and must already be 0..K-1 codes (pulling
-    it to host for np.unique would force the device sync the lazy path
-    exists to avoid)."""
+    contract ONLY: a DEVICE-resident integer ``y_true`` with
+    ``labels=None`` and ``compute=False`` skips host encoding and must
+    already be 0..K-1 codes (pulling it to host for np.unique would force
+    the device sync the lazy path exists to avoid); out-of-range codes
+    return NaN rather than a silently understated loss. With the default
+    ``compute=True`` the result comes to host anyway, so full host
+    encoding/validation always runs there."""
     import numpy as np
 
-    if isinstance(y_true, jax.Array) and labels is None \
+    if not compute and isinstance(y_true, jax.Array) and labels is None \
             and jnp.issubdtype(y_true.dtype, jnp.integer):
         y_true = jnp.asarray(y_true)
         y_pred = jnp.asarray(y_pred)
@@ -90,8 +99,7 @@ def log_loss(y_true, y_pred, sample_weight=None, labels=None,
             sample_weight = jnp.ones(y_true.shape[0], dtype=jnp.float32)
         else:
             sample_weight = jnp.asarray(sample_weight, dtype=jnp.float32)
-        out = _log_loss(y_true, y_pred, sample_weight)
-        return float(out) if compute else out
+        return _log_loss(y_true, y_pred, sample_weight)
 
     y_arr = np.asarray(y_true)
     classes = np.unique(y_arr) if labels is None else np.unique(labels)

@@ -480,26 +480,33 @@ def _admm_impl(X, y, w, beta0, x0, u0, mask, lamduh, rho, abstol, reltol,
 
         def local_newton(x, z, u):
             # argmin_x f_i(x) + (rho/2)||x - z + u||²; f_i = Σ_loc w·ℓ / SW
-            def local_grad(xx):
+            def grad_eta(xx):
+                # one data pass yields BOTH the gradient and the linear
+                # predictor the Hessian weights need
                 eta = X_loc @ xx
-                return X_loc.T @ (w_loc * dloss(eta)) / sw + rho * (xx - z + u)
+                g = X_loc.T @ (w_loc * dloss(eta)) / sw + rho * (xx - z + u)
+                return g, eta
 
             def nt_cond(s):
-                xx, it = s
+                _, g, _, it = s
                 return jnp.logical_and(it < inner_max_iter,
-                                       jnp.max(jnp.abs(local_grad(xx))) > inner_tol)
+                                       jnp.max(jnp.abs(g)) > inner_tol)
 
             def nt_body(s):
-                xx, it = s
-                eta = X_loc @ xx
-                g = local_grad(xx)
+                # carry (xx, g, eta): the condition reads the carried
+                # gradient instead of recomputing it, so each inner
+                # iteration makes exactly one gradient pass over the shard
+                xx, g, eta, it = s
                 h = w_loc * hess_fn(eta, y_loc)
                 H = (X_loc.T @ (h[:, None] * X_loc)) / sw
                 H = H + rho * jnp.eye(d, dtype=xx.dtype)
-                return xx - jnp.linalg.solve(H, g), it + 1
+                xx_new = xx - jnp.linalg.solve(H, g)
+                g_new, eta_new = grad_eta(xx_new)
+                return xx_new, g_new, eta_new, it + 1
 
-            xx, _ = lax.while_loop(nt_cond, nt_body,
-                                   (x, jnp.asarray(0, jnp.int32)))
+            g0, eta0 = grad_eta(x)
+            xx, _, _, _ = lax.while_loop(
+                nt_cond, nt_body, (x, g0, eta0, jnp.asarray(0, jnp.int32)))
             return xx
 
         def cond(state):

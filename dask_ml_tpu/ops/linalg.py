@@ -181,12 +181,11 @@ def svd_compressed(X, k: int, n_power_iter: int = 0, key=None,
     pca.py:236-241). ``weights`` masks padding rows to exact zeros (the
     ``Xᵀ·Q`` / ``Qᵀ·X`` contractions would otherwise pick up whatever the
     caller left in the padding rows)."""
-    mesh = mesh or mesh_lib.default_mesh()
+    del mesh  # accepted for API compat; the CholeskyQR2 impl is mesh-free
     if key is None:
         key = jax.random.key(0)
     if weights is not None:
         X = _mask_padding_rows(X, weights)
-    del mesh  # resolution kept for API compat; the impl is mesh-free
     return _svd_compressed_impl(X, key, k=int(k),
                                 n_power_iter=int(n_power_iter),
                                 n_oversamples=int(n_oversamples))

@@ -124,8 +124,10 @@ def test_log_loss_unsorted_labels(rng):
 
 
 def test_log_loss_device_codes_fast_path(rng):
-    """Device-resident integer y_true skips host encoding (the lazy
-    compute=False contract) and is treated as 0..K-1 codes."""
+    """Device-resident integer y_true skips host encoding ONLY under the
+    lazy compute=False contract; out-of-range codes poison with NaN; the
+    default compute=True always host-encodes (so {-1,1} labels score
+    correctly even as device arrays)."""
     import jax.numpy as jnp
 
     from dask_ml_tpu.metrics import log_loss
@@ -137,3 +139,13 @@ def test_log_loss_device_codes_fast_path(rng):
     dev = log_loss(jnp.asarray(codes), jnp.asarray(P), compute=False)
     assert not isinstance(dev, float)  # stayed on device
     np.testing.assert_allclose(float(dev), host, rtol=1e-6)
+
+    # compute=True with device ±1 labels takes the HOST-encoding path
+    p1 = rng.uniform(0.05, 0.95, 20).astype(np.float32)
+    ypm = np.where(rng.uniform(size=20) > 0.5, 1, -1)
+    np.testing.assert_allclose(
+        log_loss(jnp.asarray(ypm), jnp.asarray(p1)), log_loss(ypm, p1),
+        rtol=1e-6)
+    # lazy path with invalid codes: loud NaN, not a silent zero loss
+    bad = jnp.asarray(np.array([0, 1, 3, 2] * 5))
+    assert np.isnan(float(log_loss(bad, jnp.asarray(P), compute=False)))
