@@ -110,6 +110,40 @@ def load_pytree(path: str):
 STATEFUL_SOLVERS = ("lbfgs", "admm")
 
 
+_moments_prog = None
+
+
+def _all_moments(arrays):
+    """Three f32-accumulated reductions per array, as ONE jitted program and
+    ONE host fetch.
+
+    The reductions run fused under jit (``astype`` + square + sum never
+    materialize an upcast copy of the input — ADVICE r3: an eager
+    ``asarray(a).astype(f32)`` doubled HBM for bf16-staged data exactly on
+    the huge fits checkpointing targets), and batching all arrays into one
+    program replaces 3·n_arrays round-trip fetches with one. The jitted
+    program is module-level so repeated fingerprints (one per CV cell in a
+    checkpointed search) hit the jit cache instead of retracing.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    global _moments_prog
+    if _moments_prog is None:
+        def one(a):
+            af = a.astype(jnp.float32)  # fused into the reductions by XLA
+            return (jnp.sum(af), jnp.sum(af * af),
+                    jnp.sum(jnp.abs(af[..., ::7])))
+
+        _moments_prog = jax.jit(lambda xs: [one(x) for x in xs])
+
+    present = [jnp.asarray(a) for a in arrays if a is not None]
+    outs = [tuple(float(v) for v in t)
+            for t in jax.device_get(_moments_prog(present))]
+    it = iter(outs)
+    return [next(it) if a is not None else (0.0,) for a in arrays]
+
+
 def _problem_fingerprint(solver, X, y, w, beta0, mask, **kwargs) -> str:
     """Cheap content fingerprint binding a snapshot to its fit problem.
 
@@ -126,33 +160,34 @@ def _problem_fingerprint(solver, X, y, w, beta0, mask, **kwargs) -> str:
     """
     import hashlib
 
-    import jax.numpy as jnp
-
-    def moments(a):
-        if a is None:
-            return (0.0,)
-        # f32 accumulation (x64 is typically disabled on TPU); three
-        # independent reductions make an unnoticed collision vanishingly
-        # unlikely for real data edits
-        af = jnp.asarray(a).astype(jnp.float32)
-        return (float(jnp.sum(af)), float(jnp.sum(af * af)),
-                float(jnp.sum(jnp.abs(af[..., ::7]))))
-
+    # three independent f32-accumulated reductions per array make an
+    # unnoticed collision vanishingly unlikely for real data edits
+    mom = _all_moments([X, y, w, beta0, mask])
     h = hashlib.sha256()
     for part in (
         solver,
         tuple(getattr(X, "shape", ())), str(getattr(X, "dtype", "")),
         tuple(getattr(y, "shape", ())) if y is not None else None,
-        moments(X), moments(y), moments(w), moments(beta0), moments(mask),
+        *mom,
         sorted((k, repr(v)) for k, v in kwargs.items()),
     ):
         h.update(repr(part).encode())
     return h.hexdigest()[:32]
 
 
+def problem_fingerprint(solver, X, y, w, beta0, mask, **kwargs) -> str:
+    """Public alias of the snapshot↔problem binding checksum (see
+    :func:`_problem_fingerprint`). Estimator facades use it to derive a
+    per-problem checkpoint path suffix, so one configured path serves many
+    fits (e.g. the same checkpointed estimator across CV cells) without
+    fingerprint-mismatch errors."""
+    return _problem_fingerprint(solver, X, y, w, beta0, mask, **kwargs)
+
+
 def solve_checkpointed(solver: str, X, y, w, beta0, mask, mesh=None, *,
                        path: str, chunk_iters: int = 50, max_iter: int = 250,
-                       save_every_chunks: int = 1, **kwargs):
+                       save_every_chunks: int = 1, fingerprint: str = None,
+                       preloaded_snapshot=None, **kwargs):
     """Run a GLM solver as resumable chunks of device iterations.
 
     Each chunk is one on-device solve of at most ``chunk_iters`` iterations
@@ -166,9 +201,20 @@ def solve_checkpointed(solver: str, X, y, w, beta0, mask, mesh=None, *,
     wrong answer.
 
     Returns ``(beta, total_iters)`` with ``total_iters`` counted across all
-    runs that contributed to the checkpoint. Convergence is detected by a
-    chunk using fewer than its budgeted iterations; the snapshot is kept on
-    completion (callers may delete it) with ``meta['converged']=True``.
+    runs that contributed to the checkpoint. For the stateful solvers
+    convergence is the solver loop's OWN done flag (so converging exactly on
+    a chunk's last budgeted iteration is still recorded as converged —
+    ADVICE r3); the carry-light solvers fall back to the chunk using fewer
+    than its budgeted iterations. The snapshot is kept on completion
+    (callers may delete it) with ``meta['converged']=True``.
+
+    ``fingerprint`` may be passed pre-computed (see
+    :func:`problem_fingerprint`) to skip the device reductions, e.g. when
+    the caller already derived a per-problem path suffix from it; likewise
+    ``preloaded_snapshot`` (a :func:`load_pytree` result for ``path``) skips
+    re-reading a snapshot the caller already loaded — the carries can be
+    large (L-BFGS history, ADMM per-shard stacks) and deserializing them
+    twice on the huge-fit resume path is exactly the waste to avoid.
     """
     from dask_ml_tpu.models import glm as glm_core
 
@@ -176,12 +222,15 @@ def solve_checkpointed(solver: str, X, y, w, beta0, mask, mesh=None, *,
         raise ValueError(f"unknown solver {solver!r}")
     if solver == "admm" and mesh is None:
         raise ValueError("admm requires a mesh")
-    fingerprint = _problem_fingerprint(solver, X, y, w, beta0, mask, **kwargs)
+    if fingerprint is None:
+        fingerprint = _problem_fingerprint(solver, X, y, w, beta0, mask,
+                                           **kwargs)
 
     state = None
     iters_done = 0
     beta = beta0
-    snap = load_pytree(path)
+    snap = (preloaded_snapshot if preloaded_snapshot is not None
+            else load_pytree(path))
     if snap is not None:
         tree, meta = snap
         if meta.get("solver") != solver:
@@ -215,22 +264,25 @@ def solve_checkpointed(solver: str, X, y, w, beta0, mask, mesh=None, *,
     while iters_done < max_iter:
         budget = min(chunk_iters, max_iter - iters_done)
         if solver == "admm":
-            z, n_it, state = glm_core.admm(
+            z, n_it, state, done = glm_core.admm(
                 X, y, w, beta, mask, mesh, max_iter=budget, state=state,
                 return_state=True, **kwargs)
             beta = z
+            converged = bool(done)
         elif solver == "lbfgs":
-            beta, n_it, state = glm_core.lbfgs(
+            beta, n_it, state, done = glm_core.lbfgs(
                 X, y, w, beta, mask, max_iter=budget, state=state,
                 return_state=True, **kwargs)
+            converged = bool(done)
         else:
-            # beta-restart chunking for the carry-light solvers
+            # beta-restart chunking for the carry-light solvers, which do
+            # not expose their loop's done flag
             beta, n_it = glm_core.solve(
                 solver, X, y, w, beta, mask, mesh=mesh, max_iter=budget,
                 **kwargs)
+            converged = int(n_it) < budget
         n_it = int(n_it)
         iters_done += n_it
-        converged = n_it < budget
         chunks_since_save += 1
         if converged or chunks_since_save >= save_every_chunks:
             snapshot(converged)

@@ -19,6 +19,7 @@ Deliberate deviations, documented:
 from __future__ import annotations
 
 import logging
+import os
 
 import jax.numpy as jnp
 import numpy as np
@@ -66,8 +67,9 @@ class _GLM(BaseEstimator):
         self.n_jobs = n_jobs
         self.max_iter = max_iter
         self.solver_kwargs = solver_kwargs
-        # checkpoint: snapshot path making fit() resumable in chunks of
-        # checkpoint_every device iterations (SURVEY §5.4;
+        # checkpoint: snapshot path PREFIX making fit() resumable in chunks
+        # of checkpoint_every device iterations; each distinct fit problem
+        # writes its own fingerprint-suffixed snapshot file (SURVEY §5.4;
         # see dask_ml_tpu.checkpoint.solve_checkpointed)
         self.checkpoint = checkpoint
         self.checkpoint_every = checkpoint_every
@@ -146,15 +148,46 @@ class _GLM(BaseEstimator):
         kwargs = self._get_solver_kwargs()
         with profile_phase(logger, f"glm-{self.solver}"):
             if self.checkpoint:
-                from dask_ml_tpu.checkpoint import solve_checkpointed
+                from dask_ml_tpu.checkpoint import (problem_fingerprint,
+                                                    solve_checkpointed)
 
                 ck_kwargs = dict(kwargs)
                 ck_max_iter = ck_kwargs.pop("max_iter")
+                # ``checkpoint`` is a PATH PREFIX: each distinct fit problem
+                # (data content + hyperparameters) snapshots to its own
+                # fingerprint-suffixed file, so a second fit on different
+                # data — e.g. a checkpointed estimator inside a CV search,
+                # where every (candidate, split) cell stages a different
+                # slice — resumes ITS OWN snapshot instead of erroring on a
+                # fingerprint mismatch (ADVICE r3).
+                # max_iter stays OUT of the fingerprint (as in
+                # solve_checkpointed itself): re-fitting with a larger
+                # budget must resume the same snapshot, not start a new one
+                fp = problem_fingerprint(
+                    self.solver, Xd, data.y, data.weights, beta0,
+                    jnp.asarray(mask), **ck_kwargs)
+                ck_path = f"{self.checkpoint}.{fp[:16]}"
+                # migration: a snapshot written AT the bare configured path
+                # (pre-suffix versions) whose stored fingerprint matches this
+                # problem keeps being used — an interrupted long fit must not
+                # silently restart from zero after an upgrade. The loaded
+                # snapshot is passed through so the (possibly large) carry
+                # is not deserialized a second time inside solve_checkpointed.
+                preloaded = None
+                if not os.path.exists(ck_path) and os.path.isfile(
+                        self.checkpoint):
+                    from dask_ml_tpu.checkpoint import load_pytree
+
+                    bare = load_pytree(self.checkpoint)
+                    if bare is not None and bare[1].get("fingerprint") == fp:
+                        ck_path = self.checkpoint
+                        preloaded = bare
                 beta, n_iter = solve_checkpointed(
                     self.solver, Xd, data.y, data.weights, beta0,
-                    jnp.asarray(mask), mesh, path=self.checkpoint,
+                    jnp.asarray(mask), mesh, path=ck_path,
                     chunk_iters=int(self.checkpoint_every),
-                    max_iter=ck_max_iter, **ck_kwargs,
+                    max_iter=ck_max_iter, fingerprint=fp,
+                    preloaded_snapshot=preloaded, **ck_kwargs,
                 )
             else:
                 beta, n_iter = core.solve(

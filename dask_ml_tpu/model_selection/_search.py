@@ -167,6 +167,36 @@ def _passthrough_scorer(est, X, y):
     return est.score(X, y)
 
 
+def _scoring_identity(scoring):
+    """Content identity of a ``scoring=`` spec, for checkpoint cell keys.
+
+    Journal records must invalidate when the scoring CHANGES, including a
+    different custom callable under the same slot name (ADVICE r3: keying on
+    ``sorted(scorers)`` alone restored stale scores after swapping a scorer).
+    String specs identify by name; callables by code/attribute content
+    (bytecode + global names + consts + closure values — see
+    ``_tokenize._callable_identity``), which is stable across processes AND
+    changes when the scorer's implementation changes — pickle bytes would do
+    neither for module-level functions (serialized by reference) or lambdas
+    (unpicklable).
+    """
+    from dask_ml_tpu.model_selection._tokenize import (_callable_identity,
+                                                       _stable_repr)
+
+    if scoring is None or isinstance(scoring, str):
+        return ("named", scoring)
+    if callable(scoring):
+        return _callable_identity(scoring)
+    if isinstance(scoring, (list, tuple, set)):
+        return ("list", tuple(sorted(scoring)))
+    if isinstance(scoring, dict):
+        return ("dict", tuple(
+            (name, _scoring_identity(s))
+            for name, s in sorted(scoring.items())
+        ))
+    return ("repr", _stable_repr(scoring))
+
+
 def _lookup_scorer(name: str):
     from dask_ml_tpu.metrics.scorer import get_scorer
 
@@ -690,7 +720,7 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
         # Checkpoint/resume: completed cells live in an append-only journal
         # keyed by content — estimator config + candidate params + the
         # split's ACTUAL index arrays + the CONTENT of X/y/fit_params +
-        # scorer names — so a re-fit with the same checkpoint path restores
+        # scoring identity — so a re-fit with the same checkpoint path restores
         # finished cells and computes only the rest, while any change to
         # grid, data values, sample weights, or scoring changes the keys and
         # naturally misses. Cells that FAILED under a numeric error_score
@@ -699,6 +729,7 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
         # (SURVEY §5.4; the reference can only re-run from zero.)
         journal = done_cells = None
         cell_keys = {}
+        legacy_keys = {}
         if self.checkpoint:
             from dask_ml_tpu.checkpoint import CellJournal
 
@@ -709,14 +740,37 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
                 _content_array(X), _content_array(y),
                 {k: _content_array(v) for k, v in fit_params.items()},
             )
+            scoring_id = _scoring_identity(self.scoring)
+            # Journals written before scoring identity keyed cells on scorer
+            # NAMES (sorted(scorers)). Probe the legacy key on a miss ONLY
+            # for list-of-strings specs, where the names that reached the
+            # legacy key ARE the metrics. Everything else is ambiguous in
+            # legacy keys: None/single-string collapsed to ['score'], and a
+            # dict's keys are arbitrary slot names whose mapped metric could
+            # have changed — a legacy record can't prove WHICH metric
+            # produced it. Callable scoring's legacy records are exactly the
+            # stale ones the identity change invalidates. No journal loaded
+            # → nothing to bridge, skip the second hashing pass entirely.
+            named_scoring = (
+                isinstance(self.scoring, (list, tuple, set))
+                and all(isinstance(s, str) for s in self.scoring)
+            )
             for ci, si in cells:
                 cell_keys[(ci, si)] = tokenize(
                     "cell", est_token, candidate_params[ci],
-                    splits[si][0], splits[si][1], sorted(scorers),
+                    splits[si][0], splits[si][1], scoring_id,
                     self.return_train_score,
                 )
+                if named_scoring and done_cells:
+                    legacy_keys[(ci, si)] = tokenize(
+                        "cell", est_token, candidate_params[ci],
+                        splits[si][0], splits[si][1], sorted(scorers),
+                        self.return_train_score,
+                    )
         self.n_resumed_cells_ = sum(
-            1 for k in cell_keys.values() if k in (done_cells or {})
+            1 for cs, k in cell_keys.items()
+            if k in (done_cells or {})
+            or (cs in legacy_keys and legacy_keys[cs] in (done_cells or {}))
         )
 
         # Thread-local config (dtype etc.) set on the CALLING thread must
@@ -735,6 +789,10 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
                 if journal is not None:
                     key = cell_keys[(ci, si)]
                     hit = done_cells.get(key)
+                    if hit is None and (ci, si) in legacy_keys:
+                        hit = done_cells.get(legacy_keys[(ci, si)])
+                        if hit is not None:  # migrate to the current key
+                            journal.append(key, hit)
                     if hit is not None:
                         return hit
                     result = runner.run(candidate_params[ci], si)

@@ -20,6 +20,136 @@ def _update(h, s: str):
     h.update(s.encode())
 
 
+# ---------------------------------------------------------------------------
+# callable/value content identity
+#
+# Tokens must change when a callable's BEHAVIOR changes and be stable across
+# processes. Neither module+qualname (every lambda is "<lambda>"; editing a
+# function body changes nothing) nor pickle (serializes module-level
+# functions by reference) nor repr (embeds addresses) has both properties —
+# so callables are identified by bytecode + referenced global names +
+# constants + closure/default/instance values, recursively.
+# ---------------------------------------------------------------------------
+
+
+_ADDR_RE = None
+
+
+def _stable_repr(obj) -> str:
+    """``repr`` with memory addresses stripped, so identities are stable
+    across processes (default object reprs embed ``at 0x7f...``)."""
+    global _ADDR_RE
+    if _ADDR_RE is None:
+        import re
+
+        _ADDR_RE = re.compile(r"0x[0-9a-fA-F]+")
+    return _ADDR_RE.sub("0x", repr(obj))
+
+
+def _code_identity(code):
+    """Identity of a code object: bytecode + referenced GLOBAL NAMES +
+    constants (nested code objects — inner lambdas/defs — recurse instead of
+    repr'ing, which would embed an address). co_names matters: two lambdas
+    calling different globals have byte-identical co_code."""
+    consts = tuple(
+        _code_identity(c) if hasattr(c, "co_code") else _stable_repr(c)
+        for c in code.co_consts
+    )
+    return ("co", code.co_code, code.co_names, consts)
+
+
+def _value_identity(obj, seen=None):
+    """Process-stable content identity of an arbitrary captured value."""
+    if callable(obj):
+        return _callable_identity(obj, seen)
+    if hasattr(obj, "shape") and hasattr(obj, "dtype") and hasattr(
+            obj, "__array__"):
+        # ndarray-likes incl. jax Arrays: repr() truncates ('...') and would
+        # collide distinct contents (same rule as _normalize below)
+        arr = np.ascontiguousarray(np.asarray(obj))
+        if arr.dtype == object:
+            return ("nd-obj", arr.shape, _stable_repr(arr.tolist()))
+        return ("nd", arr.shape, str(arr.dtype), arr.tobytes())
+    if isinstance(obj, (list, tuple)):
+        return ("seq", type(obj).__name__,
+                tuple(_value_identity(v, seen) for v in obj))
+    if isinstance(obj, dict):
+        return ("map", tuple(
+            (_stable_repr(k), _value_identity(obj[k], seen))
+            for k in sorted(obj, key=repr)))
+    if isinstance(obj, (set, frozenset)):
+        return ("set", tuple(sorted(
+            (_value_identity(v, seen) for v in obj), key=repr)))
+    return _stable_repr(obj)
+
+
+def _object_identity(obj, seen=None):
+    """Identity of an object by class + attribute CONTENT (function-valued
+    attrs by their code), for scorer instances and bound-method selves."""
+    seen = set() if seen is None else seen
+    if id(obj) in seen:
+        return ("cycle",)  # self-referential object graph: mark and stop
+    seen = seen | {id(obj)}
+    attrs = getattr(obj, "__dict__", None)
+    if isinstance(attrs, dict):
+        attr_id = tuple(
+            (k, _value_identity(v, seen)) for k, v in sorted(attrs.items())
+        )
+    else:
+        attr_id = _stable_repr(obj)
+    return ("obj", type(obj).__module__, type(obj).__qualname__, attr_id)
+
+
+def _cell_value(cell):
+    try:
+        return cell.cell_contents
+    except ValueError:  # unbound cell ("Cell is empty")
+        return "<empty-cell>"
+
+
+def _callable_identity(fn, seen=None):
+    import functools
+
+    seen = set() if seen is None else seen
+    if id(fn) in seen:
+        return ("cycle",)
+    seen = seen | {id(fn)}
+    if isinstance(fn, functools.partial):
+        # partial's __dict__ is empty — func/args/keywords carry the state
+        return ("partial", _callable_identity(fn.func, seen),
+                tuple(_value_identity(a, seen) for a in fn.args),
+                tuple((k, _value_identity(v, seen))
+                      for k, v in sorted(fn.keywords.items())))
+    code = getattr(fn, "__code__", None)
+    if code is not None:
+        # a plain function/lambda/method: identify by its CODE, not by
+        # pickle — pickle serializes module-level functions by reference
+        # (module+qualname), so editing the body would not invalidate
+        cells = tuple(
+            _value_identity(_cell_value(c), seen)
+            for c in (getattr(fn, "__closure__", None) or ())
+        )
+        defaults = tuple(
+            _value_identity(v, seen)
+            for v in (getattr(fn, "__defaults__", None) or ())
+        )
+        kwdefaults = tuple(
+            (k, _value_identity(v, seen))
+            for k, v in sorted((getattr(fn, "__kwdefaults__", None)
+                                or {}).items())
+        )
+        # a bound method's behavior also depends on its instance's state
+        self_obj = getattr(fn, "__self__", None)
+        self_id = (None if self_obj is None
+                   else _object_identity(self_obj, seen))
+        return ("fn", getattr(fn, "__module__", ""),
+                getattr(fn, "__qualname__", ""), _code_identity(code),
+                cells, defaults, kwdefaults, self_id)
+    # non-function callable (e.g. a make_scorer product): class + attribute
+    # values, with function-valued attrs (the score_func) by code identity
+    return _object_identity(fn, seen)
+
+
 def _normalize(obj, h):
     """Feed a stable representation of ``obj`` into hash ``h``.
 
@@ -62,8 +192,11 @@ def _normalize(obj, h):
             _update(h, ",")
         _update(h, "}")
     elif callable(obj):
-        _update(h, f"fn:{getattr(obj, '__module__', '')}."
-                   f"{getattr(obj, '__qualname__', repr(obj))}")
+        # content identity, not module+qualname: two lambdas (or two edits
+        # of the same function) as hyperparameter values must NOT collide —
+        # a name-keyed token would share one memoized fit between candidates
+        # with different callables
+        _normalize(_callable_identity(obj), h)
     else:
         _update(h, f"{type(obj).__name__}:{obj!r}")
 

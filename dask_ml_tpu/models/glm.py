@@ -330,7 +330,10 @@ def lbfgs(X, y, w, beta0, mask, *, family="logistic", regularizer="l2",
     ``return_state=True``; resuming from it preserves the curvature history
     exactly, so a chunked run (:func:`dask_ml_tpu.checkpoint.solve_checkpointed`)
     takes the same trajectory as an uninterrupted one. ``n_iter`` counts only
-    the iterations performed in THIS call.
+    the iterations performed in THIS call. With ``return_state=True`` the
+    return is ``(beta, n_iter, state, done)`` — ``done`` is the loop's own
+    convergence flag, so a caller chunking iterations can distinguish
+    "converged" from "ran out of budget on the last iteration" (ADVICE r3).
     """
     obj_full = _make_objective(family, regularizer, smooth_penalty=True)
     sdt = _state_dtype(X)
@@ -385,7 +388,7 @@ def lbfgs(X, y, w, beta0, mask, *, family="logistic", regularizer="l2",
     init = carry0 + (jnp.asarray(0, jnp.int32), jnp.asarray(False))
     out = lax.while_loop(cond, body, init)
     if return_state:
-        return out[0], out[8], out[:8]
+        return out[0], out[8], out[:8], out[9]
     return out[0], out[8]
 
 
@@ -454,8 +457,8 @@ def _admm_impl(X, y, w, beta0, x0, u0, mask, lamduh, rho, abstol, reltol,
     ``x0``/``u0`` are the per-shard primal/dual variables stacked along the
     data axis as ``(n_shards, d)`` arrays (sharded ``P('data', None)``, one
     row per shard) so the whole solver carry can round-trip through a host
-    checkpoint (SURVEY §5.4); returns ``(z, n_iter, x, u)`` with x/u in the
-    same stacked layout."""
+    checkpoint (SURVEY §5.4); returns ``(z, n_iter, x, u, done)`` with x/u in
+    the same stacked layout and ``done`` the Boyd-stopping convergence flag."""
     loss_fn, hess_fn = FAMILIES[family]
     _, pen_prox = _penalty(regularizer)
     n_shards = mesh.shape[DATA_AXIS]
@@ -467,7 +470,7 @@ def _admm_impl(X, y, w, beta0, x0, u0, mask, lamduh, rho, abstol, reltol,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
                   P(), P(DATA_AXIS, None), P(DATA_AXIS, None),
                   P(), P(), P(), P(), P(), P()),
-        out_specs=(P(), P(), P(DATA_AXIS, None), P(DATA_AXIS, None)),
+        out_specs=(P(), P(), P(DATA_AXIS, None), P(DATA_AXIS, None), P()),
     )
     def run(X_loc, y_loc, w_loc, z0, x0_loc, u0_loc, mask_, lamduh, rho,
             abstol, reltol, inner_tol):
@@ -539,8 +542,8 @@ def _admm_impl(X, y, w, beta0, x0, u0, mask, lamduh, rho, abstol, reltol,
         # lines the while_loop carry types up under shard_map's vma checks.
         init = (z0, x0_loc[0], u0_loc[0],
                 jnp.asarray(0, jnp.int32), jnp.asarray(False))
-        z, x, u, n_iter, _ = lax.while_loop(cond, body, init)
-        return z, n_iter, x[None, :], u[None, :]
+        z, x, u, n_iter, done = lax.while_loop(cond, body, init)
+        return z, n_iter, x[None, :], u[None, :], done
 
     return run(X, y, w, beta0, x0, u0, mask, lamduh, rho, abstol, reltol,
                inner_tol)
@@ -566,7 +569,9 @@ def admm(X, y, w, beta0, mask, mesh, *, family="logistic", regularizer="l2",
     Checkpoint/resume (SURVEY §5.4): ``state = (z, x, u)`` with x/u the
     per-shard primal/dual variables stacked ``(n_shards, d)``; pass a state
     from a previous ``return_state=True`` call to continue the consensus
-    exactly where it stopped. ``n_iter`` counts this call's iterations only.
+    exactly where it stopped. ``n_iter`` counts this call's iterations only,
+    and ``return_state=True`` returns ``(z, n_iter, state, done)`` with
+    ``done`` the loop's own convergence flag (ADVICE r3).
     Unlike the L-BFGS carry, ADMM state is bound to the data-axis shard
     count (each shard owns its consensus subproblem): resuming on a mesh
     with a different number of shards is rejected.
@@ -589,12 +594,12 @@ def admm(X, y, w, beta0, mask, mesh, *, family="logistic", regularizer="l2",
             )
     scalars = [jnp.asarray(v, dt) for v in (lamduh, rho, abstol, reltol,
                                             inner_tol)]
-    z, n_iter, x, u = _admm_impl(
+    z, n_iter, x, u, done = _admm_impl(
         X, y, w, z0, x0, u0, mask, *scalars, mesh=mesh, family=family,
         regularizer=regularizer, max_iter=int(max_iter),
         inner_max_iter=int(inner_max_iter))
     if return_state:
-        return z, n_iter, (z, x, u)
+        return z, n_iter, (z, x, u), done
     return z, n_iter
 
 

@@ -579,3 +579,32 @@ def test_multimetric_with_error_score(clf_data):
             assert res[f"split{si}_test_{m}"][fail_idx] == -7.5
         assert np.isfinite(res[f"mean_test_{m}"][:2]).all()
         assert (res[f"mean_test_{m}"][:2] != -7.5).all()
+
+
+def test_callable_params_do_not_collide_in_memo():
+    """Two candidates whose hyperparameter is a DIFFERENT callable (e.g. two
+    lambdas) must not share one memoized fit: name-keyed tokens would
+    collapse both to '<lambda>' and silently hand candidate 2 candidate 1's
+    fitted model."""
+    from sklearn.base import BaseEstimator
+
+    from dask_ml_tpu.model_selection import GridSearchCV
+
+    class FnParamEstimator(BaseEstimator):
+        def __init__(self, link=None):
+            self.link = link
+
+        def fit(self, X, y=None):
+            self.out_ = float(self.link(2.0))
+            return self
+
+        def score(self, X, y=None):
+            return self.out_
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(20, 2)
+    grid = {"link": [lambda v: v, lambda v: v ** 2, lambda v: -v]}
+    gs = GridSearchCV(FnParamEstimator(), grid, cv=2, refit=False,
+                      n_jobs=1).fit(X)
+    scores = np.asarray(gs.cv_results_["mean_test_score"])
+    np.testing.assert_allclose(sorted(scores), [-2.0, 2.0, 4.0])

@@ -54,7 +54,7 @@ def test_lbfgs_state_chunks_match_single_run(staged):
     state = None
     beta = beta0
     for _ in range(3):
-        beta, _, state = glm_core.lbfgs(
+        beta, _, state, _done = glm_core.lbfgs(
             data.X, data.y, data.weights, beta, mask, max_iter=10,
             state=state, return_state=True, **kw)
 
@@ -75,7 +75,7 @@ def test_admm_state_chunks_match_single_run(staged):
     state = None
     z = beta0
     for _ in range(4):
-        z, _, state = glm_core.admm(
+        z, _, state, _done = glm_core.admm(
             data.X, data.y, data.weights, z, mask, mesh, max_iter=3,
             state=state, return_state=True, **kw)
 
@@ -89,7 +89,7 @@ def test_admm_state_roundtrips_through_host(staged, tmp_path):
     data, beta0, mask, mesh = staged
     kw = dict(family="logistic", regularizer="l1", lamduh=0.5,
               abstol=0.0, reltol=0.0)
-    z1, _, state = glm_core.admm(
+    z1, _, state, _done = glm_core.admm(
         data.X, data.y, data.weights, beta0, mask, mesh, max_iter=4,
         state=None, return_state=True, **kw)
 
@@ -100,10 +100,10 @@ def test_admm_state_roundtrips_through_host(staged, tmp_path):
     restored = tree["state"]
     assert isinstance(restored[1], np.ndarray)  # host-side after save
 
-    z2a, _, _ = glm_core.admm(
+    z2a, _, _, _ = glm_core.admm(
         data.X, data.y, data.weights, z1, mask, mesh, max_iter=3,
         state=state, return_state=True, **kw)
-    z2b, _, _ = glm_core.admm(
+    z2b, _, _, _ = glm_core.admm(
         data.X, data.y, data.weights, z1, mask, mesh, max_iter=3,
         state=tuple(restored), return_state=True, **kw)
     np.testing.assert_allclose(np.asarray(z2a), np.asarray(z2b),
@@ -464,3 +464,219 @@ def test_cell_journal_roundtrip_is_pickle_frames(tmp_path):
     assert ckpt.CellJournal(path).load() == records
     with open(path, "rb") as f:  # frames are plain pickle
         assert pickle.load(f)[0] == "k0"
+
+
+# ---------------------------------------------------------------------------
+# ADVICE r3 regression tests
+# ---------------------------------------------------------------------------
+
+
+def test_search_checkpoint_invalidates_on_scorer_change(tmp_path):
+    """Swapping a custom scorer under the same slot name must invalidate
+    journal records (cell keys carry scorer IDENTITY, not just names)."""
+    from dask_ml_tpu.model_selection import GridSearchCV
+
+    rng = np.random.RandomState(2)
+    X = rng.randn(40, 3)
+    path = str(tmp_path / "search.journal")
+
+    def scorer_a(est, X, y=None):
+        return est.score(X)
+
+    def scorer_b(est, X, y=None):
+        return 2.0 * est.score(X) + 1.0
+
+    GridSearchCV(_FlakyKMeansLike(), {"c": [0.1, 0.2]}, cv=2, refit=False,
+                 n_jobs=1, scoring=scorer_a, checkpoint=path).fit(X)
+
+    gs_b = GridSearchCV(_FlakyKMeansLike(), {"c": [0.1, 0.2]}, cv=2,
+                        refit=False, n_jobs=1, scoring=scorer_b,
+                        checkpoint=path)
+    gs_b.fit(X)
+    assert gs_b.n_resumed_cells_ == 0  # stale scorer-a records never match
+
+    fresh = GridSearchCV(_FlakyKMeansLike(), {"c": [0.1, 0.2]}, cv=2,
+                         refit=False, n_jobs=1, scoring=scorer_b)
+    fresh.fit(X)
+    np.testing.assert_allclose(gs_b.cv_results_["mean_test_score"],
+                               fresh.cv_results_["mean_test_score"])
+
+    # same scorer object again: full resume still works
+    gs_b2 = GridSearchCV(_FlakyKMeansLike(), {"c": [0.1, 0.2]}, cv=2,
+                         refit=False, n_jobs=1, scoring=scorer_b,
+                         checkpoint=path)
+    gs_b2.fit(X)
+    assert gs_b2.n_resumed_cells_ == 4
+
+    # the hard case: two LAMBDAS share qualname "<lambda>" and are
+    # unpicklable, so identity must come from their code objects
+    path2 = str(tmp_path / "search2.journal")
+    GridSearchCV(_FlakyKMeansLike(), {"c": [0.1, 0.2]}, cv=2, refit=False,
+                 n_jobs=1, scoring=lambda e, X, y=None: e.score(X),
+                 checkpoint=path2).fit(X)
+    gs_l = GridSearchCV(_FlakyKMeansLike(), {"c": [0.1, 0.2]}, cv=2,
+                        refit=False, n_jobs=1,
+                        scoring=lambda e, X, y=None: 3.0 * e.score(X) - 1.0,
+                        checkpoint=path2)
+    gs_l.fit(X)
+    assert gs_l.n_resumed_cells_ == 0
+
+
+def test_solver_done_flag_converged_on_last_budgeted_iteration(
+        staged, tmp_path):
+    """A solver converging exactly on its chunk's final budgeted iteration
+    records converged=True via the loop's own done flag, instead of a
+    redundant extra chunk from inferring convergence as n_it < budget."""
+    data, beta0, mask, mesh = staged
+    path = str(tmp_path / "done.ckpt")
+    # huge tolerances: Boyd stopping satisfied on the very first iteration,
+    # which is also the entire chunk budget
+    _beta, iters = ckpt.solve_checkpointed(
+        "admm", data.X, data.y, data.weights, beta0, mask, mesh,
+        path=path, chunk_iters=1, max_iter=5,
+        family="logistic", regularizer="l2", lamduh=0.1,
+        abstol=1e9, reltol=1e9)
+    assert iters == 1  # no redundant second chunk
+    _tree, meta = ckpt.load_pytree(path)
+    assert meta["converged"] is True
+
+
+def test_glm_facade_checkpoint_two_datasets_same_path(tmp_path):
+    """checkpoint= is a path PREFIX: fits on different data snapshot to
+    distinct fingerprint-suffixed files instead of erroring on mismatch."""
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    X1, y1 = _logreg_problem(seed=0)
+    X2, y2 = _logreg_problem(seed=1)
+    path = str(tmp_path / "prefix.ckpt")
+
+    est = LogisticRegression(solver="lbfgs", max_iter=30,
+                             checkpoint=path, checkpoint_every=10)
+    est.fit(X1, y1)
+    coef1 = est.coef_.copy()
+    est.fit(X2, y2)  # previously: ValueError (fingerprint mismatch)
+    assert not np.allclose(est.coef_, coef1)
+
+    plain = LogisticRegression(solver="lbfgs", max_iter=30).fit(X2, y2)
+    np.testing.assert_allclose(est.coef_, plain.coef_, rtol=1e-4, atol=1e-5)
+
+
+def test_glm_facade_checkpoint_inside_cv_search(tmp_path):
+    """A checkpointed GLM inside GridSearchCV: every (candidate, split) cell
+    stages a different slice; per-problem path suffixes keep them from
+    colliding (previously the second cell raised under error_score='raise')."""
+    from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.model_selection import GridSearchCV
+
+    X, y = _logreg_problem(n=200)
+    est = LogisticRegression(solver="lbfgs", max_iter=20,
+                             checkpoint=str(tmp_path / "cv.ckpt"),
+                             checkpoint_every=10)
+    gs = GridSearchCV(est, {"C": [1.0, 0.1]}, cv=2, refit=False, n_jobs=1)
+    gs.fit(X, y)  # error_score defaults to 'raise' — must not raise
+    assert len(gs.cv_results_["mean_test_score"]) == 2
+
+
+def test_search_checkpoint_migrates_pre_identity_journals(tmp_path):
+    """Journals written before scoring identity keyed cells on scorer NAMES.
+    Multi-metric name lists (whose names actually reached the legacy keys)
+    still resume and are migrated forward; None/single-string specs all
+    collapsed to ['score'] in legacy keys — ambiguous across metrics — so
+    they get NO bridge and recompute."""
+    from sklearn.model_selection import ParameterGrid, check_cv
+
+    from dask_ml_tpu.model_selection import GridSearchCV
+    from dask_ml_tpu.model_selection._search import _content_array
+    from dask_ml_tpu.model_selection._tokenize import tokenize
+
+    class _CountingClf(BaseEstimator):
+        n_fits = 0
+
+        def __init__(self, c=0.1):
+            self.c = c
+
+        def fit(self, X, y=None):
+            type(self).n_fits += 1
+            self.t_ = self.c
+            return self
+
+        def predict(self, X):
+            return (X[:, 0] > self.t_).astype(float)
+
+    rng = np.random.RandomState(3)
+    X = rng.randn(40, 3)
+    y = (X[:, 0] > 0).astype(float)
+    grid = {"c": [0.1, 0.2]}
+    scoring = ["accuracy", "r2"]
+
+    # oracle run, then rewrite its journal under the LEGACY key format
+    gs0 = GridSearchCV(_CountingClf(), grid, cv=2, refit=False,
+                       n_jobs=1, scoring=scoring,
+                       checkpoint=str(tmp_path / "new.journal"))
+    gs0.fit(X, y)
+
+    est = _CountingClf()
+    cv = check_cv(2, y, classifier=False)
+    splits = list(cv.split(X, y))
+    est_token = tokenize(type(est), est.get_params(deep=True),
+                         _content_array(X), _content_array(y), {})
+    records = ckpt.CellJournal(str(tmp_path / "new.journal")).load()
+    legacy = ckpt.CellJournal(str(tmp_path / "old.journal"))
+    new_scoring_id = ("list", ("accuracy", "r2"))
+    for params in ParameterGrid(grid):
+        for si in range(2):
+            legacy_key = tokenize("cell", est_token, params,
+                                  splits[si][0], splits[si][1],
+                                  sorted(scoring), True)
+            new_key = tokenize("cell", est_token, params, splits[si][0],
+                               splits[si][1], new_scoring_id, True)
+            assert new_key in records
+            legacy.append(legacy_key, records[new_key])
+
+    _CountingClf.n_fits = 0
+    gs = GridSearchCV(_CountingClf(), grid, cv=2, refit=False,
+                      n_jobs=1, scoring=scoring,
+                      checkpoint=str(tmp_path / "old.journal"))
+    gs.fit(X, y)
+    assert gs.n_resumed_cells_ == 4
+    assert _CountingClf.n_fits == 0
+    _cv_results_equal(gs.cv_results_, gs0.cv_results_)
+
+
+def test_search_checkpoint_no_bridge_for_single_name_scoring(tmp_path):
+    """scoring=None / a single string never probes legacy keys: their legacy
+    key component was always ['score'], identical across DIFFERENT metrics,
+    so bridging could restore another metric's scores."""
+    from sklearn.model_selection import ParameterGrid, check_cv
+
+    from dask_ml_tpu.model_selection import GridSearchCV
+    from dask_ml_tpu.model_selection._search import (_content_array,
+                                                     _resolve_scoring)
+    from dask_ml_tpu.model_selection._tokenize import tokenize
+
+    rng = np.random.RandomState(4)
+    X = rng.randn(40, 3)
+    grid = {"c": [0.1, 0.2]}
+
+    est = _FlakyKMeansLike()
+    scorers, _ = _resolve_scoring(est, None)
+    cv = check_cv(2, None, classifier=False)
+    splits = list(cv.split(X, None))
+    est_token = tokenize(type(est), est.get_params(deep=True),
+                         _content_array(X), _content_array(None), {})
+    legacy = ckpt.CellJournal(str(tmp_path / "old.journal"))
+    for params in ParameterGrid(grid):
+        for si in range(2):
+            legacy_key = tokenize("cell", est_token, params,
+                                  splits[si][0], splits[si][1],
+                                  sorted(scorers), True)
+            legacy.append(legacy_key, ({"score": 123.0}, None, 0.0, 0.0,
+                                       False))
+
+    _FlakyKMeansLike.n_fits = 0
+    gs = GridSearchCV(_FlakyKMeansLike(), grid, cv=2, refit=False,
+                      n_jobs=1, checkpoint=str(tmp_path / "old.journal"))
+    gs.fit(X)
+    assert gs.n_resumed_cells_ == 0
+    assert _FlakyKMeansLike.n_fits == 4  # everything recomputed
+    assert not np.any(gs.cv_results_["mean_test_score"] == 123.0)
