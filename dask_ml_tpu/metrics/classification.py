@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 @jax.jit
@@ -37,6 +38,30 @@ def _accuracy_count(y_true, y_pred, sample_weight):
 def accuracy_score(
     y_true, y_pred, normalize: bool = True, sample_weight=None, compute: bool = True
 ):
+    def _kind(a):
+        dt = getattr(a, "dtype", None)
+        # avoid materializing device arrays just to read a dtype; only
+        # dtype-less inputs (lists) go through numpy
+        return dt.kind if dt is not None else np.asarray(a).dtype.kind
+
+    kt, kp = _kind(y_true), _kind(y_pred)
+    if (kt in "USO") != (kp in "USO"):
+        # one side strings, the other numeric: np.concatenate would promote
+        # numerics to strings and '1' != '1.0' would score 0 silently —
+        # raise loudly instead, as sklearn does
+        raise TypeError(
+            "Labels in y_true and y_pred should be of the same type, got "
+            f"dtype kinds {kt!r} and {kp!r}"
+        )
+    if kt in "USO":
+        # string/object labels (e.g. multiclass class names) can't stage to
+        # device; map both through the label union — equality of indices is
+        # equality of labels, so the device comparison is unchanged
+        y_true = np.asarray(y_true)
+        y_pred = np.asarray(y_pred)
+        union = np.unique(np.concatenate([y_true.ravel(), y_pred.ravel()]))
+        y_true = np.searchsorted(union, y_true)
+        y_pred = np.searchsorted(union, y_pred)
     y_true = jnp.asarray(y_true)
     y_pred = jnp.asarray(y_pred)
     if sample_weight is None:

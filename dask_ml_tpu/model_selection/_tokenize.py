@@ -70,14 +70,20 @@ def _value_identity(obj, seen=None):
         if arr.dtype == object:
             return ("nd-obj", arr.shape, _stable_repr(arr.tolist()))
         return ("nd", arr.shape, str(arr.dtype), arr.tobytes())
-    if isinstance(obj, (list, tuple)):
-        return ("seq", type(obj).__name__,
-                tuple(_value_identity(v, seen) for v in obj))
-    if isinstance(obj, dict):
-        return ("map", tuple(
-            (_stable_repr(k), _value_identity(obj[k], seen))
-            for k in sorted(obj, key=repr)))
-    if isinstance(obj, (set, frozenset)):
+    if isinstance(obj, (list, tuple, dict, set, frozenset)):
+        # containers join the cycle guard: self-referential lists/dicts are
+        # legal Python and must not blow the stack
+        seen = set() if seen is None else seen
+        if id(obj) in seen:
+            return ("cycle",)
+        seen = seen | {id(obj)}
+        if isinstance(obj, (list, tuple)):
+            return ("seq", type(obj).__name__,
+                    tuple(_value_identity(v, seen) for v in obj))
+        if isinstance(obj, dict):
+            return ("map", tuple(
+                (_stable_repr(k), _value_identity(obj[k], seen))
+                for k in sorted(obj, key=repr)))
         return ("set", tuple(sorted(
             (_value_identity(v, seen) for v in obj), key=repr)))
     return _stable_repr(obj)
@@ -96,7 +102,20 @@ def _object_identity(obj, seen=None):
             (k, _value_identity(v, seen)) for k, v in sorted(attrs.items())
         )
     else:
-        attr_id = _stable_repr(obj)
+        # __slots__-backed objects have no __dict__; their state lives in
+        # the slot descriptors declared across the MRO
+        slot_names = sorted({
+            name
+            for klass in type(obj).__mro__
+            for name in getattr(klass, "__slots__", ())
+        })
+        if slot_names:
+            attr_id = tuple(
+                (name, _value_identity(getattr(obj, name, "<unset>"), seen))
+                for name in slot_names
+            )
+        else:
+            attr_id = _stable_repr(obj)
     return ("obj", type(obj).__module__, type(obj).__qualname__, attr_id)
 
 
@@ -110,10 +129,10 @@ def _cell_value(cell):
 def _callable_identity(fn, seen=None):
     import functools
 
-    seen = set() if seen is None else seen
-    if id(fn) in seen:
+    outer_seen = set() if seen is None else seen
+    if id(fn) in outer_seen:
         return ("cycle",)
-    seen = seen | {id(fn)}
+    seen = outer_seen | {id(fn)}
     if isinstance(fn, functools.partial):
         # partial's __dict__ is empty — func/args/keywords carry the state
         return ("partial", _callable_identity(fn.func, seen),
@@ -146,8 +165,10 @@ def _callable_identity(fn, seen=None):
                 getattr(fn, "__qualname__", ""), _code_identity(code),
                 cells, defaults, kwdefaults, self_id)
     # non-function callable (e.g. a make_scorer product): class + attribute
-    # values, with function-valued attrs (the score_func) by code identity
-    return _object_identity(fn, seen)
+    # values, with function-valued attrs (the score_func) by code identity.
+    # Delegate with the OUTER seen — _object_identity does its own
+    # check-and-add for fn, and the id we just added would read as a cycle.
+    return _object_identity(fn, outer_seen)
 
 
 def _normalize(obj, h):

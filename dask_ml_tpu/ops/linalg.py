@@ -6,13 +6,18 @@ truncated_svd.py:163-171); the survey assigns the implementation to this
 build (SURVEY §7.2-4: "we own the tsqr"). TPU-native design:
 
 - **tsqr** (Benson/Gleich/Demmel 2013, the algorithm the reference cites at
-  pca.py:121-127): one ``shard_map`` program — each shard takes a local
-  ``jnp.linalg.qr`` of its row block, the small R factors are
-  ``all_gather``-ed over the ICI (P·d×d total — tiny), every shard runs the
-  same small stacked QR (replicated compute beats a scatter round-trip), and
-  the local Q is patched with its slice of the small Q. The reference's
-  recursive dask reduction tree collapses to one gather because mesh sizes
-  (≤ thousands of chips) never need a multi-level tree for d×d blocks.
+  pca.py:121-127): the DEFAULT path is CholeskyQR2 — two rounds of
+  (sharded Gram matmul → replicated small Cholesky → triangular solve),
+  every FLOP a matmul/trsm on the MXU — with a measured-orthogonality
+  guard that falls back, inside the same XLA program (``lax.cond``), to
+  the Householder variant: one ``shard_map`` program where each shard
+  takes a local ``jnp.linalg.qr`` of its row block, the small R factors
+  are gathered over the ICI (P·d×d total — tiny), every shard runs the
+  same small stacked QR (replicated compute beats a scatter round-trip),
+  and the local Q is patched with its slice of the small Q. The
+  reference's recursive dask reduction tree collapses to one gather
+  because mesh sizes (≤ thousands of chips) never need a multi-level tree
+  for d×d blocks.
 - **SVD via tsqr**: SVD of the small R, then ``U = Q @ U_r`` locally.
 - **svd_compressed** (Halko/Martinsson/Tropp randomized range finder with QR
   power iterations — the ``da.linalg.svd_compressed`` analogue): sharded
@@ -54,7 +59,10 @@ def _gather_replicated(x, n_shards):
 
 
 @partial(jax.jit, static_argnames=("mesh",))
-def _tsqr_impl(X, *, mesh):
+def _tsqr_householder_impl(X, *, mesh):
+    """Per-shard Householder QR + gathered small QR — the numerically
+    bulletproof (but MXU-unfriendly: sequential panel factorizations) path.
+    Kept as the fallback branch of :func:`_tsqr_impl`'s condition guard."""
     n_shards = mesh.shape[DATA_AXIS]
 
     @partial(
@@ -77,6 +85,50 @@ def _tsqr_impl(X, *, mesh):
     return run(X)
 
 
+#: max accepted ‖QᵀQ − I‖_max from the CholeskyQR2 fast path. Well-conditioned
+#: f32 inputs land ~1e-6; the error grows ~cond(X)²·eps, so exceeding this
+#: means the Gram squaring lost real information and Householder must run.
+_CHOLQR_ORTHO_TOL = 1e-3
+
+
+@partial(jax.jit, static_argnames=("mesh",))
+def _tsqr_impl(X, *, mesh):
+    """Thin QR of a row-sharded tall-skinny array: CholeskyQR2 fast path
+    with an orthogonality guard, falling back to Householder tsqr.
+
+    CholeskyQR2 (two rounds of Gram→Cholesky→triangular-solve) keeps every
+    FLOP on the MXU — measured 57× faster than the per-shard Householder
+    panels at the PCA bench shape (500k×1000) — but one Gram squares the
+    condition number, so for cond(X) ≳ 1/√eps_f32 (~3e3) the factor
+    degrades. The guard measures the ACTUAL orthogonality error
+    ‖QᵀQ − I‖_max (one extra d×d Gram pass — cheap next to the two rounds,
+    and robust where diag(R) condition estimates can underestimate badly)
+    and a ``lax.cond`` dispatches to the Householder branch only when the
+    fast factor is bad, so the whole thing stays ONE XLA program usable
+    inside outer jits. X = Q·R holds exactly for the fast path regardless of
+    the guard (Q is defined as X·R⁻¹), so the guard is purely about how
+    orthonormal Q is.
+
+    Falls back statically to Householder when per-shard rows < d (the fast
+    path's (n, d) output shape needs full column rank per the Gram).
+    """
+    n_shards = mesh.shape[DATA_AXIS]
+    n, d = X.shape
+    if n // n_shards < d:
+        # short shards: Householder handles the k1 = n_loc < d shapes
+        return _tsqr_householder_impl(X, mesh=mesh)
+
+    Qf, Rf = _cholesky_qr2(X)
+    err = jnp.max(jnp.abs(
+        Qf.T @ Qf - jnp.eye(d, dtype=Qf.dtype)))  # psum over sharded axis
+    return lax.cond(
+        err < _CHOLQR_ORTHO_TOL,
+        lambda X: (Qf, Rf),
+        lambda X: _tsqr_householder_impl(X, mesh=mesh),
+        X,
+    )
+
+
 @jax.jit
 def _mask_padding_rows(X, weights):
     """Zero out padding rows (weight 0). The factorizations below are only
@@ -92,7 +144,11 @@ def tsqr(X, mesh: Optional[jax.sharding.Mesh] = None, weights=None):
     Returns ``(Q, R)`` with Q sharded like X (``P('data', None)``) and R
     replicated. Requires the feature axis unsharded — the same single-block
     constraint the reference enforces (reference: utils.py:120-125).
-    ``weights`` (optional row weights) masks padding rows to exact zeros."""
+    ``weights`` (optional row weights) masks padding rows to exact zeros.
+    Runs guarded CholeskyQR2 with Householder fallback (see
+    :func:`_tsqr_impl`); note R's diagonal is positive on the fast path and
+    sign-unnormalized on the fallback — downstream SVD composition is
+    sign-insensitive and ``svd_flip`` fixes output determinism."""
     mesh = mesh or mesh_lib.default_mesh()
     if weights is not None:
         X = _mask_padding_rows(X, weights)
@@ -127,10 +183,12 @@ def _cholesky_qr2(Y):
     Measured ~4× cheaper than the per-shard Householder tsqr at the
     PCA-100 bench shape (500k×110). One round loses ~cond(Y)²·eps of
     orthogonality (the Gram squares the condition number); the second
-    round repairs it, and each power iteration re-orthonormalizes anyway —
-    which is why this lives on the RANDOMIZED path only, while exact
-    ``tsvd`` keeps Householder tsqr. A relative ridge on the Gram keeps
-    the Cholesky PD at f32 even for nearly rank-deficient Y.
+    round repairs it whenever cond(Y) ≲ 1/√eps. The randomized path uses
+    it unguarded (each power iteration re-orthonormalizes, so the cond²
+    sensitivity never compounds); the exact path (:func:`_tsqr_impl`)
+    adds an orthogonality guard with Householder fallback. A relative
+    ridge on the Gram keeps the Cholesky PD at f32 even for nearly
+    rank-deficient Y.
     """
     def one(Yc):
         G = Yc.T @ Yc  # (ell, ell) replicated; psum over the sharded axis

@@ -218,3 +218,67 @@ def test_admm_compile_cache(mesh8):
     LogisticRegression(solver="admm", max_iter=50, C=2.0).fit(X, y)
     dt = time.perf_counter() - t0
     assert dt < 3.0, f"admm refit took {dt:.1f}s — likely recompiled"
+
+
+# ---------------------------------------------------------------------------
+# multiclass OVR (parity-plus: the reference's multiclass="ovr" param was
+# accepted but dask-glm is binary-only, so it never did anything)
+# ---------------------------------------------------------------------------
+
+
+def _three_class_problem(n=900, d=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    W = rng.randn(3, d).astype(np.float32) * 2.0
+    logits = X @ W.T + 0.3 * rng.randn(n, 3)
+    y = np.argmax(logits, axis=1)
+    return X, np.array(["ant", "bee", "cat"])[y]
+
+
+@pytest.mark.parametrize("solver", ["lbfgs", "newton", "admm"])
+def test_logistic_ovr_matches_sklearn(solver):
+    from sklearn.linear_model import LogisticRegression as SKLR
+    from sklearn.multiclass import OneVsRestClassifier
+
+    X, y = _three_class_problem()
+    est = LogisticRegression(solver=solver, C=1.0, max_iter=200).fit(X, y)
+    assert est.coef_.shape == (3, X.shape[1])
+    assert est.intercept_.shape == (3,)
+    assert list(est.classes_) == ["ant", "bee", "cat"]
+    assert est.decision_function(X).shape == (X.shape[0], 3)
+    proba = est.predict_proba(X)
+    assert proba.shape == (X.shape[0], 3)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+
+    sk = OneVsRestClassifier(SKLR(C=1.0, max_iter=500)).fit(X, y)
+    agree = np.mean(est.predict(X) == sk.predict(X))
+    assert agree > 0.97, agree
+    assert est.score(X, y) > 0.9
+
+
+def test_logistic_ovr_binary_surface_unchanged():
+    """Two classes keep the reference's binary facade: 1-D coef_ and 1-D
+    predict_proba (reference: glm.py:203-215)."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(200, 4).astype(np.float32)
+    y = (X[:, 0] > 0).astype(int)
+    est = LogisticRegression(solver="newton", max_iter=100).fit(X, y)
+    assert est.coef_.ndim == 1
+    assert est.predict_proba(X).ndim == 1
+
+
+def test_logistic_rejects_non_ovr_multiclass():
+    rng = np.random.RandomState(0)
+    X = rng.randn(30, 3)
+    y = np.array([0, 1, 2] * 10)
+    with pytest.raises(ValueError, match="multiclass must be 'ovr'"):
+        LogisticRegression(multiclass="multinomial").fit(X, y)
+
+
+def test_logistic_ovr_partial_fit_stays_binary():
+    rng = np.random.RandomState(0)
+    X = rng.randn(30, 3)
+    y = np.array([0, 1, 2] * 10)
+    est = LogisticRegression()
+    with pytest.raises(ValueError, match="partial_fit supports exactly 2"):
+        est.partial_fit(X, y, classes=[0, 1, 2])

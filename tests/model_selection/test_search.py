@@ -608,3 +608,66 @@ def test_callable_params_do_not_collide_in_memo():
                       n_jobs=1).fit(X)
     scores = np.asarray(gs.cv_results_["mean_test_score"])
     np.testing.assert_allclose(sorted(scores), [-2.0, 2.0, 4.0])
+
+
+def test_callable_identity_distinguishes_scorer_state():
+    """The content-identity machinery behind checkpoint cell keys must
+    separate behaviorally different callables of every shape — and must
+    never collapse object scorers to a cycle marker (regression: the
+    cycle guard once pre-added the object id before delegating to the
+    object-identity walk, so EVERY make_scorer product hashed equal)."""
+    from functools import partial
+
+    from sklearn.metrics import make_scorer, mean_squared_error, r2_score
+
+    from dask_ml_tpu.model_selection._tokenize import (_callable_identity,
+                                                       _value_identity)
+
+    assert (_callable_identity(make_scorer(r2_score))
+            == _callable_identity(make_scorer(r2_score)))
+    assert (_callable_identity(make_scorer(mean_squared_error))
+            != _callable_identity(
+                make_scorer(mean_squared_error, greater_is_better=False)))
+    assert (_callable_identity(make_scorer(mean_squared_error))
+            != _callable_identity(make_scorer(r2_score)))
+
+    class SlotScorer:  # __slots__: state outside __dict__
+        __slots__ = ("margin",)
+
+        def __init__(self, m):
+            self.margin = m
+
+        def __call__(self, est, X, y=None):
+            return self.margin
+
+    assert (_callable_identity(SlotScorer(0.1))
+            != _callable_identity(SlotScorer(0.2)))
+    assert (_callable_identity(SlotScorer(0.1))
+            == _callable_identity(SlotScorer(0.1)))
+
+    class MyScorer:  # bound-method scorers carry instance state
+        def __init__(self, t):
+            self.t = t
+
+        def score(self, est, X, y=None):
+            return self.t
+
+    assert (_callable_identity(MyScorer(0.5).score)
+            != _callable_identity(MyScorer(0.9).score))
+
+    def my_scorer(est, X, y=None, beta=1.0):
+        return beta
+
+    assert (_callable_identity(partial(my_scorer, beta=1))
+            != _callable_identity(partial(my_scorer, beta=2)))
+
+    # cyclic structures terminate instead of recursing forever
+    cyc_list = []
+    cyc_list.append(cyc_list)
+    _value_identity(cyc_list)
+    cyc_dict = {}
+    cyc_dict["x"] = cyc_dict
+    _value_identity(cyc_dict)
+    w = MyScorer(1.0)
+    w.cb = w.score
+    _callable_identity(w.cb)

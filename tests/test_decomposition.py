@@ -237,3 +237,68 @@ def test_svd_compressed_zero_matrix(any_mesh):
     np.testing.assert_allclose(np.asarray(S), 0.0, atol=1e-5)
     assert np.isfinite(np.asarray(U)).all()
     assert np.isfinite(np.asarray(Vt)).all()
+
+
+def test_tsqr_guarded_fast_path_well_conditioned(any_mesh):
+    """Well-conditioned input takes the CholeskyQR2 fast path and still
+    satisfies X = QR with orthonormal Q."""
+    from dask_ml_tpu.ops import linalg
+    from dask_ml_tpu.parallel.sharding import prepare_data
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(512, 16).astype(np.float32)
+    data = prepare_data(X, mesh=any_mesh)
+    Q, R = linalg.tsqr(data.X, mesh=any_mesh, weights=data.weights)
+    Qh, Rh = np.asarray(Q)[:512], np.asarray(R)
+    np.testing.assert_allclose(Qh @ Rh, X, atol=2e-4)
+    np.testing.assert_allclose(Qh.T @ Qh, np.eye(16), atol=2e-4)
+    # fast path's R has a positive diagonal (Cholesky factor product)
+    assert np.all(np.diag(Rh) > 0)
+
+
+def test_tsqr_guard_falls_back_on_ill_conditioned(any_mesh):
+    """cond(X) >> 1/sqrt(eps_f32): the Gram squaring destroys the fast
+    factor, the orthogonality guard trips, and the Householder branch
+    still returns an orthonormal Q."""
+    from dask_ml_tpu.ops import linalg
+    from dask_ml_tpu.parallel.sharding import prepare_data
+
+    rng = np.random.RandomState(1)
+    k = 24
+    s = np.logspace(0, -7, k)  # cond 1e7
+    U, _ = np.linalg.qr(rng.randn(1024, k))
+    V, _ = np.linalg.qr(rng.randn(k, k))
+    X = ((U * s) @ V.T).astype(np.float32)
+    data = prepare_data(X, mesh=any_mesh)
+    Q, R = linalg.tsqr(data.X, mesh=any_mesh, weights=data.weights)
+    Qh = np.asarray(Q)[:1024]
+    np.testing.assert_allclose(Qh.T @ Qh, np.eye(k), atol=1e-3)
+    np.testing.assert_allclose(Qh @ np.asarray(R), X, atol=1e-4)
+
+
+def test_tsvd_zero_matrix_guard(any_mesh):
+    """All-zero input degenerates the CholeskyQR2 factor completely; the
+    guard must route to Householder and return exact-zero singular values
+    (the documented property of the exact path)."""
+    from dask_ml_tpu.ops import linalg
+    from dask_ml_tpu.parallel.sharding import prepare_data
+
+    data = prepare_data(np.zeros((64, 8), np.float32), mesh=any_mesh)
+    U, S, Vt = linalg.tsvd(data.X, mesh=any_mesh, weights=data.weights)
+    np.testing.assert_allclose(np.asarray(S), 0.0, atol=1e-6)
+    assert np.isfinite(np.asarray(U)).all()
+    assert np.isfinite(np.asarray(Vt)).all()
+
+
+def test_tsqr_short_shards_use_householder(any_mesh):
+    """Per-shard rows < d: the fast path's shapes don't apply; the static
+    fallback still produces a valid thin QR."""
+    from dask_ml_tpu.ops import linalg
+    from dask_ml_tpu.parallel.sharding import prepare_data
+
+    rng = np.random.RandomState(2)
+    X = rng.randn(16, 12).astype(np.float32)  # 2 rows/shard on mesh8
+    data = prepare_data(X, mesh=any_mesh)
+    Q, R = linalg.tsqr(data.X, mesh=any_mesh, weights=data.weights)
+    np.testing.assert_allclose(
+        np.asarray(Q)[:16] @ np.asarray(R), X, atol=2e-4)
