@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 from sklearn.base import BaseEstimator, TransformerMixin
 
+from dask_ml_tpu.config import maybe_host
 from dask_ml_tpu.models import kmeans as core
 from dask_ml_tpu.ops.pairwise import euclidean_distances
 from dask_ml_tpu.parallel.sharding import prepare_data, unpad_rows
@@ -144,7 +145,7 @@ class KMeans(TransformerMixin, BaseEstimator):
         X = check_array(X)
         data = prepare_data(X)
         labels = core.predict_labels(data.X, jnp.asarray(self.cluster_centers_))
-        return np.asarray(unpad_rows(labels, data.n))
+        return maybe_host(unpad_rows(labels, data.n))
 
     def transform(self, X):
         """Distances to each center (reference: cluster/k_means.py:191-194)."""
@@ -152,7 +153,7 @@ class KMeans(TransformerMixin, BaseEstimator):
         X = check_array(X)
         data = prepare_data(X)
         d = euclidean_distances(data.X, jnp.asarray(self.cluster_centers_))
-        return np.asarray(unpad_rows(d, data.n))
+        return maybe_host(unpad_rows(d, data.n))
 
     def score(self, X, y=None):
         """Negative inertia on X (higher is better), matching sklearn."""
@@ -164,6 +165,60 @@ class KMeans(TransformerMixin, BaseEstimator):
                 data.X, data.weights, jnp.asarray(self.cluster_centers_)
             )
         )
+
+    # -- batched-candidate protocol (search driver fast path) -------------
+    #
+    # The search driver buckets homogeneous candidates (same estimator
+    # class, same static params, same upstream data) and fits+scores the
+    # whole bucket as ONE compiled program (SURVEY §2.9 task-parallelism
+    # row; VERDICT r3 #1). KMeans supports batching over (n_clusters, tol):
+    # tol variants share one Lloyd trajectory, k variants share one masked
+    # program — see models/kmeans.py batched_lloyd_cells.
+
+    _batchable_params = frozenset({"n_clusters", "tol"})
+
+    def _supports_batched(self, static_params) -> bool:
+        """Batchable only with on-device ``init='random'`` — the k-means||
+        and k-means++ inits are host-driven loops that would serialize the
+        group (and per-candidate inits would defeat trajectory sharing)."""
+        return static_params.get("init", self.init) == "random"
+
+    def _batchable_member_ok(self, member_params, n_train_min) -> bool:
+        """A member whose n_clusters can't fit the smallest train split
+        must run per-cell so ITS failure follows error_score semantics
+        instead of failing the whole group program."""
+        k = int(member_params.get("n_clusters", self.n_clusters))
+        return k >= 1 and (n_train_min is None or k <= n_train_min)
+
+    def _batched_fit_score(self, X, y, members, eval_sets):
+        """Fit every member (dict of batchable-param overrides) and score
+        (negative inertia) each against each eval set. Returns
+        ``{"n_iter": (M,), "scores": [per eval set (M,) arrays]}`` where the
+        arrays are DEVICE arrays — the call is pure async dispatch; the
+        search driver bulk-fetches all groups' outputs in one sync.
+
+        TRUSTED device-array inputs (CV slices scanned at upload, chain
+        intermediates from validated input — see ``StagingMemo.trust``)
+        skip the NaN-scan sync inside ``check_array``; untrusted input is
+        validated as anywhere else."""
+        data = prepare_data(check_array(X))
+        evals = [prepare_data(check_array(E)) for E in eval_sets]
+        key = check_random_state(self.random_state)
+        pairs = [
+            (int(m.get("n_clusters", self.n_clusters)),
+             float(m.get("tol", self.tol)))
+            for m in members
+        ]
+        for k, _ in pairs:
+            if k < 1 or k > data.n:
+                raise ValueError(
+                    f"n_clusters={k} must be in [1, n_samples={data.n}]")
+        n_iters, _train_inertia, eval_inertias = core.batched_lloyd_cells(
+            data, pairs, evals, max_iter=self.max_iter, key=key)
+        return {
+            "n_iter": n_iters,
+            "scores": [-inert for inert in eval_inertias],
+        }
 
 
 def k_means(X, n_clusters, init="k-means||", precompute_distances="auto",

@@ -18,6 +18,14 @@ Knobs (all also overridable per-call at the API they configure):
   :func:`dask_ml_tpu.parallel.mesh.use_mesh`. Mesh scoping is deliberately
   PROCESS-VISIBLE, not thread-local: the search driver's worker threads
   must resolve the same mesh as the thread that opened the scope.
+- ``device_outputs`` — when True, transform-like outputs of the jax-native
+  estimators (scaler/PCA transforms, predictions) are returned as device
+  arrays instead of host numpy. The default (False) preserves the sklearn
+  contract; the search driver enables it around all-jax-native pipelines so
+  stage outputs flow device→device between pipeline steps — over a slow
+  host link every needless fetch is ~RTT + bytes/bandwidth, and a CV sweep
+  does thousands of them. ``np.asarray`` on a returned device array still
+  works everywhere. Thread-local under :func:`config_context`.
 
 (Feature-axis sharding is NOT a config knob: staging layout changes the
 shape of fitted state, so only estimators written for it — the GLMs —
@@ -33,7 +41,32 @@ from typing import Any, Optional
 _DEFAULTS: dict[str, Any] = {
     "dtype": None,
     "mesh": None,
+    "device_outputs": False,
 }
+
+
+def maybe_host(x):
+    """Return ``x`` as host numpy unless ``device_outputs`` is enabled.
+
+    The one call every estimator's transform/predict tail goes through:
+    by default it materializes to numpy (sklearn contract); inside a
+    ``config_context(device_outputs=True)`` scope the device array passes
+    through untouched, so pipeline stages chain device→device with no
+    host round-trip. Pass-through outputs are marked TRUSTED in the active
+    staging scope (they derive from inputs the producing estimator already
+    validated), so the next stage's ``check_array`` can skip the NaN-scan
+    sync without weakening validation of genuinely user-supplied arrays.
+    """
+    if get_config()["device_outputs"]:
+        from dask_ml_tpu.parallel.sharding import _current_memo
+
+        memo = _current_memo()
+        if memo is not None:
+            memo.trust(x)
+        return x
+    import numpy as np
+
+    return np.asarray(x)
 
 _global_config = dict(_DEFAULTS)
 _local = threading.local()

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from sklearn.base import BaseEstimator, TransformerMixin
 
+from dask_ml_tpu.config import maybe_host
 from dask_ml_tpu.ops import linalg
 from dask_ml_tpu.parallel import mesh as mesh_lib
 from dask_ml_tpu.parallel.sharding import prepare_data, shard_rows, unpad_rows
@@ -39,6 +40,11 @@ def _weighted_mean(X, w):
 
 
 @jax.jit
+def _project(Xs, mean, components):
+    return (Xs - mean) @ components.T
+
+
+@jax.jit
 def _center_and_mask(X, w, mean):
     # Padding rows must stay exact zeros after centering so they vanish from
     # R in the tsqr (see ops/linalg.py module docstring).
@@ -50,6 +56,31 @@ def _total_var(Xc, n):
     # ddof=1 column variance sum of the centered data (padding rows are 0
     # and contribute nothing); reference: pca.py:249 ``X.var(ddof=1)``.
     return (Xc * Xc).sum() / (n - 1.0)
+
+
+@partial(jax.jit, static_argnames=("k", "n_power_iter", "randomized",
+                                   "mesh"))
+def _fit_program(X, w, key, n, *, k, n_power_iter, randomized, mesh):
+    """The whole PCA device fit as ONE program: mean, centering+masking,
+    the factorization, sign flip, and total variance. One dispatch instead
+    of five — on a high-latency host link, per-op dispatch cost dominates
+    small fits (a CV sweep runs many)."""
+    from dask_ml_tpu.ops import linalg
+
+    mean = _weighted_mean(X, w)
+    Xc = _center_and_mask(X, w, mean)
+    if randomized:
+        U, S, Vt = linalg._svd_compressed_impl(
+            Xc, key, k=k, n_power_iter=n_power_iter, n_oversamples=10)
+    else:
+        U, S, Vt = linalg._tsvd_impl(Xc, mesh=mesh)
+    U, Vt = linalg.svd_flip(U, Vt)
+    # only the randomized path needs the full-data variance (the exact
+    # path's total variance IS sum(S²)/(n-1)); gating avoids a wasted
+    # O(n·d) reduction per exact fit
+    total_var = (_total_var(Xc, n) if randomized
+                 else jnp.asarray(0.0, jnp.float32))
+    return mean, U, S, Vt, total_var
 
 
 class PCA(BaseEstimator, TransformerMixin):
@@ -128,31 +159,34 @@ class PCA(BaseEstimator, TransformerMixin):
             and n_features % mesh_lib.n_model_shards(mesh) == 0
         )
         data = prepare_data(X, mesh=mesh, shard_features=shard_features)
-        mean = _weighted_mean(data.X, data.weights)
-        Xc = _center_and_mask(data.X, data.weights, mean)
-
-        if solver in ("full", "tsqr"):
-            with profile_phase(logger, "pca-tsvd"):
-                U, S, Vt = linalg.tsvd(Xc, mesh=mesh, weights=data.weights)
-        else:
-            key = check_random_state(self.random_state)
-            with profile_phase(logger, "pca-randomized-svd"):
-                U, S, Vt = linalg.svd_compressed(
-                    Xc, n_components, n_power_iter=int(self.iterated_power),
-                    key=key, mesh=mesh, weights=data.weights,
-                )
-        U, Vt = linalg.svd_flip(U, Vt)
+        randomized = solver == "randomized"
+        key = check_random_state(self.random_state)
+        with profile_phase(logger, "pca-fit-program"):
+            # centering + masking + factorization + sign flip + total
+            # variance as one dispatch (see _fit_program)
+            mean, U, S, Vt, tv = _fit_program(
+                data.X, data.weights, key, float(n_samples),
+                k=n_components, n_power_iter=int(self.iterated_power),
+                randomized=randomized, mesh=mesh)
 
         # tsvd on the padded array can return min(n_padded, d) singular
         # values; only min(n_samples, d) are real (padding rows are zeros, so
         # the surplus values are exact zeros) — trim before bookkeeping or
         # the noise-variance tail mean gets diluted.
-        S_np = np.asarray(S)[: min(n_samples, n_features)]
-        explained_variance = (S_np ** 2) / (n_samples - 1)
+        from dask_ml_tpu.config import get_config
+
+        # Under device_outputs (the search driver's all-jax-native scope)
+        # learned attrs stay device arrays and fit() never syncs — the whole
+        # fit is one async dispatch chain. np.asarray on any attr still
+        # materializes it for interactive use.
+        lazy = get_config()["device_outputs"]
+        to_host = (lambda a: a) if lazy else np.asarray
+        S_t = to_host(S[: min(n_samples, n_features)])
+        explained_variance = (S_t ** 2) / (n_samples - 1)
         if solver == "randomized":
-            total_var = float(_total_var(Xc, float(n_samples)))
+            total_var = tv if lazy else float(tv)
         else:
-            total_var = float(explained_variance.sum())
+            total_var = explained_variance.sum()
         explained_variance_ratio = explained_variance / total_var
 
         # Probabilistic-PCA noise variance (reference: pca.py:262-276).
@@ -170,12 +204,13 @@ class PCA(BaseEstimator, TransformerMixin):
         self.n_samples_ = n_samples
         self.n_features_ = n_features
         self.n_components_ = n_components
-        self.mean_ = np.asarray(mean)
-        self.components_ = np.asarray(Vt)[:n_components]
+        self.mean_ = to_host(mean)
+        self.components_ = to_host(Vt[:n_components])
         self.explained_variance_ = explained_variance[:n_components]
         self.explained_variance_ratio_ = explained_variance_ratio[:n_components]
-        self.singular_values_ = S_np[:n_components]
-        self.noise_variance_ = float(noise_variance)
+        self.singular_values_ = S_t[:n_components]
+        self.noise_variance_ = (noise_variance if lazy
+                                else float(noise_variance))
         return U, S, Vt, data.n
 
     def fit(self, X, y=None):
@@ -187,21 +222,29 @@ class PCA(BaseEstimator, TransformerMixin):
         pass (reference: pca.py:330-357)."""
         U, S, Vt, n = self._fit(X)
         k = self.n_components_
-        U = np.asarray(unpad_rows(U, n))[:, :k]
+        Uk = unpad_rows(U, n)[:, :k]
         if self.whiten:
-            return U * np.sqrt(self.n_samples_ - 1)
-        return U * np.asarray(S)[:k]
+            return maybe_host(Uk) * np.sqrt(self.n_samples_ - 1)
+        from dask_ml_tpu.config import get_config
+
+        if get_config()["device_outputs"]:
+            # stay on device end to end — np.asarray(S) would force the
+            # host sync the device_outputs scope exists to avoid
+            return maybe_host(Uk * S[:k])
+        return np.asarray(Uk) * np.asarray(S)[:k]
 
     # -- inference ---------------------------------------------------------
 
     def transform(self, X):
         X = check_array(X)
         Xs, n = shard_rows(X)
-        out = (Xs - jnp.asarray(self.mean_)) @ jnp.asarray(self.components_).T
+        # one fused dispatch (vs 2-4 eager ops); matters on high-RTT links
+        out = _project(Xs, jnp.asarray(self.mean_),
+                       jnp.asarray(self.components_))
         if self.whiten:
             out = out / jnp.sqrt(jnp.asarray(
                 self.explained_variance_, out.dtype))
-        return np.asarray(unpad_rows(out, n))
+        return maybe_host(unpad_rows(out, n))
 
     def inverse_transform(self, X):
         X = check_array(X)
@@ -211,7 +254,7 @@ class PCA(BaseEstimator, TransformerMixin):
             comps = jnp.sqrt(jnp.asarray(
                 self.explained_variance_))[:, None] * comps
         out = Xs @ comps + jnp.asarray(self.mean_)
-        return np.asarray(unpad_rows(out, n))
+        return maybe_host(unpad_rows(out, n))
 
     # -- Probabilistic-PCA scoring (reference: pca.py:387-434) -------------
 
@@ -262,7 +305,7 @@ class PCA(BaseEstimator, TransformerMixin):
         ll = -0.5 * (Xr * (Xr @ precision)).sum(axis=1)
         sign, logdet = np.linalg.slogdet(self.get_precision())
         ll = ll - 0.5 * (self.n_features_ * np.log(2.0 * np.pi) - logdet)
-        return np.asarray(unpad_rows(ll, n))
+        return maybe_host(unpad_rows(ll, n))
 
     def score(self, X, y=None):
         return float(np.mean(self.score_samples(X)))

@@ -7,6 +7,7 @@ import jax.numpy as jnp
 import numpy as np
 from sklearn.base import BaseEstimator, TransformerMixin
 
+from dask_ml_tpu.config import maybe_host
 from dask_ml_tpu.ops import linalg
 from dask_ml_tpu.parallel import mesh as mesh_lib
 from dask_ml_tpu.parallel.sharding import prepare_data, shard_rows, unpad_rows
@@ -86,10 +87,10 @@ class TruncatedSVD(BaseEstimator, TransformerMixin):
         X = check_array(X)
         Xs, n = shard_rows(X)
         out = Xs @ jnp.asarray(self.components_).T
-        return np.asarray(unpad_rows(out, n))
+        return maybe_host(unpad_rows(out, n))
 
     def inverse_transform(self, X):
         X = check_array(X)
         Xs, n = shard_rows(X)
         out = Xs @ jnp.asarray(self.components_)
-        return np.asarray(unpad_rows(out, n))
+        return maybe_host(unpad_rows(out, n))

@@ -57,11 +57,22 @@ def _is_pairwise(est) -> bool:
         return bool(getattr(est, "_pairwise", False))
 
 
+def _n_rows(a) -> int:
+    """Sample count for any X container (ndarray, scipy sparse, frame,
+    list) — ``np.asarray(sparse)`` would 0-d wrap it."""
+    shape = getattr(a, "shape", None)
+    if shape is not None and len(shape) >= 1:
+        return int(shape[0])
+    return len(a)
+
+
 def _index(a, idx):
     if a is None:
         return None
     if hasattr(a, "iloc"):
         return a.iloc[idx]
+    if hasattr(a, "tocsr"):  # scipy sparse: np.asarray would 0-d wrap it
+        return a.tocsr()[idx]
     return np.asarray(a)[idx]
 
 
@@ -90,13 +101,58 @@ class CVCache:
     """Materialized train/test slices per split, cached per search
     (reference: methods.py:67-124). ``extract(..., pairwise=True)`` slices
     both axes of a precomputed kernel matrix the way the reference does
-    (methods.py:110-124)."""
+    (methods.py:110-124).
 
-    def __init__(self, splits, X, y, cache: bool = True):
+    ``device_slices=True`` (set by the driver for all-jax-native candidate
+    estimators): X uploads to the device ONCE and train/test slices are
+    device-side gathers — over a slow host link, uploading every CV slice
+    separately costs ~2× the bytes of X per split pair, all on the wire.
+    y and pairwise-kernel slicing stay host-side (small / special-cased).
+    """
+
+    def __init__(self, splits, X, y, cache: bool = True,
+                 device_slices: bool = False):
         self.splits = list(splits)
         self.X = X
         self.y = y
         self.cache = {} if cache else None
+        self._x_dev = None
+        self._dev_lock = threading.Lock()
+        self.device_slices = bool(device_slices) and self._device_sliceable(X)
+
+    @staticmethod
+    def _device_sliceable(X) -> bool:
+        if X is None or hasattr(X, "iloc"):
+            return False
+        try:
+            arr = np.asarray(X)
+        except Exception:
+            return False
+        return arr.ndim == 2 and arr.dtype.kind in "fiub"
+
+    def _device_slice(self, idx):
+        import jax.numpy as jnp
+
+        from dask_ml_tpu.parallel.sharding import _current_memo
+        from dask_ml_tpu.utils.validation import staging_dtype
+
+        with self._dev_lock:
+            if self._x_dev is None:
+                arr = np.asarray(self.X)
+                x = jnp.asarray(arr, dtype=staging_dtype(arr.dtype))
+                # One NaN/inf scan for the WHOLE search at upload: finite
+                # data marks its slices trusted (estimators skip the
+                # per-stage re-scan). Non-finite data is NOT an error
+                # here — slices stay untrusted, each estimator's own
+                # check_array raises inside methods.fit, and the cells
+                # follow error_score semantics exactly as host slicing did.
+                self._x_finite = bool(jnp.isfinite(x).all())
+                self._x_dev = x
+        out = jnp.take(self._x_dev, jnp.asarray(np.asarray(idx)), axis=0)
+        memo = _current_memo()
+        if memo is not None and self._x_finite:
+            memo.trust(out)
+        return out
 
     def n_test(self, split_idx: int) -> int:
         return len(self.splits[split_idx][1])
@@ -118,6 +174,8 @@ class CVCache:
                     "estimators"
                 )
             out = X[np.ix_(idx, train_idx)]
+        elif self.device_slices:
+            out = self._device_slice(idx)
         else:
             out = _index(self.X, idx)
         if self.cache is not None:
@@ -133,14 +191,27 @@ class CVCache:
 class _Memo:
     """token → Future; the first thread to claim a token computes it, every
     other candidate sharing the token waits on the same future. This gives the
-    reference's graph-level CSE (one task per distinct key) under threads."""
+    reference's graph-level CSE (one task per distinct key) under threads.
+
+    Each entry also records a human label, its upstream keys, and how many
+    cells consumed it — the data behind ``shared_fit_report()`` /
+    ``visualize()`` (the reference's ``GridSearchCV.visualize`` renders the
+    shared-fit dask graph the same way, _search.py:870-894)."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._futures: dict[str, Future] = {}
+        self._meta: dict[str, dict] = {}
 
-    def get_or_run(self, key: str, fn):
+    def get_or_run(self, key: str, fn, label: Optional[str] = None,
+                   parents: tuple = ()):
         with self._lock:
+            meta = self._meta.setdefault(
+                key, {"label": label, "parents": tuple(parents),
+                      "consumers": 0})
+            meta["consumers"] += 1
+            if label and not meta["label"]:
+                meta["label"] = label
             fut = self._futures.get(key)
             owner = fut is None
             if owner:
@@ -156,6 +227,10 @@ class _Memo:
     @property
     def n_entries(self) -> int:
         return len(self._futures)
+
+    def report(self) -> dict:
+        with self._lock:
+            return {k: dict(m) for k, m in self._meta.items()}
 
 
 # ---------------------------------------------------------------------------
@@ -296,7 +371,7 @@ class _CandidateRunner:
         self.return_train_score = return_train_score
         self.fit_params = fit_params or {}
         self._n_samples = (
-            None if cv_cache.X is None else int(np.asarray(cv_cache.X).shape[0])
+            None if cv_cache.X is None else _n_rows(cv_cache.X)
         )
         self._fp_cache: dict[int, dict] = {}
         self._fp_lock = threading.Lock()
@@ -342,7 +417,8 @@ class _CandidateRunner:
                 error_score=self.error_score,
             )
 
-        return self.memo.get_or_run(key, run)
+        return self.memo.get_or_run(
+            key, run, label=f"fit:{type(est).__name__}")
 
     # -- recursive composite expansion with CSE --------------------------
     #
@@ -398,7 +474,9 @@ class _CandidateRunner:
                 fit_params=sfit, error_score=self.error_score,
             )
 
-        (fitted, Xt), t = self.memo.get_or_run(key, run_stage)
+        (fitted, Xt), t = self.memo.get_or_run(
+            key, run_stage, label=f"fit_transform:{type(est).__name__}",
+            parents=(upstream,))
         return key, fitted, Xt, t, fitted is FIT_FAILURE
 
     def _fit_any(self, est, params, sfit, upstream, split_idx,
@@ -428,7 +506,9 @@ class _CandidateRunner:
                 fit_params=sfit, error_score=self.error_score,
             )
 
-        fitted, t = self.memo.get_or_run(key, run_fit)
+        fitted, t = self.memo.get_or_run(
+            key, run_fit, label=f"fit:{type(est).__name__}",
+            parents=(upstream,))
         return key, fitted, t, fitted is FIT_FAILURE
 
     def _ft_atomic_fallback(self, est, params, sfit, upstream, split_idx,
@@ -453,10 +533,13 @@ class _CandidateRunner:
                 error_score=self.error_score,
             )
 
+        wl = f"whole-{mode}:{type(est).__name__}"
         if need_transform:
-            (fitted, Xt), t = self.memo.get_or_run(key, run_whole)
+            (fitted, Xt), t = self.memo.get_or_run(
+                key, run_whole, label=wl, parents=(upstream,))
         else:
-            fitted, t = self.memo.get_or_run(key, run_whole)
+            fitted, t = self.memo.get_or_run(
+                key, run_whole, label=wl, parents=(upstream,))
             Xt = None
         return key, fitted, Xt, t, fitted is FIT_FAILURE
 
@@ -598,10 +681,142 @@ class _CandidateRunner:
                 Xt = _union_concat(sub_parts, weights, n_rows)
             return (out, Xt), 0.0
 
-        (fitted_union, Xt), t_assemble = self.memo.get_or_run(ckey, assemble)
+        (fitted_union, Xt), t_assemble = self.memo.get_or_run(
+            ckey, assemble, label="union-concat",
+            parents=tuple(t for t in sub_tokens if t != "drop"))
         total_time += t_assemble
         return (ckey, fitted_union, Xt, total_time,
                 fitted_union is FIT_FAILURE)
+
+    # -- batched candidate cells (fast path) -----------------------------
+    #
+    # Homogeneous candidates (same estimator class, same static params,
+    # same upstream pipeline prefix) are fit+scored as ONE compiled program
+    # via the terminal estimator's ``_batched_fit_score`` protocol — the
+    # "vmap over candidates" promise of SURVEY §2.9, and the answer to a
+    # search paying per-cell dispatch + score-fetch round-trips on a
+    # high-RTT host↔device link. The memo makes the group program run
+    # exactly once however many member cells land on the pool.
+
+    def _prefix_root_pairwise(self, est):
+        if not isinstance(est, Pipeline):
+            return _is_pairwise(est)
+        first_real = next(
+            (s for _, s in est.steps
+             if not _is_dropped(s) and s != "passthrough"),
+            None,
+        )
+        return _is_pairwise(first_real) if first_real is not None else False
+
+    _PREFIX_FAILED = "prefix-failed"
+
+    def batched_group_out(self, params, split_idx, group):
+        """Dispatch (or memo-hit) a group's fit+score program.
+
+        Returns ``(result, t_prefix)`` where ``result`` is
+        ``(out_dict, t_group)``, ``None`` (group program failed under a
+        numeric error_score), or ``_PREFIX_FAILED``. ``out_dict['scores']``
+        may hold device arrays: the protocol's batched fits are pure async
+        dispatch, and the driver pre-pass bulk-fetches every group's
+        outputs in ONE ``device_get`` before cells read member values —
+        per-group fetches each pay ~2 RTT and serialize on a tunneled
+        host link."""
+        from timeit import default_timer
+
+        est = self.estimator
+        root_pairwise = self._prefix_root_pairwise(est)
+        t_prefix = 0.0
+        if isinstance(est, Pipeline):
+            term_name, term_est = est.steps[-1]
+            prefix_steps = est.steps[:-1]
+            root = self._root_token(split_idx)
+            prefix_params = {
+                k: v for k, v in params.items()
+                if not k.startswith(term_name + "__")
+            }
+            if prefix_steps:
+                # the prefix fits through the SAME recursive CSE machinery
+                # (and thus the same memo tokens) as unbatched candidates
+                token, fitted_prefix, Xt, t_prefix, failed = (
+                    self._ft_pipeline(
+                        Pipeline(prefix_steps), prefix_params, {}, root,
+                        split_idx, root_pairwise, need_transform=True,
+                    ))
+                if failed:
+                    return self._PREFIX_FAILED, t_prefix
+            else:
+                token = root
+                Xt = self._resolve_input(root, split_idx, root_pairwise)
+                fitted_prefix = None
+        else:
+            term_est = est
+            token = self._root_token(split_idx)
+            Xt = self.cv_cache.extract(split_idx, train=True,
+                                       pairwise=root_pairwise)
+            fitted_prefix = None
+
+        def compute_test_input():
+            Xe = self.cv_cache.extract(split_idx, train=False,
+                                       pairwise=root_pairwise)
+            if fitted_prefix is not None:
+                for _name, stage in fitted_prefix.steps:
+                    if _is_dropped(stage) or stage == "passthrough":
+                        continue
+                    Xe = stage.transform(Xe)
+            return Xe
+
+        test_key = tokenize("batch-test-input", token, split_idx)
+        X_test = self.memo.get_or_run(
+            test_key, compute_test_input, label="batch-test-input",
+            parents=(token,))
+
+        gkey = tokenize(
+            "batch-cells", token, split_idx, type(term_est),
+            term_est.get_params(deep=True), sorted(group.static.items()),
+            group.token, self.return_train_score,
+        )
+
+        def run_group():
+            t0 = default_timer()
+            est_c = methods.copy_estimator(term_est)
+            if group.static:
+                est_c.set_params(**group.static)
+            evals = [X_test] + ([Xt] if self.return_train_score else [])
+            try:
+                out = est_c._batched_fit_score(
+                    Xt, self._y_train(split_idx), group.members, evals)
+            except Exception as e:
+                if self.error_score == "raise":
+                    raise
+                methods.warn_fit_failure(self.error_score, e)
+                return None  # whole-group failure
+            return out, default_timer() - t0
+
+        result = self.memo.get_or_run(
+            gkey, run_group,
+            label=(f"batch-cells:{type(term_est).__name__}"
+                   f"[{len(group.members)} members]"),
+            parents=(token,))
+        return result, t_prefix
+
+    def run_batched(self, params, split_idx, group, member_idx):
+        """One cell through its batch group. Same result contract as
+        :meth:`run`; the group fit+score executes once per (group, split)."""
+        result, t_prefix = self.batched_group_out(params, split_idx, group)
+        if result is self._PREFIX_FAILED or result is None:
+            test, train, score_time = methods.score(
+                FIT_FAILURE, None, None,
+                None if not self.return_train_score else FIT_FAILURE,
+                None, self.scorers, self.error_score)
+            return test, train, t_prefix, score_time, True
+        out, t_group = result
+        n_members = max(len(group.members), 1)
+        test = {"score": float(np.asarray(out["scores"][0][member_idx]))}
+        train = None
+        if self.return_train_score:
+            train = {"score": float(np.asarray(out["scores"][1][member_idx]))}
+        # wall-time attribution: the group's cost is shared evenly
+        return test, train, t_prefix + t_group / n_members, 0.0, False
 
     # -- one cell --------------------------------------------------------
     def run(self, params, split_idx):
@@ -638,6 +853,124 @@ class _CandidateRunner:
             self.error_score,
         )
         return test, train, fit_time, score_time, fitted is FIT_FAILURE
+
+
+# ---------------------------------------------------------------------------
+# batched-candidate planning
+# ---------------------------------------------------------------------------
+
+
+class _BatchGroup:
+    """A bucket of homogeneous candidates fit+scored as one program."""
+
+    __slots__ = ("members", "static", "token")
+
+    def __init__(self, members, static, token):
+        self.members = members  # list of varying-param dicts, one/member
+        self.static = static  # terminal-stage overrides shared by the group
+        self.token = token
+
+
+def _plan_batched_groups(estimator, candidate_params, scorers, fit_params,
+                         n_train_min=None):
+    """→ ``{candidate_index: (_BatchGroup, member_idx)}`` for candidates
+    eligible for the batched fast path (empty dict = everything runs the
+    per-cell path).
+
+    Eligibility: passthrough scoring only (the estimator's own ``score`` is
+    what the batched program can compute in bulk; arbitrary scorer callables
+    can't be batched), no fit_params, a terminal estimator declaring the
+    protocol (``_batchable_params`` + ``_batched_fit_score``), candidates
+    whose terminal params vary ONLY in batchable keys grouped by (prefix
+    params, static terminal params), groups of ≥ 2. A candidate the
+    estimator's ``_batchable_member_ok`` hook rejects (e.g. KMeans with
+    ``n_clusters`` > the smallest train split) is EXCLUDED from its group
+    and takes the per-cell path, so its individual failure follows
+    error_score semantics instead of poisoning the whole group's program.
+    """
+    if fit_params:
+        return {}
+    if set(scorers) != {"score"} or scorers["score"] is not _passthrough_scorer:
+        return {}
+    if isinstance(estimator, Pipeline):
+        if not estimator.steps:
+            return {}
+        term_name, term = estimator.steps[-1]
+        if _is_dropped(term) or term == "passthrough" or isinstance(
+                term, (Pipeline, FeatureUnion)):
+            return {}
+        prefix = term_name + "__"
+
+        def split_params(p):
+            tp, rest = {}, {}
+            for k, v in p.items():
+                if k.startswith(prefix):
+                    tp[k[len(prefix):]] = v
+                else:
+                    rest[k] = v
+            return tp, rest
+
+    elif isinstance(estimator, FeatureUnion):
+        return {}
+    else:
+        term = estimator
+
+        def split_params(p):
+            return dict(p), {}
+
+    batchable = getattr(type(term), "_batchable_params", None)
+    if not batchable or not hasattr(term, "_batched_fit_score"):
+        return {}
+
+    buckets: dict = {}
+    for ci, p in enumerate(candidate_params):
+        if isinstance(estimator, Pipeline) and any(
+                "__" not in k for k in p):
+            continue  # top-level overrides (steps=, stage replacement)
+        tp, rest = split_params(p)
+        varying = {k: v for k, v in tp.items() if k in batchable}
+        static = {k: v for k, v in tp.items() if k not in batchable}
+        merged = {**term.get_params(deep=False), **static}
+        try:
+            if not term._supports_batched(merged):
+                continue
+            member_ok = getattr(term, "_batchable_member_ok", None)
+            if member_ok is not None and not member_ok(
+                    {**merged, **varying}, n_train_min):
+                continue
+        except Exception:
+            continue
+        gk = tokenize("plan", sorted(rest.items()), sorted(static.items()))
+        b = buckets.setdefault(gk, {"static": static, "members": [],
+                                    "cis": []})
+        b["members"].append(varying)
+        b["cis"].append(ci)
+
+    plan: dict = {}
+    for b in buckets.values():
+        if len(b["cis"]) < 2:
+            continue
+        grp = _BatchGroup(
+            b["members"], b["static"],
+            tokenize("members", b["members"], sorted(b["static"].items())),
+        )
+        for mi, ci in enumerate(b["cis"]):
+            plan[ci] = (grp, mi)
+    return plan
+
+
+def _all_stages_device_native(estimator) -> bool:
+    """True when the estimator (or every pipeline stage) is a dask_ml_tpu
+    estimator — the condition under which the driver turns on
+    ``device_outputs`` so stage outputs chain device→device."""
+    def native(e):
+        return type(e).__module__.startswith("dask_ml_tpu.")
+
+    if isinstance(estimator, Pipeline):
+        stages = [s for _, s in estimator.steps
+                  if not _is_dropped(s) and s != "passthrough"]
+        return bool(stages) and all(native(s) for s in stages)
+    return native(estimator)
 
 
 # ---------------------------------------------------------------------------
@@ -696,10 +1029,21 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
         scorers, multimetric = _resolve_scoring(estimator, self.scoring)
         refit_metric = self._check_refit(multimetric, scorers)
 
+        # plain python sequences are legal inputs (sklearn's indexable()
+        # contract); the split/slice machinery wants arrays
+        if (X is not None and not hasattr(X, "shape")
+                and not hasattr(X, "iloc") and not hasattr(X, "tocsr")):
+            X = np.asarray(X)
+        if (y is not None and not hasattr(y, "shape")
+                and not hasattr(y, "iloc")):
+            y = np.asarray(y)
+
         cv = check_cv(self.cv, y, classifier=is_classifier(estimator))
         splits = list(cv.split(X, y, groups))
         n_splits = len(splits)
-        cv_cache = CVCache(splits, X, y, cache=self.cache_cv)
+        device_native = _all_stages_device_native(estimator)
+        cv_cache = CVCache(splits, X, y, cache=self.cache_cv,
+                           device_slices=device_native)
 
         candidate_params = list(self._get_param_iterator())
         n_candidates = len(candidate_params)
@@ -716,6 +1060,15 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
             for si in range(n_splits)
         ]
         n_workers = _normalize_n_jobs(self.n_jobs)
+
+        # Batched-candidate fast path: bucket homogeneous candidates and let
+        # the terminal estimator fit+score each bucket as one compiled
+        # program (see _plan_batched_groups). Unplanned candidates take the
+        # per-cell path; both share the same prefix-fit memo tokens.
+        batch_plan = _plan_batched_groups(
+            estimator, candidate_params, scorers, fit_params,
+            n_train_min=min((len(tr) for tr, _te in splits), default=None))
+        self.n_batched_cells_ = len(batch_plan) * n_splits
 
         # Checkpoint/resume: completed cells live in an append-only journal
         # keyed by content — estimator config + candidate params + the
@@ -783,6 +1136,19 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
         caller_cfg = {
             k: v for k, v in config_lib.get_config().items() if k != "mesh"
         }
+        if device_native:
+            # all-jax-native candidate pipelines: stage outputs flow
+            # device→device between pipeline steps for the whole search
+            # (over a slow host link, per-stage fetch+restage dominates) —
+            # scoped to the cells, so refit and the returned estimator keep
+            # the numpy sklearn contract
+            caller_cfg["device_outputs"] = True
+
+        def _compute_cell(ci, si):
+            if ci in batch_plan:
+                group, mi = batch_plan[ci]
+                return runner.run_batched(candidate_params[ci], si, group, mi)
+            return runner.run(candidate_params[ci], si)
 
         def run_cell(ci, si):
             with config_lib.config_context(**caller_cfg):
@@ -795,11 +1161,11 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
                             journal.append(key, hit)
                     if hit is not None:
                         return hit
-                    result = runner.run(candidate_params[ci], si)
+                    result = _compute_cell(ci, si)
                     if not result[-1]:  # journal only non-failed cells
                         journal.append(key, result)
                     return result
-                return runner.run(candidate_params[ci], si)
+                return _compute_cell(ci, si)
 
         # Device-staging memo: jax-native candidates re-stage their CV slice
         # inside fit; within this scope identical (slice, role) pairs upload
@@ -808,6 +1174,35 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
         from dask_ml_tpu.parallel.sharding import staging_memo
 
         with staging_memo() as dmemo:
+            # Pre-pass for batched groups: dispatch every group's program
+            # (prefix fits + the batched fit+score are pure async dispatch
+            # under device_outputs) and bulk-fetch ALL outputs in one
+            # device sync — per-group fetches each cost ~2 RTT and
+            # serialize on a tunneled host link, which dominated the sweep.
+            if batch_plan:
+                group_cis: dict = {}
+                for ci, (group, _mi) in batch_plan.items():
+                    group_cis.setdefault(id(group), (group, []))[1].append(ci)
+                pending = []
+                with config_lib.config_context(**caller_cfg):
+                    for group, cis in group_cis.values():
+                        for si in range(n_splits):
+                            if journal is not None and all(
+                                cell_keys[(cj, si)] in done_cells
+                                for cj in cis
+                            ):
+                                continue  # fully journaled: nothing to run
+                            res, _tp = runner.batched_group_out(
+                                candidate_params[cis[0]], si, group)
+                            if isinstance(res, tuple):
+                                pending.append(res[0])
+                if pending:
+                    import jax
+
+                    host = jax.device_get([o["scores"] for o in pending])
+                    for o, hs in zip(pending, host):
+                        o["scores"] = list(hs)
+
             if n_workers == 1:
                 results = [run_cell(ci, si) for ci, si in cells]
             else:
@@ -834,8 +1229,12 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
         self.multimetric_ = multimetric
         self.scorer_ = scorers if multimetric else scorers["score"]
         self.n_shared_fits_ = memo.n_entries  # CSE observability
+        self._shared_fit_graph = memo.report()
 
-        if self.refit:
+        # best_* availability follows sklearn: single-metric scoring gets
+        # best_index_/best_score_/best_params_ even with refit=False;
+        # multimetric needs refit=<metric name> to define "best"
+        if self.refit or not multimetric:
             rank_key = (
                 f"rank_test_{refit_metric}" if multimetric else "rank_test_score"
             )
@@ -847,6 +1246,7 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
                 self.cv_results_[mean_key][self.best_index_]
             )
             self.best_params_ = candidate_params[self.best_index_]
+        if self.refit:
             # refit always raises on failure (reference: _search.py:965-969)
             best = methods.copy_estimator(estimator)
             best.set_params(**self.best_params_)
@@ -865,6 +1265,61 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
                 f"scorer used to find the best parameters; got {self.refit!r}"
             )
         return self.refit
+
+    # -- search introspection (reference: _search.py:870-894) ------------
+
+    def shared_fit_report(self) -> str:
+        """Human-readable view of the work-sharing (CSE) DAG: every
+        memoized node with how many cells consumed it, ordered by sharing.
+
+        The reference's ``visualize()`` renders the merged dask graph to
+        show that pipeline-prefix fits are shared (reference:
+        _search.py:870-894, docs/source/hyper-parameter-search.rst:78-135);
+        this is the same evidence as text — each node ran its computation
+        ONCE however many consumers it lists.
+        """
+        if not hasattr(self, "_shared_fit_graph"):
+            raise AttributeError("Not fitted; call fit first")
+        nodes = self._shared_fit_graph
+        lines = [
+            f"{len(nodes)} distinct computations served "
+            f"{sum(m['consumers'] for m in nodes.values())} consumers",
+            "",
+            f"{'consumers':>9}  {'node':<40} key",
+        ]
+        order = sorted(nodes.items(),
+                       key=lambda kv: -kv[1]["consumers"])
+        for key, m in order:
+            label = m["label"] or "(input)"
+            lines.append(f"{m['consumers']:>9}  {label:<40} {key[:12]}")
+        return "\n".join(lines)
+
+    def visualize(self, filename: Optional[str] = "mydask"):
+        """Render the shared-fit DAG with graphviz (parity with the
+        reference's ``DaskBaseSearchCV.visualize``, _search.py:870-894).
+        Requires the optional ``graphviz`` package; use
+        :meth:`shared_fit_report` for the dependency-free text view."""
+        if not hasattr(self, "_shared_fit_graph"):
+            raise AttributeError("Not fitted; call fit first")
+        try:
+            import graphviz
+        except ImportError as e:  # pragma: no cover - optional dep
+            raise ImportError(
+                "visualize() needs the optional 'graphviz' package; "
+                "shared_fit_report() provides the same information as text"
+            ) from e
+        g = graphviz.Digraph("shared_fits")
+        nodes = self._shared_fit_graph
+        for key, m in nodes.items():
+            label = m["label"] or "input"
+            g.node(key[:12], f"{label}\\n×{m['consumers']}")
+        for key, m in nodes.items():
+            for p in m["parents"]:
+                if p in nodes:
+                    g.edge(p[:12], key[:12])
+        if filename:
+            g.render(filename, format="svg", cleanup=True)
+        return g
 
     # -- post-fit delegation (reference: _search.py:728-762) -------------
     def _check_is_fitted(self, method_name):

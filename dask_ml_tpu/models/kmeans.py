@@ -384,6 +384,153 @@ def scaled_tolerance(X, w, tol):
 
 
 # ---------------------------------------------------------------------------
+# Batched candidate cells (search fast path)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("max_k", "max_iter", "n_valid"))
+def _batched_cells_impl(X, w, uk_arr, member_uk, tol_arr, key, eval_Xs,
+                        eval_ws, *, max_k, max_iter, n_valid):
+    """All (n_clusters, tol) KMeans candidates over ONE dataset as ONE XLA
+    program: trajectories per unique k, per-tol stopping selection, bulk
+    scoring — the driver's batched-candidate fast path (SURVEY §2.9
+    task-parallelism row: "vmap over candidates when shapes are
+    homogeneous"; VERDICT r3 #1).
+
+    Three facts make this beat one-program-per-candidate by far more than
+    dispatch overhead:
+
+    - **Shared trajectories.** Candidates differing only in ``tol`` follow
+      the IDENTICAL Lloyd trajectory and differ only in where they stop, so
+      the program runs one ``lax.scan`` per UNIQUE ``n_clusters`` (recording
+      per-iteration centers/shift) and each member just SELECTS its stopping
+      iteration — 10 tol values cost one trajectory, not 10.
+    - **Masked k.** Centers live in a fixed ``(max_k, d)`` buffer with an
+      ``arange < k`` validity mask (invalid rows: +inf distance, frozen
+      position), so every ``n_clusters`` value shares one compiled program —
+      the recompilation-storm answer SURVEY §7.3 calls for ("jit with
+      hyperparams as traced scalars").
+    - **Bulk scoring.** Every member × eval-set inertia is computed
+      on-device in one pass and fetched together: on a high-RTT host link a
+      search's per-cell score fetches dominate wall time otherwise.
+
+    Member m's config: ``k = uk_arr[member_uk[m]]``, ``tol_arr[m]`` (raw;
+    scaled by mean feature variance in-program). Returns
+    ``(n_iters (M,), train_inertia (M,), eval_inertias tuple of (M,))``.
+    """
+    n_pad, d = X.shape
+    U = uk_arr.shape[0]
+    kiota = jnp.arange(max_k, dtype=jnp.int32)
+
+    # shared random init mirroring the single-fit path's _random_rows draw
+    # (same permutation of the same key): member k uses the first k sampled
+    # rows, so its trajectory matches a standalone fit(random_state=...) up
+    # to a row permutation of the center buffer — which leaves assignments,
+    # shifts, n_iter, and inertia unchanged
+    idx0 = jax.random.permutation(key, n_valid)[:max_k]
+    centers0 = jnp.take(X, idx0, axis=0).astype(jnp.float32)  # (max_k, d)
+
+    x2 = jnp.sum(X.astype(jnp.float32) ** 2, axis=1)  # (n_pad,) invariant
+
+    # tol scaling by mean feature variance ON DEVICE (the single-fit path's
+    # scaled_tolerance, without its host fetch)
+    sw = jnp.maximum(jnp.sum(w), 1.0)
+    mean = (w[:, None] * X).sum(0) / sw
+    var = (w[:, None] * (X - mean) ** 2).sum(0) / sw
+    tol_arr = tol_arr * var.mean()
+
+    def one_k(k):
+        valid = (kiota < k)  # (max_k,)
+
+        def step(centers, _):
+            c2 = jnp.sum(centers * centers, axis=1)
+            prod = jax.lax.dot_general(
+                X, centers.astype(X.dtype), (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # (n_pad, max_k)
+            scores = jnp.where(valid[None, :], c2[None, :] - 2.0 * prod,
+                               jnp.inf)
+            best = jnp.argmin(scores, axis=1)
+            onehot = (kiota[None, :] == best[:, None]).astype(jnp.float32)
+            oh_w = onehot * w[:, None]
+            sums = jax.lax.dot_general(
+                oh_w, X.astype(jnp.float32), (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)  # (max_k, d)
+            counts = oh_w.sum(axis=0)
+            live = jnp.logical_and(valid, counts > 0)
+            safe = jnp.where(counts > 0, counts, 1.0)
+            new_centers = jnp.where(live[:, None], sums / safe[:, None],
+                                    centers)
+            shift = jnp.sum(
+                jnp.where(valid[:, None], (new_centers - centers) ** 2, 0.0))
+            mind = jnp.maximum(jnp.min(scores, axis=1) + x2, 0.0)
+            inertia = jnp.sum(mind * w)
+            return new_centers, (new_centers, shift, inertia)
+
+        _, (hist, shifts, inertias) = jax.lax.scan(
+            step, centers0, None, length=max_iter)
+        return hist, shifts, inertias  # (T,max_k,d), (T,), (T,)
+
+    hist, shifts, inertias = jax.vmap(one_k)(uk_arr)  # (U,T,...)
+
+    # per-member stopping: first t with shift < tol, else T-1 (same rule as
+    # lloyd_loop's `shift >= tol` while-condition, reference
+    # cluster/k_means.py:496-499)
+    m_shifts = shifts[member_uk]  # (M, T)
+    below = m_shifts < tol_arr[:, None]
+    any_below = jnp.any(below, axis=1)
+    first = jnp.argmax(below, axis=1)
+    stop = jnp.where(any_below, first, max_iter - 1)  # (M,)
+    n_iters = stop + 1
+
+    centers_m = hist[member_uk, stop]  # (M, max_k, d) f32
+    k_m = uk_arr[member_uk]  # (M,)
+    valid_m = kiota[None, :] < k_m[:, None]  # (M, max_k)
+    train_inertia = inertias[member_uk, stop]  # (M,)
+
+    def eval_inertia(Xe, we):
+        xe2 = jnp.sum(Xe.astype(jnp.float32) ** 2, axis=1)  # (nE,)
+        c2 = jnp.sum(centers_m * centers_m, axis=2)  # (M, max_k)
+        flat = centers_m.reshape(-1, d)  # (M*max_k, d)
+        prod = jax.lax.dot_general(
+            Xe, flat.astype(Xe.dtype), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (nE, M*max_k)
+        prod = prod.reshape(Xe.shape[0], centers_m.shape[0], max_k)
+        scores = jnp.where(valid_m[None], c2[None] - 2.0 * prod, jnp.inf)
+        mind = jnp.maximum(jnp.min(scores, axis=2) + xe2[:, None], 0.0)
+        return jnp.sum(mind * we[:, None], axis=0)  # (M,)
+
+    eval_out = tuple(
+        eval_inertia(Xe, we) for Xe, we in zip(eval_Xs, eval_ws)
+    )
+    return n_iters, train_inertia, eval_out
+
+
+def batched_lloyd_cells(data, members, eval_sets, *, max_iter, key):
+    """Host entry for the batched-candidate program (see
+    :func:`_batched_cells_impl`).
+
+    ``data``: staged training :class:`DeviceData`; ``members``: list of
+    ``(n_clusters, tol)``; ``eval_sets``: list of staged DeviceData to score
+    (negative inertia). Returns ``(n_iters, train_inertia, [scores...])``
+    as DEVICE arrays — no sync: the dispatch is async, and the search
+    driver bulk-fetches every group's outputs in one ``device_get`` (a
+    fetch per group costs ~2 RTT on a tunneled host link and serializes).
+    """
+    ks = [int(k) for k, _ in members]
+    uks = sorted(set(ks))
+    uk_index = {k: i for i, k in enumerate(uks)}
+    max_k = max(uks)
+    tol_arr = jnp.asarray([float(t) for _, t in members], jnp.float32)
+    uk_arr = jnp.asarray(uks, jnp.int32)
+    member_uk = jnp.asarray([uk_index[k] for k in ks], jnp.int32)
+    n_iters, train_inertia, evals = _batched_cells_impl(
+        data.X, data.weights, uk_arr, member_uk, tol_arr, key,
+        tuple(e.X for e in eval_sets), tuple(e.weights for e in eval_sets),
+        max_k=max_k, max_iter=int(max_iter), n_valid=data.n)
+    return n_iters, train_inertia, list(evals)
+
+
+# ---------------------------------------------------------------------------
 # Initialization
 # ---------------------------------------------------------------------------
 

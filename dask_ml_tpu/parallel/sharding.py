@@ -51,7 +51,20 @@ class StagingMemo:
     def __init__(self):
         self._lock = threading.Lock()
         self._entries: dict = {}
+        self._trusted: dict = {}  # id -> strong ref: arrays validated once
         self.hits = 0
+
+    def trust(self, arr):
+        """Mark a device array as already validated (NaN/inf-scanned, or
+        derived from validated input): ``check_array`` skips re-scanning it
+        within this scope. Strong refs make id-keying safe, as for staging."""
+        with self._lock:
+            self._trusted[id(arr)] = arr
+        return arr
+
+    def is_trusted(self, arr) -> bool:
+        with self._lock:
+            return id(arr) in self._trusted
 
     def get_or_stage(self, key, refs, compute):
         with self._lock:

@@ -33,6 +33,7 @@ from pandas.api.types import CategoricalDtype
 from sklearn.base import BaseEstimator, TransformerMixin
 from sklearn.utils.validation import check_is_fitted
 
+from dask_ml_tpu.config import maybe_host
 from dask_ml_tpu.parallel.sharding import prepare_data, shard_rows, unpad_rows
 from dask_ml_tpu.utils.validation import check_array
 
@@ -41,6 +42,11 @@ BOUNDS_THRESHOLD = 1e-7
 # canonical home is the utils layer, as in the reference (imported from
 # dask_ml.utils at data.py:18); re-exported here for backward compat
 from dask_ml_tpu.utils._utils import handle_zeros_in_scale  # noqa: E402,F401
+
+
+@jax.jit
+def _standardize(X, mean, scale):
+    return (X - mean) / scale
 
 
 @jax.jit
@@ -70,16 +76,26 @@ class StandardScaler(skdata.StandardScaler):
     __doc__ = skdata.StandardScaler.__doc__
 
     def fit(self, X, y=None):
+        from dask_ml_tpu.config import get_config
+
         self._reset()
         X = check_array(X)
         data = prepare_data(X)
-        mean, var = (np.asarray(a) for a in _mean_var(data.X, data.weights))
+        mean, var = _mean_var(data.X, data.weights)
+        if get_config()["device_outputs"]:
+            # stay fully async: learned attrs as device arrays (np.asarray
+            # on access still works); the jnp handle-zeros matches
+            # handle_zeros_in_scale's divide-by-1-for-constant-features
+            scale = jnp.sqrt(jnp.where(var == 0.0, 1.0, var))
+        else:
+            mean, var = np.asarray(mean), np.asarray(var)
+            scale = np.sqrt(handle_zeros_in_scale(var))
         # sklearn's attribute contract: disabled statistics are None, not
         # absent.
         self.mean_ = mean if self.with_mean else None
         if self.with_std:
             self.var_ = var
-            self.scale_ = np.sqrt(handle_zeros_in_scale(var))
+            self.scale_ = scale
         else:
             self.var_ = None
             self.scale_ = None
@@ -96,11 +112,18 @@ class StandardScaler(skdata.StandardScaler):
         check_is_fitted(self, "n_samples_seen_")
         X = check_array(X)
         Xs, n = shard_rows(X)
-        if self.with_mean:
-            Xs = Xs - jnp.asarray(self.mean_, Xs.dtype)
-        if self.with_std:
-            Xs = Xs / jnp.asarray(self.scale_, Xs.dtype)
-        return np.asarray(unpad_rows(Xs, n))
+        if self.with_mean and self.with_std:
+            # fused single dispatch for the common case (a CV sweep calls
+            # this hundreds of times; per-op dispatch latency adds up on a
+            # high-RTT host link)
+            Xs = _standardize(Xs, jnp.asarray(self.mean_, Xs.dtype),
+                              jnp.asarray(self.scale_, Xs.dtype))
+        else:
+            if self.with_mean:
+                Xs = Xs - jnp.asarray(self.mean_, Xs.dtype)
+            if self.with_std:
+                Xs = Xs / jnp.asarray(self.scale_, Xs.dtype)
+        return maybe_host(unpad_rows(Xs, n))
 
     def inverse_transform(self, X, copy=None):
         check_is_fitted(self, "n_samples_seen_")
@@ -110,7 +133,7 @@ class StandardScaler(skdata.StandardScaler):
             Xs = Xs * jnp.asarray(self.scale_, Xs.dtype)
         if self.with_mean:
             Xs = Xs + jnp.asarray(self.mean_, Xs.dtype)
-        return np.asarray(unpad_rows(Xs, n))
+        return maybe_host(unpad_rows(Xs, n))
 
 
 class MinMaxScaler(skdata.MinMaxScaler):
@@ -152,7 +175,7 @@ class MinMaxScaler(skdata.MinMaxScaler):
         if getattr(self, "clip", False):
             lo, hi = self.feature_range
             out = jnp.clip(out, lo, hi)
-        return np.asarray(unpad_rows(out, n))
+        return maybe_host(unpad_rows(out, n))
 
     def inverse_transform(self, X, y=None, copy=None):
         check_is_fitted(self, "scale_")
@@ -160,7 +183,7 @@ class MinMaxScaler(skdata.MinMaxScaler):
         Xs, n = shard_rows(X)
         out = (Xs - jnp.asarray(self.min_, Xs.dtype)) / jnp.asarray(
             self.scale_, Xs.dtype)
-        return np.asarray(unpad_rows(out, n))
+        return maybe_host(unpad_rows(out, n))
 
 
 class RobustScaler(skdata.RobustScaler):
@@ -197,7 +220,7 @@ class RobustScaler(skdata.RobustScaler):
             Xs = Xs - jnp.asarray(self.center_, Xs.dtype)
         if self.with_scaling:
             Xs = Xs / jnp.asarray(self.scale_, Xs.dtype)
-        return np.asarray(unpad_rows(Xs, n))
+        return maybe_host(unpad_rows(Xs, n))
 
     def inverse_transform(self, X):
         check_is_fitted(self, "scale_")
@@ -207,7 +230,7 @@ class RobustScaler(skdata.RobustScaler):
             Xs = Xs * jnp.asarray(self.scale_, Xs.dtype)
         if self.with_centering:
             Xs = Xs + jnp.asarray(self.center_, Xs.dtype)
-        return np.asarray(unpad_rows(Xs, n))
+        return maybe_host(unpad_rows(Xs, n))
 
 
 # ---------------------------------------------------------------------------
@@ -300,7 +323,7 @@ class QuantileTransformer(skdata.QuantileTransformer):
             Xs, jnp.asarray(self.quantiles_, Xs.dtype),
             jnp.asarray(self.references_, Xs.dtype),
             inverse=inverse, normal=self.output_distribution == "normal")
-        return np.asarray(unpad_rows(out, n))
+        return maybe_host(unpad_rows(out, n))
 
     def transform(self, X):
         return self._transform_inner(X, inverse=False)

@@ -58,6 +58,21 @@ def check_array(
                              min_samples)
 
 
+def staging_dtype(np_dtype):
+    """The TPU-first dtype policy for staging untyped numeric input:
+    ints/uints/bools → float32; float64 → float32 unless x64 is enabled;
+    f32/f16/bf16 kept (returns ``None`` = no conversion). One definition so
+    every staging path (check_array, the search driver's device CV slices)
+    applies identical coercion."""
+    kind = np.dtype(np_dtype).kind
+    if kind in "iub":
+        return jnp.float32
+    if (kind == "f" and np.dtype(np_dtype).itemsize > 4
+            and not jax.config.jax_enable_x64):
+        return jnp.float32
+    return None
+
+
 def _check_array_impl(X, ensure_2d, allow_nd, force_all_finite, dtype,
                       min_samples):
     arr = np.asarray(X) if not isinstance(X, jax.Array) else X
@@ -74,15 +89,23 @@ def _check_array_impl(X, ensure_2d, allow_nd, force_all_finite, dtype,
         )
     if dtype is None:
         kind = np.dtype(arr.dtype).kind
-        if kind in "iub":
-            dtype = jnp.float32
-        elif kind == "f" and np.dtype(arr.dtype).itemsize > 4:
-            if not jax.config.jax_enable_x64:
-                dtype = jnp.float32
-        elif kind not in "f":
+        if kind not in "fiub":
             raise ValueError(f"Unsupported dtype {arr.dtype}")
+        dtype = staging_dtype(arr.dtype)
     out = jnp.asarray(arr, dtype=dtype)
     if force_all_finite:
+        if isinstance(X, jax.Array):
+            from dask_ml_tpu.parallel.sharding import _current_memo
+
+            memo = _current_memo()
+            if memo is not None and memo.is_trusted(X):
+                # explicitly validated within this staging scope (a CV
+                # slice scanned once at upload, or an output derived from
+                # validated input): re-scanning would cost a host sync per
+                # pipeline stage — the round-trip the scope eliminates.
+                # Untrusted device arrays (user-supplied, or slices of
+                # non-finite data) still get the scan below.
+                return out
         # Single fused reduction — the analogue of the reference's one-pass
         # NaN/inf scan (reference: cluster/k_means.py:161-170).
         if not bool(jnp.isfinite(out).all()):

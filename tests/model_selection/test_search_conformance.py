@@ -1,0 +1,575 @@
+"""sklearn GridSearchCV conformance suite for the TPU search driver.
+
+The reference's single biggest test asset is scikit-learn's own search test
+suite ported to run against its implementation
+(reference: tests/model_selection/dask_searchcv/test_model_selection_sklearn.py,
+1064 LoC, ~39 tests). This file is the analogue for this build: each test
+re-implements one of those behaviors — drop-in cv_results_ structure, sparse
+and precomputed-kernel inputs, multioutput, pickling, rank tie-breaking,
+error_score semantics, scorer selection — freshly written against modern
+scikit-learn (the reference targets the 2018 API: ``Imputer``,
+version-gated multimetric) and cited test-by-test by reference line.
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+from sklearn.base import BaseEstimator, ClassifierMixin
+from sklearn.cluster import KMeans as SKKMeans
+from sklearn.datasets import (make_blobs, make_classification,
+                              make_multilabel_classification)
+from sklearn.exceptions import FitFailedWarning
+from sklearn.linear_model import Ridge
+from sklearn.metrics import f1_score, make_scorer, roc_auc_score
+from sklearn.model_selection import (GroupKFold, GroupShuffleSplit, KFold,
+                                     LeaveOneGroupOut, LeavePGroupsOut,
+                                     StratifiedKFold, StratifiedShuffleSplit)
+from sklearn.neighbors import KernelDensity
+from sklearn.pipeline import Pipeline
+from sklearn.svm import SVC, LinearSVC
+from sklearn.tree import DecisionTreeClassifier, DecisionTreeRegressor
+
+from dask_ml_tpu.model_selection import GridSearchCV, RandomizedSearchCV
+from dask_ml_tpu.model_selection.utils_test import (CheckingClassifier,
+                                                    FailingClassifier,
+                                                    MockClassifier)
+
+# the reference suite's canonical tiny problem (test_model_selection_sklearn
+# .py:54-55): 4 points, 2 classes, linearly separable
+X_SMALL = np.array([[-1.0, -1.0], [-2.0, -1.0], [1.0, 1.0], [2.0, 1.0]])
+y_SMALL = np.array([1, 1, 2, 2])
+
+
+def _clf_data(n=100, seed=0):
+    return make_classification(n_samples=n, n_features=4, random_state=seed)
+
+
+class LinearSVCNoScore(LinearSVC):
+    """LinearSVC whose score attribute raises — the scoring-required probe
+    (reference: :44-49)."""
+
+    @property
+    def score(self):
+        raise AttributeError
+
+
+# ---------------------------------------------------------------------------
+# basics: iteration protocol, scorer selection, refit semantics
+# ---------------------------------------------------------------------------
+
+
+def test_basic_grid_search():
+    """reference: :65-88 — fit over 3 C values, one best, results iterable
+    and indexable consistently."""
+    clf = LinearSVC(random_state=0)
+    grid = {"C": [0.2, 1.0, 10.0]}
+    search = GridSearchCV(clf, grid, cv=2)
+    search.fit(X_SMALL, y_SMALL)
+    assert len(search.cv_results_["params"]) == 3
+    assert search.best_index_ in range(3)
+    assert sorted(p["C"] for p in search.cv_results_["params"]) == [
+        0.2, 1.0, 10.0]
+    # a second fit with a different grid replaces the results
+    search2 = GridSearchCV(clf, {"C": [1.0]}, cv=2).fit(X_SMALL, y_SMALL)
+    assert len(search2.cv_results_["params"]) == 1
+
+
+@pytest.mark.parametrize("cls,extra", [
+    (GridSearchCV, {"param_grid": {"foo_param": [1, 2, 3]}}),
+    (RandomizedSearchCV, {"param_distributions": {"foo_param": [1, 2, 3]},
+                          "n_iter": 3, "random_state": 0}),
+])
+def test_fit_params_routed_to_estimator(cls, extra):
+    """reference: :91-108 — fit params reach every fit; array-likes aligned
+    with the sample axis are sliced per split."""
+    X, y = _clf_data(30)
+    clf = CheckingClassifier(
+        expected_fit_params=["spam", "eggs"],
+    )
+    search = cls(clf, cv=2, **extra)
+    search.fit(X, y, spam=np.ones(30), eggs=np.zeros(30))
+    assert len(search.cv_results_["params"]) == 3
+
+
+def test_scoring_required_without_score_method():
+    """reference: :111-141 — estimator without .score: scoring= is
+    mandatory; providing one works end to end."""
+    X, y = _clf_data(60)
+    clf = LinearSVCNoScore(random_state=0)
+    with pytest.raises(TypeError, match="no score"):
+        GridSearchCV(clf, {"C": [0.1, 1.0]}, cv=2).fit(X, y)
+
+    def scorer(est, Xs, ys):
+        return float(np.mean(est.predict(Xs) == ys))
+
+    gs = GridSearchCV(clf, {"C": [0.1, 1.0]}, cv=2, scoring=scorer)
+    gs.fit(X, y)
+    assert hasattr(gs, "best_params_")
+
+
+def test_score_method_uses_requested_scorer():
+    """reference: :144-169 — scoring='roc_auc' changes both cv scores and
+    the post-fit .score() relative to the default accuracy."""
+    X, y = make_classification(n_samples=100, n_classes=2, flip_y=0.3,
+                               random_state=0)
+    clf = LinearSVC(random_state=0)
+    g_acc = GridSearchCV(clf, {"C": [0.1, 1.0]}, cv=3,
+                         scoring="accuracy").fit(X, y)
+    auc_scorer = make_scorer(roc_auc_score, response_method="decision_function")
+    g_auc = GridSearchCV(clf, {"C": [0.1, 1.0]}, cv=3,
+                         scoring=auc_scorer).fit(X, y)
+    # both fitted; the scores differ because the metrics differ
+    assert not np.allclose(g_acc.cv_results_["mean_test_score"],
+                           g_auc.cv_results_["mean_test_score"])
+    assert g_acc.score(X, y) != pytest.approx(g_auc.score(X, y), abs=1e-12)
+
+
+@pytest.mark.parametrize("cv_cls,needs_groups", [
+    (GroupKFold(n_splits=3), True),
+    (LeaveOneGroupOut(), True),
+    (LeavePGroupsOut(n_groups=2), True),
+    (GroupShuffleSplit(n_splits=3, random_state=0), True),
+    (StratifiedKFold(n_splits=3), False),
+    (StratifiedShuffleSplit(n_splits=3, random_state=0), False),
+])
+def test_group_cvs_route_groups(cv_cls, needs_groups):
+    """reference: :172-200 — group CV splitters require groups= and run
+    when given; non-group splitters ignore it."""
+    X, y = make_classification(n_samples=30, random_state=0)
+    groups = np.tile(np.arange(6), 5)
+    gs = GridSearchCV(LinearSVC(random_state=0), {"C": [1.0]}, cv=cv_cls)
+    if needs_groups:
+        with pytest.raises((ValueError, TypeError)):
+            gs.fit(X, y)
+    gs.fit(X, y, groups=groups)
+    assert hasattr(gs, "cv_results_")
+
+
+def test_classes_property():
+    """reference: :236-260 — classes_ delegates to the refit best
+    estimator; absent before fit, after refit=False, and for regressors."""
+    X, y = _clf_data(60)
+    gs = GridSearchCV(LinearSVC(random_state=0), {"C": [0.1, 1.0]}, cv=2)
+    with pytest.raises(AttributeError):
+        gs.classes_
+    gs.fit(X, y)
+    np.testing.assert_array_equal(gs.classes_, np.unique(y))
+
+    no_refit = GridSearchCV(LinearSVC(random_state=0), {"C": [0.1, 1.0]},
+                            cv=2, refit=False).fit(X, y)
+    with pytest.raises(AttributeError):
+        no_refit.classes_
+
+    reg = GridSearchCV(DecisionTreeRegressor(), {"max_depth": [1, 2]},
+                       cv=2).fit(X, y)
+    assert not hasattr(reg.best_estimator_, "classes_")
+
+
+def test_trivial_cv_results_and_no_refit():
+    """reference: :263-293 — a one-point grid still populates cv_results_;
+    refit=False keeps best_params_/best_index_ but blocks predict/etc."""
+    X, y = _clf_data(60)
+    gs = GridSearchCV(MockClassifier(), {"foo_param": [1]}, cv=3).fit(X, y)
+    assert "mean_test_score" in gs.cv_results_
+
+    gs = GridSearchCV(MockClassifier(), {"foo_param": [1, 2, 3]}, cv=3,
+                      refit=False).fit(X, y)
+    assert gs.best_params_ == {"foo_param": 2} or "foo_param" in gs.best_params_
+    assert isinstance(gs.best_index_, int)
+    for meth in ("predict", "predict_proba", "transform"):
+        with pytest.raises(AttributeError, match="refit=False"):
+            getattr(gs, meth)(X)
+
+
+def test_no_refit_multiple_metrics():
+    """reference: :296-312 — multimetric + refit=False exposes per-metric
+    result columns without best_* selection."""
+    X, y = _clf_data(60)
+    gs = GridSearchCV(DecisionTreeClassifier(),
+                      {"max_depth": [1, 2]}, cv=2, refit=False,
+                      scoring=["accuracy", "precision"]).fit(X, y)
+    for metric in ("accuracy", "precision"):
+        assert f"mean_test_{metric}" in gs.cv_results_
+        assert f"rank_test_{metric}" in gs.cv_results_
+    assert not hasattr(gs, "best_score_")
+
+
+def test_grid_search_error_on_mismatched_lengths():
+    """reference: :315-322 — X/y length mismatch raises."""
+    X, y = _clf_data(60)
+    gs = GridSearchCV(LinearSVC(random_state=0), {"C": [1.0]}, cv=2)
+    with pytest.raises(ValueError):
+        gs.fit(X[:40], y)
+
+
+def test_one_grid_point_matches_direct_fit():
+    """reference: :325-336 — a single-point grid's refit estimator equals a
+    direct fit with those params."""
+    X, y = _clf_data(80)
+    gs = GridSearchCV(SVC(gamma=0.1), {"C": [2.0]}, cv=3).fit(X, y)
+    direct = SVC(C=2.0, gamma=0.1).fit(X, y)
+    np.testing.assert_allclose(gs.best_estimator_.dual_coef_,
+                               direct.dual_coef_, atol=1e-8)
+
+
+def test_bad_param_grid_rejected():
+    """reference: :339-367 — scalar / non-iterable / string grid values are
+    rejected by ParameterGrid."""
+    for bad in ({"C": 1.0}, {"C": "a-string"}):
+        with pytest.raises((ValueError, TypeError)):
+            GridSearchCV(LinearSVC(), bad, cv=2).fit(X_SMALL, y_SMALL)
+
+
+# ---------------------------------------------------------------------------
+# input formats: sparse, precomputed kernels, nd, lists, pandas
+# ---------------------------------------------------------------------------
+
+
+def test_sparse_X_end_to_end():
+    """reference: :370-388 — fitting on dense then predicting the same
+    search fit on sparse X gives the same labels and best C."""
+    X, y = make_classification(n_samples=200, n_features=20, random_state=0)
+    dense = GridSearchCV(LinearSVC(random_state=0), {"C": [0.1, 1.0]},
+                         cv=2).fit(X, y)
+    Xs = sp.csr_matrix(X)
+    sparse = GridSearchCV(LinearSVC(random_state=0), {"C": [0.1, 1.0]},
+                          cv=2).fit(Xs, y)
+    np.testing.assert_array_equal(dense.predict(X), sparse.predict(Xs))
+    assert dense.best_params_ == sparse.best_params_
+
+
+def test_sparse_X_with_custom_scorer():
+    """reference: :391-423 — a custom scorer sees the sparse slices."""
+    X, y = make_classification(n_samples=200, n_features=20, random_state=0)
+    Xs = sp.csr_matrix(X)
+    seen = []
+
+    def scorer(est, Xv, yv):
+        seen.append(sp.issparse(Xv))
+        return f1_score(yv, est.predict(Xv))
+
+    gs = GridSearchCV(LinearSVC(random_state=0), {"C": [0.1, 1.0]}, cv=2,
+                      scoring=scorer, refit=False,
+                      return_train_score=False).fit(Xs, y)
+    assert all(seen) and len(seen) == 4  # 2 candidates x 2 splits, test only
+    assert np.all(np.asarray(gs.cv_results_["mean_test_score"]) > 0.5)
+
+
+def test_precomputed_kernel_search():
+    """reference: :426-452 — a precomputed square kernel is sliced on BOTH
+    axes per split and reproduces the linear-kernel search."""
+    X, y = make_classification(n_samples=120, n_features=10, random_state=0)
+    K = X @ X.T
+    gs_k = GridSearchCV(SVC(kernel="precomputed"), {"C": [0.1, 1.0]},
+                        cv=3).fit(K, y)
+    gs_lin = GridSearchCV(SVC(kernel="linear"), {"C": [0.1, 1.0]},
+                          cv=3).fit(X, y)
+    np.testing.assert_allclose(gs_k.cv_results_["mean_test_score"],
+                               gs_lin.cv_results_["mean_test_score"],
+                               atol=1e-10)
+
+
+def test_precomputed_kernel_nonsquare_rejected():
+    """reference: :455-463."""
+    K = np.zeros((10, 4))
+    gs = GridSearchCV(SVC(kernel="precomputed"), {"C": [1.0]}, cv=2)
+    with pytest.raises(ValueError, match="square"):
+        gs.fit(K, np.arange(10) % 2)
+
+
+def test_nd_X_through_checking_classifier():
+    """reference: :493-513 — >2-D X flows through untouched when the
+    estimator accepts it."""
+    X4 = np.arange(40 * 5 * 3 * 2, dtype=float).reshape(40, 5, 3, 2)
+    y = np.arange(40) % 2
+
+    def check(Xv):
+        return Xv.shape[1:] == (5, 3, 2)
+
+    clf = CheckingClassifier(check_X=check)
+    GridSearchCV(clf, {"foo_param": [1, 2]}, cv=2).fit(X4, y)
+
+
+def test_X_and_y_as_lists():
+    """reference: :504-526."""
+    X, y = _clf_data(30)
+    gs = GridSearchCV(MockClassifier(), {"foo_param": [1, 2]}, cv=3)
+    gs.fit(X.tolist(), y.tolist())
+    assert hasattr(gs, "cv_results_")
+
+
+def test_pandas_input():
+    """reference: :529-552 — DataFrame X / Series y slice positionally."""
+    pd = pytest.importorskip("pandas")
+    X, y = _clf_data(60)
+    df = pd.DataFrame(X, index=np.arange(100, 160))  # non-default index
+    ys = pd.Series(y, index=df.index)
+    gs = GridSearchCV(LinearSVC(random_state=0), {"C": [0.1, 1.0]}, cv=2)
+    gs.fit(df, ys)
+    assert hasattr(gs, "best_params_")
+
+
+def test_unsupervised_search():
+    """reference: :555-568 — unsupervised estimator scored by its own score
+    or a supervised metric against given y."""
+    X, true_labels = make_blobs(n_samples=50, random_state=0)
+    km = SKKMeans(random_state=0, n_init=1)
+    gs = GridSearchCV(km, {"n_clusters": [2, 3, 4]},
+                      scoring="fowlkes_mallows_score", cv=2)
+    gs.fit(X, true_labels)
+    assert gs.best_params_["n_clusters"] == 3
+    gs2 = GridSearchCV(km, {"n_clusters": [2, 3, 4]}, cv=2).fit(X)
+    assert hasattr(gs2, "best_params_")
+
+
+def test_search_no_predict():
+    """reference: :571-603 — estimator with only fit (KernelDensity) works
+    with a custom scoring callable; delegation then fails cleanly."""
+    X = make_blobs(n_samples=60, random_state=0)[0]
+
+    def scoring(est, Xv, yv=None):
+        return float(est.score(Xv))
+
+    gs = GridSearchCV(KernelDensity(),
+                      {"bandwidth": [0.1, 1.0, 10.0]},
+                      scoring=scoring, cv=2).fit(X)
+    assert gs.best_params_["bandwidth"] in (0.1, 1.0, 10.0)
+    with pytest.raises(AttributeError):
+        gs.predict(X)
+
+
+# ---------------------------------------------------------------------------
+# cv_results_ structure
+# ---------------------------------------------------------------------------
+
+
+def _check_cv_results_shape(results, n_cand, n_splits, extra_keys=()):
+    keys = {"params", "mean_test_score", "std_test_score",
+            "rank_test_score", "mean_fit_time", "std_fit_time",
+            "mean_score_time", "std_score_time"} | set(extra_keys)
+    for si in range(n_splits):
+        keys.add(f"split{si}_test_score")
+    assert keys <= set(results)
+    for k in keys:
+        assert len(results[k]) == n_cand, k
+    assert results["rank_test_score"].dtype == np.int32 or \
+        results["rank_test_score"].dtype == np.int64
+
+
+def test_grid_search_cv_results_structure():
+    """reference: :606-658 — full key set, per-candidate lengths, masked
+    param arrays with fill for absent keys."""
+    X, y = _clf_data(80)
+    grid = [{"kernel": ["rbf"], "C": [1, 10], "gamma": [0.1, 1.0]},
+            {"kernel": ["poly"], "degree": [1, 2]}]
+    gs = GridSearchCV(SVC(), grid, cv=3).fit(X, y)
+    n_cand = 4 + 2
+    _check_cv_results_shape(
+        gs.cv_results_, n_cand, 3,
+        extra_keys={"param_C", "param_kernel", "param_gamma", "param_degree",
+                    "mean_train_score", "std_train_score"})
+    # absent params are MASKED for the other subgrid's candidates
+    degree = gs.cv_results_["param_degree"]
+    kernel = np.asarray(
+        [p["kernel"] for p in gs.cv_results_["params"]])
+    assert np.ma.isMaskedArray(degree)
+    assert degree.mask[kernel == "rbf"].all()
+    assert not degree.mask[kernel == "poly"].any()
+
+
+def test_random_search_cv_results_structure():
+    """reference: :661-704 — same contract under sampled candidates."""
+    X, y = _clf_data(80)
+    n_iter = 5
+    rs = RandomizedSearchCV(
+        SVC(), {"C": np.logspace(-2, 2, 10), "gamma": np.logspace(-2, 2, 10)},
+        n_iter=n_iter, cv=3, random_state=0).fit(X, y)
+    _check_cv_results_shape(rs.cv_results_, n_iter, 3,
+                            extra_keys={"param_C", "param_gamma"})
+    assert len(rs.cv_results_["params"]) == n_iter
+
+
+def test_iid_weighting():
+    """reference: :707-800 — iid=True weights split scores by test size;
+    iid=False is the unweighted mean. An unequal split makes them differ."""
+    X, y = _clf_data(70)
+    cv = KFold(n_splits=3)  # 70 -> 24/23/23: unequal test sizes
+
+    class SplitScorer(BaseEstimator, ClassifierMixin):
+        def fit(self, Xv, yv=None):
+            self.n_ = len(Xv)
+            return self
+
+        def score(self, Xv, yv=None):
+            return float(len(Xv))  # score == test-set size
+
+    g_iid = GridSearchCV(SplitScorer(), {}, cv=cv, iid=True,
+                         refit=False).fit(X, y)
+    g_flat = GridSearchCV(SplitScorer(), {}, cv=cv, iid=False,
+                          refit=False).fit(X, y)
+    sizes = np.array([24.0, 23.0, 23.0])
+    assert g_flat.cv_results_["mean_test_score"][0] == pytest.approx(
+        sizes.mean())
+    assert g_iid.cv_results_["mean_test_score"][0] == pytest.approx(
+        np.average(sizes, weights=sizes))
+
+
+def test_rank_tie_breaking():
+    """reference: :803-837 — equal mean scores share the minimum rank."""
+    X, y = _clf_data(40)
+
+    class FixedScore(BaseEstimator):
+        def __init__(self, s=0.0):
+            self.s = s
+
+        def fit(self, Xv, yv=None):
+            return self
+
+        def score(self, Xv, yv=None):
+            return {0: 0.5, 1: 0.5, 2: 0.9}[self.s]
+
+    gs = GridSearchCV(FixedScore(), {"s": [0, 1, 2]}, cv=2, iid=False,
+                      refit=False).fit(X, y)
+    np.testing.assert_array_equal(gs.cv_results_["rank_test_score"],
+                                  [2, 2, 1])
+
+
+def test_cv_results_none_param_masked():
+    """reference: :840-849 — None as a candidate value appears unmasked in
+    the param column."""
+    X, y = _clf_data(30)
+
+    class TakesNone(BaseEstimator):
+        def __init__(self, p=1):
+            self.p = p
+
+        def fit(self, Xv, yv=None):
+            return self
+
+        def score(self, Xv, yv=None):
+            return 1.0 if self.p is None else 0.5
+
+    gs = GridSearchCV(TakesNone(), {"p": [None, 2]}, cv=2,
+                      refit=False).fit(X, y)
+    col = gs.cv_results_["param_p"]
+    assert col[0] is None or col.data[0] is None
+    assert gs.best_params_ == {"p": None}
+
+
+def test_correct_score_results_vs_manual_cv():
+    """reference: :852-889 — per-split scores equal a hand-rolled fit/score
+    over the same KFold."""
+    X, y = _clf_data(90)
+    cv = KFold(n_splits=3)
+    Cs = [0.1, 1.0, 10.0]
+    gs = GridSearchCV(LinearSVC(random_state=0), {"C": Cs}, cv=cv,
+                      refit=False).fit(X, y)
+    for ci, C in enumerate(Cs):
+        for si, (tr, te) in enumerate(cv.split(X, y)):
+            expected = LinearSVC(random_state=0, C=C).fit(
+                X[tr], y[tr]).score(X[te], y[te])
+            got = gs.cv_results_[f"split{si}_test_score"][ci]
+            assert got == pytest.approx(expected, abs=1e-12)
+
+
+def test_pickle_fitted_search():
+    """reference: :892-906 — fitted Grid/Randomized searches pickle and
+    keep predicting identically."""
+    X, y = _clf_data(60)
+    for search in (
+        GridSearchCV(MockClassifier(), {"foo_param": [1, 2, 3]}, cv=3),
+        RandomizedSearchCV(MockClassifier(), {"foo_param": [1, 2, 3]},
+                           cv=3, n_iter=3, random_state=0),
+    ):
+        search.fit(X, y)
+        restored = pickle.loads(pickle.dumps(search))
+        np.testing.assert_array_equal(search.predict(X), restored.predict(X))
+
+
+def test_multioutput_data():
+    """reference: :909-951 — multilabel y through trees and KNN-style
+    estimators, grid and randomized."""
+    X, y = make_multilabel_classification(n_samples=60, random_state=0)
+    est = DecisionTreeClassifier(random_state=0)
+    gs = GridSearchCV(est, {"max_depth": [1, 2]}, cv=2).fit(X, y)
+    assert gs.predict(X).shape == y.shape
+    reg = DecisionTreeRegressor(random_state=0)
+    y_reg = np.stack([X[:, 0], X[:, 1]], axis=1)
+    rs = RandomizedSearchCV(reg, {"max_depth": [1, 2, 3]}, cv=2, n_iter=2,
+                            random_state=0).fit(X, y_reg)
+    assert rs.predict(X).shape == y_reg.shape
+
+
+def test_predict_proba_disabled():
+    """reference: :954-960 — SVC(probability=False) through refit: the
+    search exposes no predict_proba."""
+    X, y = _clf_data(40)
+    gs = GridSearchCV(SVC(probability=False), {"C": [1.0]}, cv=2).fit(X, y)
+    with pytest.raises(AttributeError):
+        gs.predict_proba(X)
+
+
+def test_search_allows_nans_with_imputer():
+    """reference: :963-973 — NaN rows survive when the pipeline imputes."""
+    from sklearn.impute import SimpleImputer
+
+    X = 10 + np.random.RandomState(0).randn(60, 5)
+    X[2, 1] = np.nan
+    y = (X[:, 0] > 10).astype(int)
+    pipe = Pipeline([("imp", SimpleImputer(strategy="mean")),
+                     ("clf", MockClassifier())])
+    GridSearchCV(pipe, {"clf__foo_param": [1, 2]}, cv=2).fit(X, y)
+
+
+def test_failing_classifier_error_score():
+    """reference: :976-1023 — FailingClassifier inside the grid: numeric
+    error_score fills its cells and warns; 'raise' raises."""
+    X, y = _clf_data(30)
+    clf = FailingClassifier()
+    grid = {"parameter": [FailingClassifier.FAILING_PARAMETER, 0, 1]}
+    with pytest.warns(FitFailedWarning):
+        gs = GridSearchCV(clf, grid, cv=2, error_score=-1.0,
+                          refit=False).fit(X, y)
+    res = gs.cv_results_
+    fail_idx = [i for i, p in enumerate(res["params"])
+                if p["parameter"] == FailingClassifier.FAILING_PARAMETER]
+    ok_idx = [i for i in range(3) if i not in fail_idx]
+    assert np.all(np.asarray(res["mean_test_score"])[fail_idx] == -1.0)
+    # non-failing candidates scored normally (FailingClassifier scores 0.0)
+    assert np.all(np.asarray(res["mean_test_score"])[ok_idx] == 0.0)
+
+    with pytest.raises(ValueError, match="Failing classifier"):
+        GridSearchCV(clf, grid, cv=2, error_score="raise",
+                     refit=False).fit(X, y)
+
+
+def test_train_scores_toggle():
+    """reference: :1026-1036 — return_train_score=False drops the train
+    columns; True includes them."""
+    X, y = _clf_data(40)
+    on = GridSearchCV(MockClassifier(), {"foo_param": [1, 2]}, cv=2,
+                      return_train_score=True, refit=False).fit(X, y)
+    assert "mean_train_score" in on.cv_results_
+    off = GridSearchCV(MockClassifier(), {"foo_param": [1, 2]}, cv=2,
+                       return_train_score=False, refit=False).fit(X, y)
+    assert not any(k.endswith("train_score") for k in off.cv_results_)
+
+
+def test_multiple_metrics_with_refit_metric():
+    """reference: :1039-1064 — dict scoring + refit by name selects best_*
+    by that metric and exposes both column families."""
+    X, y = _clf_data(80)
+    scoring = {"acc": "accuracy", "prec": "precision"}
+    gs = GridSearchCV(DecisionTreeClassifier(random_state=0),
+                      {"max_depth": [1, 2, 4]}, cv=3, scoring=scoring,
+                      refit="acc").fit(X, y)
+    for m in ("acc", "prec"):
+        assert f"mean_test_{m}" in gs.cv_results_
+    assert gs.best_index_ == int(np.argmin(gs.cv_results_["rank_test_acc"]))
+    assert hasattr(gs, "best_estimator_")
+    # refit must name a metric for multimetric scoring
+    with pytest.raises(ValueError, match="refit"):
+        GridSearchCV(DecisionTreeClassifier(), {"max_depth": [1]}, cv=2,
+                     scoring=scoring, refit=True).fit(X, y)

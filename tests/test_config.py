@@ -22,7 +22,26 @@ def _clean_config():
 
 def test_defaults():
     cfg = get_config()
-    assert cfg == {"dtype": None, "mesh": None}
+    assert cfg == {"dtype": None, "mesh": None, "device_outputs": False}
+
+
+def test_device_outputs_scopes_transform_results():
+    """device_outputs=True keeps transform outputs on device (no host
+    materialization); the default returns numpy — and np.asarray of a
+    device result still works."""
+    import jax
+    import numpy as np
+
+    from dask_ml_tpu.config import config_context
+    from dask_ml_tpu.preprocessing import StandardScaler
+
+    X = np.random.RandomState(0).randn(32, 3).astype(np.float32)
+    sc = StandardScaler().fit(X)
+    assert isinstance(sc.transform(X), np.ndarray)
+    with config_context(device_outputs=True):
+        out = StandardScaler().fit(X).transform(X)
+    assert isinstance(out, jax.Array)
+    np.testing.assert_allclose(np.asarray(out), sc.transform(X), atol=1e-6)
 
 
 def test_set_config_is_process_wide():
