@@ -200,7 +200,25 @@ class KMeans(TransformerMixin, BaseEstimator):
         TRUSTED device-array inputs (CV slices scanned at upload, chain
         intermediates from validated input — see ``StagingMemo.trust``)
         skip the NaN-scan sync inside ``check_array``; untrusted input is
-        validated as anywhere else."""
+        validated as anywhere else.
+
+        Returns ``NotImplemented`` when the trajectory history the program
+        would materialize (unique_ks × max_iter × max_k × d) exceeds a
+        sane HBM budget — e.g. the estimator's default ``max_iter=300``
+        with wide data — and the driver then runs the group per-cell,
+        whose ``while_loop`` stops at convergence without storing
+        history."""
+        ks = {int(m.get("n_clusters", self.n_clusters)) for m in members}
+        hist_bytes = (len(ks) * int(self.max_iter) * max(ks)
+                      * int(X.shape[1]) * 4)
+        # decline BEFORE validating/staging anything (the whole point is to
+        # bail out): on memory (history buffer) or scan length — the
+        # batched program runs a fixed-length scan of max_iter steps
+        # (frozen steps are cheap but not free), while the per-cell
+        # while_loop stops at convergence, so an extreme max_iter is
+        # better served per-cell
+        if hist_bytes > 512 * 1024 * 1024 or int(self.max_iter) > 4096:
+            return NotImplemented
         data = prepare_data(check_array(X))
         evals = [prepare_data(check_array(E)) for E in eval_sets]
         key = check_random_state(self.random_state)

@@ -45,7 +45,7 @@ _DEFAULTS: dict[str, Any] = {
 }
 
 
-def maybe_host(x):
+def maybe_host(x, trusted: bool = True):
     """Return ``x`` as host numpy unless ``device_outputs`` is enabled.
 
     The one call every estimator's transform/predict tail goes through:
@@ -56,13 +56,19 @@ def maybe_host(x):
     staging scope (they derive from inputs the producing estimator already
     validated), so the next stage's ``check_array`` can skip the NaN-scan
     sync without weakening validation of genuinely user-supplied arrays.
+
+    ``trusted=False`` is for producers that can MANUFACTURE non-finite
+    values from finite input (e.g. PCA whitening divides by a variance
+    that may be zero): their outputs keep the downstream NaN scan so the
+    search's error semantics match the host path.
     """
     if get_config()["device_outputs"]:
-        from dask_ml_tpu.parallel.sharding import _current_memo
+        if trusted:
+            from dask_ml_tpu.parallel.sharding import _current_memo
 
-        memo = _current_memo()
-        if memo is not None:
-            memo.trust(x)
+            memo = _current_memo()
+            if memo is not None:
+                memo.trust(x)
         return x
     import numpy as np
 

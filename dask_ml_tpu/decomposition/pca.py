@@ -244,7 +244,10 @@ class PCA(BaseEstimator, TransformerMixin):
         if self.whiten:
             out = out / jnp.sqrt(jnp.asarray(
                 self.explained_variance_, out.dtype))
-        return maybe_host(unpad_rows(out, n))
+        # whitening divides by a variance that can be zero: the output can
+        # be non-finite for FINITE input, so it must keep the downstream
+        # NaN scan (trusted=False) — host-path error semantics preserved
+        return maybe_host(unpad_rows(out, n), trusted=not self.whiten)
 
     def inverse_transform(self, X):
         X = check_array(X)

@@ -1,0 +1,106 @@
+"""PCA over data larger than device memory: streamed covariance accumulation.
+
+The blueprint's PCA config is 1e7×1000 — 40 GB of f32, over a single chip's
+HBM (VERDICT r3 #3), and the reference's answer (dask chunks spilling to
+cluster RAM) has no single-chip analogue. The TPU-native answer for
+tall-skinny PCA: one ``lax.scan`` over row blocks accumulating the O(d²)
+sufficient statistics (weighted count, column sums, Gram matrix — 4 MB at
+d=1000), then an eigendecomposition of the d×d covariance. One pass over
+the data, peak HBM = one block + the Gram, exact covariance PCA.
+
+``block_fn(b) -> (X_b, w_b)`` is traced inside the scan: it can regenerate
+blocks from a seed (nothing ever resident), pull host-pinned rows via
+``jax.pure_callback``, or slice a resident array (tests). Numerical note:
+the Gram squares the condition number, so tiny trailing eigenvalues carry
+~cond²·eps relative error — the same regime where the in-memory exact path
+falls back to Householder. For the top-k components of tall-skinny data
+(the PCA use case) f32 Gram accumulation matches the in-memory solver to
+test tolerance.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["streamed_moments", "pca_fit_blocks"]
+
+
+@partial(jax.jit, static_argnames=("block_fn", "n_blocks"))
+def streamed_moments(*, block_fn, n_blocks):
+    """One scan over all blocks → ``(sw, sums, gram)``:
+    Σw, Σ w·x (d,), Σ w·xxᵀ (d, d) — f32 accumulation."""
+
+    def body(carry, b):
+        sw, s, G = carry
+        X_b, w_b = block_fn(b)
+        Xw = X_b * w_b[:, None]
+        sw = sw + jnp.sum(w_b)
+        s = s + jnp.sum(Xw, axis=0)
+        G = G + jax.lax.dot_general(
+            Xw, X_b, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return (sw, s, G), None
+
+    shapes = jax.eval_shape(block_fn, jnp.asarray(0, jnp.int32))
+    d = shapes[0].shape[1]
+    init = (jnp.asarray(0.0, jnp.float32), jnp.zeros((d,), jnp.float32),
+            jnp.zeros((d, d), jnp.float32))
+    (sw, s, G), _ = jax.lax.scan(
+        body, init, jnp.arange(n_blocks, dtype=jnp.int32))
+    return sw, s, G
+
+
+@jax.jit
+def _pca_from_moments(sw, s, G):
+    mean = s / jnp.maximum(sw, 1.0)
+    denom = jnp.maximum(sw - 1.0, 1.0)
+    cov = (G - sw * jnp.outer(mean, mean)) / denom
+    evals, evecs = jnp.linalg.eigh(cov)  # ascending
+    evals = evals[::-1]
+    comps = evecs[:, ::-1].T  # (d, d) rows = components, descending
+    # deterministic signs (the svd_flip convention): the max-|coeff| entry
+    # of every component is positive
+    idx = jnp.argmax(jnp.abs(comps), axis=1)
+    signs = jnp.sign(comps[jnp.arange(comps.shape[0]), idx])
+    comps = comps * jnp.where(signs == 0, 1.0, signs)[:, None]
+    return mean, jnp.maximum(evals, 0.0), comps
+
+
+def pca_fit_blocks(block_fn, n_blocks, n_components, pca=None):
+    """Fit a :class:`dask_ml_tpu.decomposition.PCA` from streamed blocks.
+
+    Returns a fitted PCA estimator (components_, explained_variance_ and
+    friends populated from the streamed covariance), usable for
+    ``transform``/``inverse_transform`` exactly like an in-memory fit.
+    ``pca`` optionally supplies a pre-configured estimator to fill in.
+    """
+    from dask_ml_tpu.decomposition import PCA
+
+    sw, s, G = streamed_moments(block_fn=block_fn, n_blocks=int(n_blocks))
+    mean, evals, comps = _pca_from_moments(sw, s, G)
+    mean, evals, comps, sw = jax.device_get((mean, evals, comps, sw))
+
+    n = int(round(float(sw)))
+    d = comps.shape[1]
+    k = int(n_components)
+    est = pca if pca is not None else PCA(n_components=k)
+    est.n_components_ = k
+    est.n_samples_ = n
+    est.n_features_ = d
+    est.mean_ = np.asarray(mean)
+    est.components_ = np.asarray(comps[:k])
+    est.explained_variance_ = np.asarray(evals[:k])
+    total_var = float(evals.sum())
+    est.explained_variance_ratio_ = est.explained_variance_ / max(
+        total_var, np.finfo(np.float32).tiny)
+    est.singular_values_ = np.sqrt(
+        np.maximum(est.explained_variance_ * max(n - 1, 1), 0.0))
+    if k < min(n, d):
+        est.noise_variance_ = float(evals[k:].mean())
+    else:
+        est.noise_variance_ = 0.0
+    return est

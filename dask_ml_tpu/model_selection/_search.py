@@ -790,6 +790,10 @@ class _CandidateRunner:
                     raise
                 methods.warn_fit_failure(self.error_score, e)
                 return None  # whole-group failure
+            if out is NotImplemented:
+                # the estimator declined at runtime (e.g. the program's
+                # memory footprint): members run per-cell instead
+                return NotImplemented
             return out, default_timer() - t0
 
         result = self.memo.get_or_run(
@@ -803,6 +807,10 @@ class _CandidateRunner:
         """One cell through its batch group. Same result contract as
         :meth:`run`; the group fit+score executes once per (group, split)."""
         result, t_prefix = self.batched_group_out(params, split_idx, group)
+        if result is NotImplemented:
+            # runtime decline by the estimator: the per-cell path still
+            # shares prefix fits through the same memo tokens
+            return self.run(params, split_idx)
         if result is self._PREFIX_FAILED or result is None:
             test, train, score_time = methods.score(
                 FIT_FAILURE, None, None,

@@ -439,10 +439,18 @@ def _batched_cells_impl(X, w, uk_arr, member_uk, tol_arr, key, eval_Xs,
     var = (w[:, None] * (X - mean) ** 2).sum(0) / sw
     tol_arr = tol_arr * var.mean()
 
-    def one_k(k):
+    # freeze threshold per unique k: once a trajectory's shift drops under
+    # the SMALLEST tol of any member with that k, every member's stopping
+    # index is already determined — later iterations skip the data passes
+    # (lax.cond) instead of recomputing identical centers
+    U = uk_arr.shape[0]
+    min_tol_uk = jnp.full((U,), jnp.inf, jnp.float32)
+    min_tol_uk = min_tol_uk.at[member_uk].min(tol_arr)
+
+    def one_k(k, min_tol):
         valid = (kiota < k)  # (max_k,)
 
-        def step(centers, _):
+        def lloyd(centers):
             c2 = jnp.sum(centers * centers, axis=1)
             prod = jax.lax.dot_general(
                 X, centers.astype(X.dtype), (((1,), (1,)), ((), ())),
@@ -464,13 +472,35 @@ def _batched_cells_impl(X, w, uk_arr, member_uk, tol_arr, key, eval_Xs,
                 jnp.where(valid[:, None], (new_centers - centers) ** 2, 0.0))
             mind = jnp.maximum(jnp.min(scores, axis=1) + x2, 0.0)
             inertia = jnp.sum(mind * w)
-            return new_centers, (new_centers, shift, inertia)
+            return new_centers, shift, inertia
 
+        def step(carry, _):
+            centers, frozen, shift_p, inertia_p = carry
+            new_centers, shift, inertia = jax.lax.cond(
+                frozen,
+                lambda c: (c, shift_p, inertia_p),  # no data pass
+                lloyd,
+                centers,
+            )
+            frozen = jnp.logical_or(frozen, shift < min_tol)
+            return ((new_centers, frozen, shift, inertia),
+                    (new_centers, shift, inertia))
+
+        carry0 = (centers0, jnp.asarray(False),
+                  jnp.asarray(jnp.inf, jnp.float32),
+                  jnp.asarray(jnp.inf, jnp.float32))
         _, (hist, shifts, inertias) = jax.lax.scan(
-            step, centers0, None, length=max_iter)
+            step, carry0, None, length=max_iter)
         return hist, shifts, inertias  # (T,max_k,d), (T,), (T,)
 
-    hist, shifts, inertias = jax.vmap(one_k)(uk_arr)  # (U,T,...)
+    # lax.map, NOT vmap: under vmap the freeze `lax.cond` would lower to a
+    # select that executes BOTH branches for every lane — the data passes
+    # would never be skipped. map keeps the predicate scalar per trajectory
+    # so converged trajectories genuinely stop paying for Lloyd steps; each
+    # trajectory's matmuls saturate the chip on their own, so sequential
+    # unique-k processing costs no real parallelism.
+    hist, shifts, inertias = jax.lax.map(
+        lambda args: one_k(*args), (uk_arr, min_tol_uk))  # (U,T,...)
 
     # per-member stopping: first t with shift < tol, else T-1 (same rule as
     # lloyd_loop's `shift >= tol` while-condition, reference

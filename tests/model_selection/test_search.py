@@ -893,3 +893,30 @@ def test_nan_input_device_native_pipeline_raises_like_sklearn():
         with pytest.raises(ValueError, match="NaN"):
             GridSearchCV(_km_pipe(), grid, cv=2, refit=False, n_jobs=1,
                          error_score=error_score).fit(X)
+
+
+def test_batched_runtime_decline_falls_back_per_cell():
+    """An estimator may decline batching at runtime (NotImplemented) — e.g.
+    KMeans when the trajectory history would blow the HBM budget (huge
+    max_iter × d). The group's members then run per-cell with correct
+    results."""
+    from dask_ml_tpu.cluster import KMeans
+    from dask_ml_tpu.model_selection import GridSearchCV
+
+    X = _spectral_X(n=200, d=30)
+    # max_iter large enough that unique_ks * max_iter * max_k * d * 4 > 512MB
+    # (2 * 3e6 * 3 * 30 * 4 = 2.2 GB); the per-cell while_loop is untouched
+    # by max_iter's size — it stops at convergence
+    est = KMeans(init="random", max_iter=3_000_000, random_state=0, tol=1e-2)
+    gs = GridSearchCV(est, {"n_clusters": [2, 3], "tol": [1e-2, 1e-1]},
+                      cv=2, refit=False, n_jobs=1).fit(X)
+    scores = np.asarray(gs.cv_results_["mean_test_score"])
+    assert np.all(np.isfinite(scores))
+
+    def sc(e, X, y=None):
+        return e.score(X)
+
+    oracle = GridSearchCV(est, {"n_clusters": [2, 3], "tol": [1e-2, 1e-1]},
+                          cv=2, refit=False, n_jobs=1, scoring=sc).fit(X)
+    np.testing.assert_allclose(
+        scores, oracle.cv_results_["mean_test_score"], rtol=1e-3, atol=1e-3)

@@ -1,0 +1,164 @@
+"""Larger-than-HBM streaming tier: streamed ADMM + streamed covariance PCA.
+
+The blueprint benches run at scales over a single chip's HBM (PCA 1e7×1k =
+40 GB, ADMM 1e8×100 = 40 GB; VERDICT r3 #3); these tests pin the streamed
+solvers' MATH to the in-memory oracles at small scale — block-streamed
+consensus ADMM must take the same trajectory as the sharded solver (blocks
+⇔ shards), and streamed covariance PCA must match the in-memory fit.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dask_ml_tpu.models import glm as glm_core
+from dask_ml_tpu.parallel.sharding import prepare_data
+
+
+def _problem(n=640, d=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    beta = rng.randn(d).astype(np.float32)
+    y = (X @ beta + 0.3 * rng.randn(n) > 0).astype(np.float32)
+    return X, y
+
+
+def test_streamed_admm_matches_sharded(mesh8):
+    """8 streamed blocks == 8 mesh shards: identical consensus math."""
+    X, y = _problem()
+    n, d = X.shape
+    data = prepare_data(X, y=y, mesh=mesh8)
+    beta0 = jnp.zeros((d,), jnp.float32)
+    mask = jnp.ones((d,), jnp.float32)
+    kw = dict(family="logistic", regularizer="l2", lamduh=0.5,
+              abstol=0.0, reltol=0.0)
+
+    z_shard, _ = glm_core.admm(
+        data.X, data.y, data.weights, beta0, mask, mesh8, max_iter=8, **kw)
+
+    Xd, yd = jnp.asarray(X), jnp.asarray(y)
+    rows = n // 8
+
+    def block_fn(b):
+        Xb = jax.lax.dynamic_slice_in_dim(Xd, b * rows, rows, axis=0)
+        yb = jax.lax.dynamic_slice_in_dim(yd, b * rows, rows, axis=0)
+        return Xb, yb, jnp.ones((rows,), jnp.float32)
+
+    z_stream, n_iter = glm_core.admm_streamed(
+        block_fn, 8, d, float(n), mask, max_iter=8, **kw)
+    assert int(n_iter) == 8
+    np.testing.assert_allclose(np.asarray(z_stream), np.asarray(z_shard),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_streamed_admm_converges_and_masks_intercept():
+    """End-to-end quality + intercept exclusion through the penalty mask."""
+    X, y = _problem(n=960, d=5, seed=1)
+    n, d = X.shape
+    Xi = np.concatenate([X, np.ones((n, 1), np.float32)], axis=1)
+    Xd, yd = jnp.asarray(Xi), jnp.asarray(y)
+    rows = n // 6
+    mask = jnp.asarray([1.0] * d + [0.0], jnp.float32)
+
+    def block_fn(b):
+        Xb = jax.lax.dynamic_slice_in_dim(Xd, b * rows, rows, axis=0)
+        yb = jax.lax.dynamic_slice_in_dim(yd, b * rows, rows, axis=0)
+        return Xb, yb, jnp.ones((rows,), jnp.float32)
+
+    z, _ = glm_core.admm_streamed(
+        block_fn, 6, d + 1, float(n), mask, family="logistic",
+        regularizer="l2", lamduh=1.0, max_iter=60)
+    from sklearn.linear_model import LogisticRegression as SKLR
+
+    sk = SKLR(C=1.0, max_iter=500).fit(X, y)
+    pred = (np.asarray(Xi @ np.asarray(z)) > 0).astype(np.float32)
+    agree = np.mean(pred == sk.predict(X))
+    assert agree > 0.97, agree
+
+
+def test_streamed_admm_state_roundtrip():
+    """Chunked streamed runs thread (z, x, u) exactly like the sharded
+    solver's checkpoint contract."""
+    X, y = _problem(n=320, d=4, seed=2)
+    n, d = X.shape
+    Xd, yd = jnp.asarray(X), jnp.asarray(y)
+    rows = n // 4
+
+    def block_fn(b):
+        Xb = jax.lax.dynamic_slice_in_dim(Xd, b * rows, rows, axis=0)
+        yb = jax.lax.dynamic_slice_in_dim(yd, b * rows, rows, axis=0)
+        return Xb, yb, jnp.ones((rows,), jnp.float32)
+
+    kw = dict(family="logistic", regularizer="l1", lamduh=0.3,
+              abstol=0.0, reltol=0.0)
+    z_full, _, _, _ = glm_core.admm_streamed(
+        block_fn, 4, d, float(n), max_iter=9, return_state=True, **kw)
+
+    state = None
+    for _ in range(3):
+        z, _, state, _done = glm_core.admm_streamed(
+            block_fn, 4, d, float(n), max_iter=3, state=state,
+            return_state=True, **kw)
+    np.testing.assert_allclose(np.asarray(z), np.asarray(z_full),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_streamed_pca_matches_in_memory():
+    from dask_ml_tpu.decomposition import PCA
+    from dask_ml_tpu.decomposition.streaming import pca_fit_blocks
+
+    rng = np.random.RandomState(0)
+    n, d, k = 2000, 12, 4
+    A = rng.randn(n, 5).astype(np.float32)
+    B = rng.randn(5, d).astype(np.float32)
+    X = A @ B + 0.05 * rng.randn(n, d).astype(np.float32) + 3.0
+    Xd = jnp.asarray(X)
+    rows = n // 8
+
+    def block_fn(b):
+        Xb = jax.lax.dynamic_slice_in_dim(Xd, b * rows, rows, axis=0)
+        return Xb, jnp.ones((rows,), jnp.float32)
+
+    est = pca_fit_blocks(block_fn, 8, k)
+    oracle = PCA(n_components=k, svd_solver="tsqr").fit(X)
+
+    np.testing.assert_allclose(est.mean_, oracle.mean_, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(est.explained_variance_,
+                               oracle.explained_variance_, rtol=1e-3)
+    np.testing.assert_allclose(est.explained_variance_ratio_,
+                               oracle.explained_variance_ratio_, rtol=1e-3)
+    np.testing.assert_allclose(np.abs(est.components_),
+                               np.abs(oracle.components_), atol=2e-3)
+    np.testing.assert_allclose(est.singular_values_, oracle.singular_values_,
+                               rtol=1e-3)
+    # the streamed fit is a REAL estimator: transform round-trips
+    np.testing.assert_allclose(
+        est.transform(X[:100]), oracle.transform(X[:100]),
+        rtol=5e-2, atol=2e-2)
+
+
+def test_streamed_pca_weighted_blocks():
+    """Zero-weight rows (padding in a partial final block) drop out."""
+    from dask_ml_tpu.decomposition.streaming import pca_fit_blocks
+
+    rng = np.random.RandomState(1)
+    X = rng.randn(90, 5).astype(np.float32)
+    Xpad = np.concatenate([X, 1e6 * np.ones((6, 5), np.float32)])
+    Xd = jnp.asarray(Xpad)
+    w = jnp.asarray(np.concatenate([np.ones(90), np.zeros(6)]), jnp.float32)
+    rows = 96 // 4
+
+    def block_fn(b):
+        Xb = jax.lax.dynamic_slice_in_dim(Xd, b * rows, rows, axis=0)
+        wb = jax.lax.dynamic_slice_in_dim(w, b * rows, rows, axis=0)
+        return Xb, wb
+
+    est = pca_fit_blocks(block_fn, 4, 3)
+    from dask_ml_tpu.decomposition import PCA
+
+    oracle = PCA(n_components=3, svd_solver="tsqr").fit(X)
+    np.testing.assert_allclose(est.mean_, oracle.mean_, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(est.explained_variance_,
+                               oracle.explained_variance_, rtol=1e-3)
