@@ -111,14 +111,25 @@ def bench_kmeans(rtt):
         t = max(measure(f, Xd, w, centers0, tol) - rtt, 1e-9)
         out[dtype_name] = n * iters / t / jax.device_count()
 
-    # the opt-in single-pass pallas variant, for the record: halves logical
-    # HBM traffic but Mosaic's pipeline doesn't saturate the bandwidth the
-    # whole-shard XLA path reaches, so auto keeps XLA (models/kmeans.py
-    # _lloyd_iter_pallas docstring has the analysis)
+    # the single-pass pallas variant at the flagship shape, for the record:
+    # XLA's two-pass roofline wins HERE (small k, f32), so auto keeps XLA —
+    # but auto DOES select pallas in its measured winning regimes (k=128 /
+    # bf16 wide; models/kmeans.py _pallas_auto_wins has the sweep table),
+    # demonstrated by the k=128 field below
     fp = partial(core.lloyd_loop_fused, mesh=mesh, max_iter=iters,
                  kernel="pallas")
     t_pallas = max(measure(fp, X, w, centers0, tol) - rtt, 1e-9)
     out["pallas"] = n * iters / t_pallas / jax.device_count()
+
+    # the k=128 regime where the fused single-pass kernel WINS: auto
+    # dispatches to pallas there; forced XLA shown for the ratio
+    k128, it128 = 128, 300
+    c128 = core.init_random(X, w, n, k128, jax.random.key(1))
+    for kern in ("auto", "xla"):
+        fk = partial(core.lloyd_loop_fused, mesh=mesh, max_iter=it128,
+                     kernel=kern)
+        t = max(measure(fk, X, w, c128, tol) - rtt, 1e-9)
+        out[f"k128_{kern}"] = n * it128 / t / jax.device_count()
 
     # streaming floor: bare distance matmul + min over the same data,
     # feature-major, same rep count — the kernel's bandwidth floor
@@ -164,6 +175,11 @@ def bench_kmeans(rtt):
         "dtype": "float32 (f32 accumulation)",
         "bf16_samples_per_sec_per_chip": round(out["bfloat16"], 1),
         "pallas_single_pass_samples_per_sec_per_chip": round(out["pallas"], 1),
+        "k128_auto_pallas_samples_per_sec_per_chip":
+            round(out["k128_auto"], 1),
+        "k128_forced_xla_samples_per_sec_per_chip":
+            round(out["k128_xla"], 1),
+        "k128_pallas_win": round(out["k128_auto"] / out["k128_xla"], 2),
         "effective_gbps_logical": round(gbps, 1),
         "spec_frac_of_v5e_819gbps": round(gbps / HBM_V5E_SPEC_GBPS, 3),
         "floor_us_per_iter": round(t_floor * 1e6, 1),

@@ -375,6 +375,8 @@ class _CandidateRunner:
         )
         self._fp_cache: dict[int, dict] = {}
         self._fp_lock = threading.Lock()
+        self.n_batched_done = 0  # cells that actually took the batched path
+        self._batched_lock = threading.Lock()
 
     def _fit_params_for(self, split_idx):
         """Per-split fit params: array-likes aligned with the sample axis are
@@ -818,6 +820,8 @@ class _CandidateRunner:
                 None, self.scorers, self.error_score)
             return test, train, t_prefix, score_time, True
         out, t_group = result
+        with self._batched_lock:
+            self.n_batched_done += 1
         n_members = max(len(group.members), 1)
         test = {"score": float(np.asarray(out["scores"][0][member_idx]))}
         train = None
@@ -1076,7 +1080,6 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
         batch_plan = _plan_batched_groups(
             estimator, candidate_params, scorers, fit_params,
             n_train_min=min((len(tr) for tr, _te in splits), default=None))
-        self.n_batched_cells_ = len(batch_plan) * n_splits
 
         # Checkpoint/resume: completed cells live in an append-only journal
         # keyed by content — estimator config + candidate params + the
@@ -1237,6 +1240,10 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
         self.multimetric_ = multimetric
         self.scorer_ = scorers if multimetric else scorers["score"]
         self.n_shared_fits_ = memo.n_entries  # CSE observability
+        # cells that ACTUALLY read a batched group's result this fit —
+        # runtime declines (NotImplemented) and journal-resumed cells are
+        # excluded, so the attribute is evidence of which path ran
+        self.n_batched_cells_ = runner.n_batched_done
         self._shared_fit_graph = memo.report()
 
         # best_* availability follows sklearn: single-metric scoring gets

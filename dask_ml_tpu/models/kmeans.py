@@ -109,9 +109,42 @@ _LLOYD_BLK = 2048  # lanes per pallas block; d·BLK·4B ≈ 0.4–2 MB of VMEM
 def _pallas_lloyd_supported(k: int, d: int) -> bool:
     """Shapes the single-pass kernel handles with comfortable VMEM margins.
     Shapes beyond the bound are REJECTED for an explicit ``kernel='pallas'``
-    request (ValueError at trace time); ``'auto'`` never selects pallas —
-    see the measured verdict in :func:`_lloyd_iter_pallas`."""
+    request (ValueError at trace time); ``'auto'`` selects pallas only in
+    its measured winning regimes — see :func:`_pallas_auto_wins`."""
     return k <= 128 and d <= 512
+
+
+def _pallas_auto_wins(k: int, d: int, dtype) -> bool:
+    """The regimes where the single-pass Pallas kernel MEASURED faster than
+    the two-read XLA path on TPU (full sweep in the r4 notes; every cell
+    below re-measured with runtimes ≫ the host-link RTT):
+
+    ====  ====  ========  ==============
+       d     k  dtype     pallas / xla
+    ====  ====  ========  ==============
+      50   128  f32       **6.8×**  (XLA's two-pass collapses at k=128)
+      50   128  bf16      **7.8×**
+     256     8  bf16      1.84×
+     256    64  bf16      1.79×
+     256   128  bf16      1.57×
+     512     8  bf16      2.04×
+     512   128  bf16      1.51×
+      50    64  f32/bf16  1.1–1.2×  (parity band — XLA kept)
+      50  8–96  f32       0.5–1.0×  (XLA wins; incl. the flagship shape)
+     256+  any  f32       0.9–1.1×  (parity — XLA kept)
+    ====  ====  ========  ==============
+
+    Rule distilled from the table, conservative (pallas only where it won
+    ≥1.5× reliably): large-k/small-d any dtype, or bf16 with d ≥ 128.
+    TPU only — on other backends the kernel runs in interpret mode and the
+    measurements do not transfer."""
+    if not _pallas_lloyd_supported(k, d):
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    if k >= 128 and d <= 128:
+        return True
+    return dtype == jnp.bfloat16 and d >= 128
 
 
 def _lloyd_iter_pallas(centers, XT, w2d, n_loc: int):
@@ -125,17 +158,16 @@ def _lloyd_iter_pallas(centers, XT, w2d, n_loc: int):
     accumulators, written to the outputs on the final sequential grid
     step). Halves the LOGICAL HBM traffic of the dominant loop.
 
-    **Measured verdict (why ``auto`` does not pick this)**: on the bench
-    chip (1M×50, k=8, f32) the XLA two-read path runs each iteration at the
-    full memory bandwidth of BOTH passes (~5.4B samples/s/chip — i.e. the
-    hardware roofline for its traffic), while this kernel's Mosaic-emitted
-    pipeline sustains only ~⅓ of that bandwidth and lands at ~3.6B
-    samples/s/chip across block sizes 2k–16k, scratch or direct
-    accumulation. Lesson #2 of ``lloyd_loop_fused``'s docstring holds even
-    inside Pallas: XLA's own scheduling of whole-shard matmuls is the bar
-    to beat, and halving logical traffic does not pay if the generated
-    pipeline can't saturate the HBM. Kept selectable (``kernel="pallas"``)
-    for re-evaluation on other hardware/Mosaic versions.
+    **Measured verdict (r4 regime sweep)**: on the flagship bench shape
+    (1M×50, k=8, f32) the XLA two-read path runs each iteration at the
+    full memory bandwidth of BOTH passes (~5.4B samples/s/chip — the
+    hardware roofline for its traffic) and beats this kernel ~2×: halving
+    logical traffic does not pay when Mosaic's pipeline can't saturate the
+    HBM. But the full (d, k, dtype) sweep found regimes where the fusion
+    WINS decisively — k=128 with small d (XLA's two-pass path collapses to
+    ~235M samples/s there; this kernel sustains 1.6–1.9B, a 6.8–7.8×
+    win) and bf16 inputs with d ≥ 128 (1.5–2×). ``kernel="auto"``
+    dispatches on the measured rule (:func:`_pallas_auto_wins`).
 
     ``n_loc`` masks the final partial block (grid is ceil-div); padding
     rows inside ``n_loc`` are handled by their zero weights, as everywhere.
@@ -267,10 +299,12 @@ def lloyd_loop_fused(X, w, centers0, tol, *, mesh, max_iter: int,
     ``kernel`` selects the per-iteration implementation: ``"xla"`` is the
     two-matmul whole-shard path above; ``"pallas"`` is the single-pass
     kernel (:func:`_lloyd_iter_pallas`) that halves per-iteration logical
-    HBM traffic by fusing the M-step accumulation into the distance pass —
-    measured SLOWER than the XLA path on current hardware (see its
-    docstring for the numbers), so ``"auto"`` (default) always takes the
-    XLA path and pallas stays an explicit opt-in.
+    HBM traffic by fusing the M-step accumulation into the distance pass.
+    ``"auto"`` (default) picks per the MEASURED winning-regime rule
+    (:func:`_pallas_auto_wins`): pallas for k=128-class problems with
+    small d (6.8–7.8× there) and for bf16 with d ≥ 128 (1.5–2×); XLA
+    everywhere else, including the flagship small-k f32 shape where its
+    two-pass roofline wins.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -282,7 +316,8 @@ def lloyd_loop_fused(X, w, centers0, tol, *, mesh, max_iter: int,
     if kernel == "pallas" and not _pallas_lloyd_supported(k, d):
         raise ValueError(
             f"kernel='pallas' supports k<=128, d<=512; got k={k}, d={d}")
-    use_pallas = kernel == "pallas"
+    use_pallas = kernel == "pallas" or (
+        kernel == "auto" and _pallas_auto_wins(k, d, X.dtype))
 
     @partial(
         jax.shard_map,
@@ -443,7 +478,6 @@ def _batched_cells_impl(X, w, uk_arr, member_uk, tol_arr, key, eval_Xs,
     # the SMALLEST tol of any member with that k, every member's stopping
     # index is already determined — later iterations skip the data passes
     # (lax.cond) instead of recomputing identical centers
-    U = uk_arr.shape[0]
     min_tol_uk = jnp.full((U,), jnp.inf, jnp.float32)
     min_tol_uk = min_tol_uk.at[member_uk].min(tol_arr)
 

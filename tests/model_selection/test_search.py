@@ -920,3 +920,17 @@ def test_batched_runtime_decline_falls_back_per_cell():
                           cv=2, refit=False, n_jobs=1, scoring=sc).fit(X)
     np.testing.assert_allclose(
         scores, oracle.cv_results_["mean_test_score"], rtol=1e-3, atol=1e-3)
+
+
+def test_n_batched_cells_counts_actual_executions():
+    """n_batched_cells_ reflects cells that READ a batched result this fit
+    — runtime declines report 0, not the planned count."""
+    from dask_ml_tpu.cluster import KMeans
+    from dask_ml_tpu.model_selection import GridSearchCV
+
+    X = _spectral_X(n=200, d=30)
+    declined = GridSearchCV(
+        KMeans(init="random", max_iter=3_000_000, random_state=0, tol=1e-2),
+        {"n_clusters": [2, 3], "tol": [1e-2, 1e-1]},
+        cv=2, refit=False, n_jobs=1).fit(X)
+    assert declined.n_batched_cells_ == 0
