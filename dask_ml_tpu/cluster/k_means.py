@@ -192,7 +192,9 @@ class KMeans(TransformerMixin, BaseEstimator):
 
     def _batched_fit_score(self, X, y, members, eval_sets):
         """Fit every member (dict of batchable-param overrides) and score
-        (negative inertia) each against each eval set. Returns
+        (negative inertia) each against each eval set — ``eval_sets`` is a
+        list of ``(X_eval, y_eval)`` pairs (y unused by KMeans; supervised
+        implementers of the protocol score against it). Returns
         ``{"n_iter": (M,), "scores": [per eval set (M,) arrays]}`` where the
         arrays are DEVICE arrays — the call is pure async dispatch; the
         search driver bulk-fetches all groups' outputs in one sync.
@@ -220,7 +222,7 @@ class KMeans(TransformerMixin, BaseEstimator):
         if hist_bytes > 512 * 1024 * 1024 or int(self.max_iter) > 4096:
             return NotImplemented
         data = prepare_data(check_array(X))
-        evals = [prepare_data(check_array(E)) for E in eval_sets]
+        evals = [prepare_data(check_array(E)) for E, _y in eval_sets]
         key = check_random_state(self.random_state)
         pairs = [
             (int(m.get("n_clusters", self.n_clusters)),

@@ -47,6 +47,11 @@ class _GLM(BaseEstimator):
 
     family = None  # set by subclasses: 'logistic' | 'normal' | 'poisson'
 
+    #: solvers that optimize the UNREGULARIZED objective, as in the
+    #: reference (glm.py:120-122 pops regularizer/lamduh) — the single
+    #: definition every path (batch fit, streaming, batched search) reads
+    _UNREGULARIZED_SOLVERS = ("gradient_descent", "newton")
+
     def __init__(self, penalty="l2", dual=False, tol=1e-4, C=1.0,
                  fit_intercept=True, intercept_scaling=1.0, class_weight=None,
                  random_state=None, solver="admm", multiclass="ovr",
@@ -89,7 +94,7 @@ class _GLM(BaseEstimator):
             "regularizer": self.penalty,
             "lamduh": 1.0 / self.C,
         }
-        if self.solver in ("gradient_descent", "newton"):
+        if self.solver in self._UNREGULARIZED_SOLVERS:
             # These solve the unregularized problem, as in the reference
             # (glm.py:120-122 pops regularizer/lamduh).
             kwargs["lamduh"] = 0.0
@@ -242,7 +247,7 @@ class _GLM(BaseEstimator):
     def _sgd_config(self):
         sk = dict(self.solver_kwargs or {})
         regularizer, lamduh = self.penalty, 1.0 / self.C
-        if self.solver in ("gradient_descent", "newton"):
+        if self.solver in self._UNREGULARIZED_SOLVERS:
             # these solvers optimize the unregularized objective in fit()
             # (reference: glm.py:120-122); streaming must match, or
             # fit/partial_fit on the same estimator solve different problems
@@ -314,6 +319,103 @@ class _GLM(BaseEstimator):
     def _incremental_finalize(self, state):
         self._store_pf_state(state)
         return self
+
+    # -- batched-candidate protocol (search driver fast path) -------------
+    #
+    # A C grid over one GLM is the same problem at different regularization
+    # strengths: the driver's batched path solves the whole grid as ONE
+    # vmapped program and scores every member in one pass + one fetch
+    # (models/glm.py batched_glm_path; SURVEY §2.9 task-parallelism row).
+
+    _batchable_params = frozenset({"C"})
+
+    def _supports_batched(self, static_params) -> bool:
+        """Pure-jit solvers only (ADMM keeps per-shard state in shard_map);
+        plain 1-D data staging (no feature sharding) and no estimator-level
+        solver_kwargs/checkpoint plumbing, whose per-member interactions
+        the batched program does not model."""
+        solver = static_params.get("solver", self.solver)
+        if solver not in ("lbfgs", "proximal_grad", "newton",
+                          "gradient_descent"):
+            return False
+        if static_params.get("solver_kwargs", self.solver_kwargs):
+            return False
+        if static_params.get("checkpoint", self.checkpoint):
+            return False
+        return self.family in ("logistic", "normal")
+
+    def _member_lamduh(self, member):
+        if self.solver in self._UNREGULARIZED_SOLVERS:
+            # C never reaches these solvers (see _UNREGULARIZED_SOLVERS)
+            return 0.0
+        return 1.0 / float(member.get("C", self.C))
+
+    def _batchable_member_ok(self, member_params, n_train_min) -> bool:
+        """C=0 / non-finite C can't form a lamduh — such members run
+        per-cell so only THEY fail under error_score, not their group."""
+        if self.solver in self._UNREGULARIZED_SOLVERS:
+            return True
+        try:
+            c = float(member_params.get("C", self.C))
+        except (TypeError, ValueError):
+            return False
+        return np.isfinite(c) and c != 0.0
+
+    def _encode_eval_y(self, y):
+        if self.family == "logistic":
+            # labels OUTSIDE the train fold's class set encode to -1: a
+            # {0,1} prediction never matches them, exactly as the
+            # per-cell accuracy on raw labels counts them wrong (a plain
+            # positive-class test would silently score them as negative
+            # HITS when the model predicts the negative class)
+            ye = np.asarray(y)
+            return np.where(
+                ye == self.classes_[1], np.float32(1.0),
+                np.where(ye == self.classes_[0], np.float32(0.0),
+                         np.float32(-1.0))).astype(np.float32)
+        return np.asarray(y, dtype=np.float32)
+
+    def _batched_fit_score(self, X, y, members, eval_sets):
+        """One vmapped solve over the members' lamduh values + bulk scoring
+        (accuracy / R², matching ``score``). Declines (NotImplemented) on
+        meshes with a model axis and on multiclass targets — those run
+        per-cell with identical results."""
+        mesh = mesh_lib.default_mesh()
+        if mesh_lib.n_model_shards(mesh) > 1:
+            return NotImplemented
+        y_enc = self._encode_y(y)
+        if getattr(self, "classes_", None) is not None and len(
+                self.classes_) > 2:
+            return NotImplemented
+
+        def prep(Xa, ya):
+            import jax
+
+            Xin = Xa if isinstance(Xa, jax.Array) else check_array(Xa)
+            return prepare_data(Xin, y=ya, mesh=mesh, y_dtype=jnp.float32)
+
+        data = prep(X, y_enc)
+        Xd = add_intercept(data.X) if self.fit_intercept else data.X
+        d = int(Xd.shape[1])
+        mask = np.ones(d, dtype=np.float32)
+        if self.fit_intercept:
+            mask[-1] = 0.0
+        beta0 = jnp.zeros((d,), Xd.dtype)
+        kwargs = self._get_solver_kwargs()
+        lam = jnp.asarray([self._member_lamduh(m) for m in members],
+                          jnp.float32)
+        betas, n_iters = core.batched_glm_path(
+            Xd, data.y, data.weights, beta0, jnp.asarray(mask), lam,
+            solver=self.solver, family=kwargs["family"],
+            regularizer=kwargs["regularizer"],
+            max_iter=int(kwargs["max_iter"]), tol=kwargs["tol"])
+        scores = []
+        for E, y_e in eval_sets:
+            ed = prep(E, self._encode_eval_y(y_e))
+            Ed = add_intercept(ed.X) if self.fit_intercept else ed.X
+            scores.append(core.batched_eval_scores(
+                Ed, ed.y, ed.weights, betas, family=self.family))
+        return {"n_iter": n_iters, "scores": scores}
 
 
 class LogisticRegression(_GLM):

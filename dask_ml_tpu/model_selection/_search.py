@@ -783,7 +783,11 @@ class _CandidateRunner:
             est_c = methods.copy_estimator(term_est)
             if group.static:
                 est_c.set_params(**group.static)
-            evals = [X_test] + ([Xt] if self.return_train_score else [])
+            y_test = self.cv_cache.extract(split_idx, train=False,
+                                           is_x=False)
+            evals = [(X_test, y_test)]
+            if self.return_train_score:
+                evals.append((Xt, self._y_train(split_idx)))
             try:
                 out = est_c._batched_fit_score(
                     Xt, self._y_train(split_idx), group.members, evals)

@@ -604,6 +604,63 @@ def admm(X, y, w, beta0, mask, mesh, *, family="logistic", regularizer="l2",
 
 
 # ---------------------------------------------------------------------------
+# Batched regularization-path solves (search fast path)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("solver", "family", "regularizer",
+                                   "max_iter"))
+def batched_glm_path(X, y, w, beta0, mask, lamduh_arr, *, solver, family,
+                     regularizer, max_iter, tol):
+    """Solve the SAME GLM problem for a whole vector of regularization
+    strengths as one program: ``jax.vmap`` over ``lamduh`` maps the chosen
+    solver's full ``lax.while_loop`` across members (each lane stops
+    contributing once converged; the loop runs to the slowest member).
+
+    The batched-candidate analogue of KMeans' trajectory program for the
+    search driver (SURVEY §2.9 task-parallelism): a ``C`` grid over a
+    LogisticRegression dispatches one program + one score fetch instead of
+    one fit and one fetch per candidate. Data is closed over un-mapped, so
+    the memory cost is one copy of X plus (M, d) coefficients. ADMM is
+    excluded (its shard_map program keeps per-shard state; the facade
+    declines batching for it). Returns ``(betas (M, d), n_iters (M,))``.
+    """
+    table = {
+        "gradient_descent": gradient_descent,
+        "newton": newton,
+        "lbfgs": lbfgs,
+        "proximal_grad": proximal_grad,
+    }
+    fn = table[solver]
+
+    def one(lam):
+        return fn(X, y, w, beta0, mask, family=family,
+                  regularizer=regularizer, lamduh=lam, max_iter=max_iter,
+                  tol=tol)
+
+    return jax.vmap(one)(lamduh_arr)
+
+
+@partial(jax.jit, static_argnames=("family",))
+def batched_eval_scores(E, y, w, betas, *, family):
+    """Default scores of a coefficient batch on one eval set, weighted (0
+    weights exclude padding rows): accuracy for logistic (matching the
+    facade's ``score``), R² for normal. ``betas`` is (M, d); returns (M,)."""
+    eta = E @ betas.T  # (nE, M)
+    sw = jnp.maximum(jnp.sum(w), 1e-12)
+    if family == "logistic":
+        pred = (eta > 0).astype(jnp.float32)
+        hit = (pred == y[:, None]).astype(jnp.float32)
+        return jnp.sum(hit * w[:, None], axis=0) / sw
+    # normal: weighted R² with the standard uniform-average convention
+    resid = y[:, None] - eta
+    ss_res = jnp.sum(resid * resid * w[:, None], axis=0)
+    ybar = jnp.sum(y * w) / sw
+    ss_tot = jnp.maximum(jnp.sum((y - ybar) ** 2 * w), 1e-30)
+    return 1.0 - ss_res / ss_tot
+
+
+# ---------------------------------------------------------------------------
 # Larger-than-HBM training: streamed consensus ADMM over row blocks
 # ---------------------------------------------------------------------------
 

@@ -282,3 +282,13 @@ def test_logistic_ovr_partial_fit_stays_binary():
     est = LogisticRegression()
     with pytest.raises(ValueError, match="partial_fit supports exactly 2"):
         est.partial_fit(X, y, classes=[0, 1, 2])
+
+
+def test_batched_eval_encoding_marks_unseen_labels_wrong():
+    """Eval labels outside the train fold's class set must never count as
+    hits in the batched scorer: they encode to -1, unreachable by a {0,1}
+    prediction — matching per-cell accuracy on raw labels."""
+    est = LogisticRegression()
+    est._encode_y(np.array(["a", "b", "a", "b"]))
+    enc = est._encode_eval_y(np.array(["a", "b", "c"]))
+    np.testing.assert_array_equal(enc, [0.0, 1.0, -1.0])

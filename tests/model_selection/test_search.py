@@ -934,3 +934,79 @@ def test_n_batched_cells_counts_actual_executions():
         {"n_clusters": [2, 3], "tol": [1e-2, 1e-1]},
         cv=2, refit=False, n_jobs=1).fit(X)
     assert declined.n_batched_cells_ == 0
+
+
+def test_batched_glm_c_grid_matches_per_cell():
+    """A C grid over LogisticRegression / LinearRegression takes the
+    batched path (one vmapped solve over lamduh + bulk scoring) and
+    reproduces the per-cell path's cv_results_."""
+    from dask_ml_tpu.linear_model import LinearRegression, LogisticRegression
+    from dask_ml_tpu.model_selection import GridSearchCV
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(240, 6).astype(np.float32)
+    beta = rng.randn(6).astype(np.float32)
+    y_clf = np.array(["neg", "pos"])[(X @ beta > 0).astype(int)]
+    y_reg = (X @ beta + 0.1 * rng.randn(240)).astype(np.float32)
+
+    grid = {"C": [0.01, 0.1, 1.0, 10.0]}
+
+    def oracle_scorer(est, Xv, yv):
+        return est.score(Xv, yv)
+
+    for est, yv in ((LogisticRegression(solver="lbfgs", max_iter=80), y_clf),
+                    (LinearRegression(solver="lbfgs", max_iter=80), y_reg)):
+        gs = GridSearchCV(est, grid, cv=2, refit=False, n_jobs=1).fit(X, yv)
+        assert gs.n_batched_cells_ == 8, type(est).__name__
+        oracle = GridSearchCV(est, grid, cv=2, refit=False, n_jobs=1,
+                              scoring=oracle_scorer).fit(X, yv)
+        assert oracle.n_batched_cells_ == 0
+        np.testing.assert_allclose(
+            gs.cv_results_["mean_test_score"],
+            oracle.cv_results_["mean_test_score"], rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(
+            gs.cv_results_["mean_train_score"],
+            oracle.cv_results_["mean_train_score"], rtol=2e-3, atol=2e-3)
+
+
+def test_batched_glm_declines_admm_and_multiclass():
+    from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.model_selection import GridSearchCV
+
+    rng = np.random.RandomState(1)
+    X = rng.randn(120, 4).astype(np.float32)
+    y3 = np.array([0, 1, 2] * 40)
+
+    # ADMM keeps per-shard state: planned out entirely
+    gs = GridSearchCV(LogisticRegression(solver="admm", max_iter=20),
+                      {"C": [1.0, 0.1]}, cv=2, refit=False,
+                      n_jobs=1).fit(X, (X[:, 0] > 0).astype(int))
+    assert gs.n_batched_cells_ == 0
+
+    # multiclass declines at runtime, per-cell OVR still runs
+    gs3 = GridSearchCV(LogisticRegression(solver="lbfgs", max_iter=40),
+                       {"C": [1.0, 0.1]}, cv=2, refit=False,
+                       n_jobs=1).fit(X, y3)
+    assert gs3.n_batched_cells_ == 0
+    assert np.all(np.isfinite(gs3.cv_results_["mean_test_score"]))
+
+
+def test_batched_glm_invalid_c_runs_per_cell():
+    """C=0 can't form a lamduh: that member is planned out and fails alone
+    under error_score while the rest of its group batches normally."""
+    from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.model_selection import GridSearchCV
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(120, 4).astype(np.float32)
+    y = (X[:, 0] > 0).astype(int)
+    with pytest.warns(Warning, match="fit failed"):
+        gs = GridSearchCV(LogisticRegression(solver="lbfgs", max_iter=40),
+                          {"C": [0.0, 1.0, 10.0]}, cv=2, refit=False,
+                          n_jobs=1, error_score=-9.0).fit(X, y)
+    res = gs.cv_results_
+    cs = np.asarray([p["C"] for p in res["params"]])
+    scores = np.asarray(res["mean_test_score"])
+    assert np.all(scores[cs == 0.0] == -9.0)
+    assert np.all(scores[cs != 0.0] > 0.5)
+    assert gs.n_batched_cells_ == 4  # the two valid C values, both splits
