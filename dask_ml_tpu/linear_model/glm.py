@@ -352,8 +352,13 @@ class _GLM(BaseEstimator):
 
     def _batchable_member_ok(self, member_params, n_train_min) -> bool:
         """C=0 / non-finite C can't form a lamduh — such members run
-        per-cell so only THEY fail under error_score, not their group."""
-        if self.solver in self._UNREGULARIZED_SOLVERS:
+        per-cell so only THEY fail under error_score, not their group.
+        Resolves solver from the MERGED params (a grid can override it),
+        like _supports_batched — reading self.solver would admit a C=0
+        member planned against an unregularized default solver and poison
+        the group at runtime."""
+        if member_params.get(
+                "solver", self.solver) in self._UNREGULARIZED_SOLVERS:
             return True
         try:
             c = float(member_params.get("C", self.C))
@@ -423,13 +428,16 @@ class LogisticRegression(_GLM):
 
     Multiclass (parity-plus — dask-glm is binary-only, so the reference's
     ``multiclass="ovr"`` constructor param never did anything): with > 2
-    classes and ``multiclass="ovr"`` this fits one binary problem per class
-    against the SAME staged data (the class-indicator targets are built on
-    device, so X uploads once), ``coef_`` is (n_classes, n_features),
-    ``decision_function`` returns (n, n_classes), and ``predict_proba``
-    returns sigmoid scores normalized per row — sklearn's OVR semantics.
-    Binary fits keep the reference's exact surface (1-D ``coef_``, 1-D
-    ``predict_proba``). Any other ``multiclass`` value is rejected loudly.
+    classes, ``multiclass="ovr"`` fits one binary problem per class against
+    the SAME staged data (the class-indicator targets are built on device,
+    so X uploads once) with sigmoid-normalized ``predict_proba``;
+    ``multiclass="multinomial"`` fits ONE softmax cross-entropy problem by
+    on-device L-BFGS over the (d, K) coefficient matrix with softmax
+    ``predict_proba`` (models/glm.py ``multinomial_lbfgs``). Either way
+    ``coef_`` is (n_classes, n_features) and ``decision_function`` returns
+    (n, n_classes). Binary fits keep the reference's exact surface (1-D
+    ``coef_``, 1-D ``predict_proba``). Other ``multiclass`` values are
+    rejected loudly.
     """
 
     family = "logistic"
@@ -439,11 +447,10 @@ class LogisticRegression(_GLM):
         # encoded like sklearn does (classes_ + positional remap). The
         # reference would silently diverge on e.g. {1, 2} labels — dask-glm
         # feeds y straight into the loss — which we do not reproduce.
-        if self.multiclass != "ovr":
+        if self.multiclass not in ("ovr", "multinomial"):
             raise ValueError(
-                f"multiclass must be 'ovr', got {self.multiclass!r} "
-                "(multinomial is not implemented; 'ovr' fits one binary "
-                "problem per class)"
+                f"multiclass must be 'ovr' or 'multinomial', got "
+                f"{self.multiclass!r}"
             )
         y = np.asarray(y)
         self.classes_ = np.unique(y)
@@ -458,6 +465,53 @@ class LogisticRegression(_GLM):
         # targets are derived on device in _solve_targets
         idx = np.searchsorted(self.classes_, y)
         return idx.astype(np.float32)
+
+    def fit(self, X, y=None, sample_weight=None):
+        if self.multiclass == "multinomial" and y is not None:
+            idx = self._encode_y(y)  # one unique pass; sets classes_
+            if len(self.classes_) > 2:
+                return self._fit_multinomial(X, idx, sample_weight)
+        return super().fit(X, y, sample_weight=sample_weight)
+
+    def _fit_multinomial(self, X, idx, sample_weight=None):
+        """One softmax problem over all classes (see class docstring).
+        ``idx`` is the already-encoded class-index vector from fit()."""
+        if self.solver == "admm":
+            raise ValueError(
+                "multiclass='multinomial' uses the smooth on-device L-BFGS "
+                "path; solver='admm' is not supported for it (use 'lbfgs', "
+                "or multiclass='ovr' for per-class ADMM)"
+            )
+        # the SAME validation + objective contract as every other fit path:
+        # unknown solvers raise, unregularized solvers keep lamduh=0, and
+        # solver_kwargs overrides apply (the minimizer is always L-BFGS,
+        # but the OBJECTIVE follows the estimator's configuration)
+        kwargs = self._get_solver_kwargs()
+        self._pf_state = None
+        self._pf_classes = None
+        X = check_array(X)
+        K = len(self.classes_)
+        data = prepare_data(X, y=idx, sample_weight=sample_weight,
+                            y_dtype=jnp.float32)
+        Xd = add_intercept(data.X) if self.fit_intercept else data.X
+        d = int(Xd.shape[1])
+        mask = np.ones(d, dtype=np.float32)
+        if self.fit_intercept:
+            mask[-1] = 0.0
+        with profile_phase(logger, "glm-multinomial-lbfgs"):
+            B, n_iter = core.multinomial_lbfgs(
+                Xd, data.y, data.weights,
+                jnp.zeros((d, K), jnp.float32), jnp.asarray(mask),
+                n_classes=K, regularizer=kwargs["regularizer"],
+                lamduh=kwargs["lamduh"], max_iter=int(kwargs["max_iter"]),
+                tol=kwargs.get("tol", self.tol))
+        self._coef = np.asarray(B).T  # (K, width), the OVR layout
+        self.n_iter_ = int(n_iter)
+        self.coef_ = (self._coef[:, :-1] if self.fit_intercept
+                      else self._coef)
+        if self.fit_intercept:
+            self.intercept_ = self._coef[:, -1]
+        return self
 
     def _solve_targets(self, data):
         k = len(self.classes_)
@@ -511,11 +565,16 @@ class LogisticRegression(_GLM):
     def predict_proba(self, X):
         # Binary: 1-D probability of the positive class, like the reference
         # (glm.py:203-215 returns sigmoid(X·coef), not an (n, 2) matrix).
-        # Multiclass OVR: per-class sigmoids normalized per row (sklearn's
+        # Multiclass: softmax over the joint logits for 'multinomial';
+        # per-class sigmoids normalized per row for 'ovr' (sklearn's
         # OneVsRestClassifier semantics).
         from scipy.special import expit
 
-        scores = expit(self._decision_function(X))
+        eta = self._decision_function(X)
+        if eta.ndim == 2 and self.multiclass == "multinomial":
+            z = np.exp(eta - eta.max(axis=1, keepdims=True))
+            return z / z.sum(axis=1, keepdims=True)
+        scores = expit(eta)
         if scores.ndim == 2:
             denom = np.maximum(scores.sum(axis=1, keepdims=True), 1e-30)
             return scores / denom

@@ -312,6 +312,49 @@ def _lbfgs_direction(g, S, Y, rho, count, head, m):
     return lax.fori_loop(0, m, fwd, r)
 
 
+def _lbfgs_loop(obj, value_and_grad, carry0, max_iter, tol, m):
+    """The shared L-BFGS while_loop: direction safeguard, Armijo
+    backtracking, curvature-pair update, gradient/relative-improvement
+    stopping. ``carry0 = (b, g, f, S, Y, rho, count, head)``; returns the
+    final 10-tuple carry (``out[8]`` = iterations, ``out[9]`` = done).
+    One definition serves both the vector GLM solver (:func:`lbfgs`) and
+    the flattened multinomial solver (:func:`multinomial_lbfgs`)."""
+
+    def cond(state):
+        _, g, *_rest, it, done = state
+        return jnp.logical_and(it < max_iter, ~done)
+
+    def body(state):
+        b, g, f, S, Y, rho, count, head, it, _ = state
+        direction = -_lbfgs_direction(g, S, Y, rho, count, head, m)
+        # Safeguard: fall back to steepest descent if the history produced
+        # a non-descent direction (can happen right after a skipped update).
+        descent = jnp.dot(g, direction) < 0
+        direction = jnp.where(descent, direction, -g)
+        t0 = jnp.where(count > 0, 1.0,
+                       1.0 / jnp.maximum(jnp.linalg.norm(g), 1.0))
+        t, f_new, _ = _backtrack(obj, b, f, g, direction, t0)
+        b_new = b + t * direction
+        f_new, g_new = value_and_grad(b_new)
+        s = b_new - b
+        yv = g_new - g
+        sy = jnp.dot(s, yv)
+        ok = sy > 1e-10
+        S = jnp.where(ok, S.at[head].set(s), S)
+        Y = jnp.where(ok, Y.at[head].set(yv), Y)
+        rho = jnp.where(ok, rho.at[head].set(1.0 / jnp.maximum(sy, 1e-30)),
+                        rho)
+        head = jnp.where(ok, (head + 1) % m, head)
+        count = jnp.where(ok, jnp.minimum(count + 1, m), count)
+        gnorm = jnp.max(jnp.abs(g_new))
+        rel = jnp.abs(f - f_new) <= tol * jnp.maximum(jnp.abs(f_new), 1e-10)
+        done = jnp.logical_or(gnorm < tol, rel)
+        return b_new, g_new, f_new, S, Y, rho, count, head, it + 1, done
+
+    init = carry0 + (jnp.asarray(0, jnp.int32), jnp.asarray(False))
+    return lax.while_loop(cond, body, init)
+
+
 @partial(jax.jit, static_argnames=("family", "regularizer", "max_iter", "m",
                                    "return_state"))
 def lbfgs(X, y, w, beta0, mask, *, family="logistic", regularizer="l2",
@@ -347,36 +390,6 @@ def lbfgs(X, y, w, beta0, mask, *, family="logistic", regularizer="l2",
 
     value_and_grad = jax.value_and_grad(obj)
 
-    def cond(state):
-        _, g, *_rest, it, done = state
-        return jnp.logical_and(it < max_iter, ~done)
-
-    def body(state):
-        beta, g, f, S, Y, rho, count, head, it, _ = state
-        direction = _lbfgs_direction(g, S, Y, rho, count, head, m)
-        direction = -direction
-        # Safeguard: fall back to steepest descent if the history produced a
-        # non-descent direction (can happen right after a skipped update).
-        descent = jnp.dot(g, direction) < 0
-        direction = jnp.where(descent, direction, -g)
-        t0 = jnp.where(count > 0, 1.0, 1.0 / jnp.maximum(jnp.linalg.norm(g), 1.0))
-        t, f_new, _ = _backtrack(obj, beta, f, g, direction, t0)
-        beta_new = beta + t * direction
-        f_new, g_new = value_and_grad(beta_new)
-        s = beta_new - beta
-        yv = g_new - g
-        sy = jnp.dot(s, yv)
-        ok = sy > 1e-10
-        S = jnp.where(ok, S.at[head].set(s), S)
-        Y = jnp.where(ok, Y.at[head].set(yv), Y)
-        rho = jnp.where(ok, rho.at[head].set(1.0 / jnp.maximum(sy, 1e-30)), rho)
-        head = jnp.where(ok, (head + 1) % m, head)
-        count = jnp.where(ok, jnp.minimum(count + 1, m), count)
-        gnorm = jnp.max(jnp.abs(g_new))
-        rel = jnp.abs(f - f_new) <= tol * jnp.maximum(jnp.abs(f_new), 1e-10)
-        done = jnp.logical_or(gnorm < tol, rel)
-        return beta_new, g_new, f_new, S, Y, rho, count, head, it + 1, done
-
     if state is None:
         f0, g0 = value_and_grad(beta0)
         carry0 = (beta0, g0, f0,
@@ -385,8 +398,7 @@ def lbfgs(X, y, w, beta0, mask, *, family="logistic", regularizer="l2",
                   jnp.asarray(0, jnp.int32))
     else:
         carry0 = tuple(jnp.asarray(s) for s in state)
-    init = carry0 + (jnp.asarray(0, jnp.int32), jnp.asarray(False))
-    out = lax.while_loop(cond, body, init)
+    out = _lbfgs_loop(obj, value_and_grad, carry0, max_iter, tol, m)
     if return_state:
         return out[0], out[8], out[:8], out[9]
     return out[0], out[8]
@@ -601,6 +613,60 @@ def admm(X, y, w, beta0, mask, mesh, *, family="logistic", regularizer="l2",
     if return_state:
         return z, n_iter, (z, x, u), done
     return z, n_iter
+
+
+# ---------------------------------------------------------------------------
+# Multinomial (softmax) logistic regression
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("n_classes", "regularizer", "max_iter",
+                                   "m"))
+def multinomial_lbfgs(X, y_idx, w, B0, mask, *, n_classes, regularizer="l2",
+                      lamduh=0.0, max_iter=200, tol=1e-4, m=10):
+    """Softmax (multinomial) logistic regression by L-BFGS on the flattened
+    (d·K) coefficient vector — one on-device ``lax.while_loop``, the same
+    algorithm/stopping rules as :func:`lbfgs` instantiated over the softmax
+    cross-entropy objective (parity-plus: dask-glm, and therefore the
+    reference, is binary-only).
+
+    ``y_idx`` holds float class indices 0..K-1 (padding rows: any index,
+    weight 0); ``mask`` is the per-FEATURE penalty mask (d,), broadcast over
+    classes — the intercept row stays unpenalized, matching the binary
+    facade. Each iteration is two fused data passes (logits matmul forward,
+    Xᵀ·residual pullback inside the gradient), psum'd over the sharded
+    sample axis by XLA. Returns ``(B (d, K), n_iter)``. With an l2 penalty
+    the softmax shift degeneracy is pinned exactly as sklearn's multinomial
+    path pins it.
+    """
+    n, d = X.shape
+    K = n_classes
+    sdt = _state_dtype(X)
+    sw = jnp.maximum(jnp.sum(w), 1.0)
+    pen_value, _ = _penalty(regularizer)
+    lam_eff = jnp.asarray(lamduh, sdt)
+    Yoh = jax.nn.one_hot(y_idx.astype(jnp.int32), K, dtype=sdt)
+
+    def obj(bflat):
+        B = bflat.reshape(d, K)
+        logits = jax.lax.dot_general(
+            X, B.astype(X.dtype), (((1,), (0,)), ((), ())),
+            preferred_element_type=sdt)  # (n, K)
+        lse = jax.scipy.special.logsumexp(logits, axis=1)
+        nll = jnp.sum(w * (lse - jnp.sum(Yoh * logits, axis=1)))
+        pen = pen_value((B * mask[:, None]).ravel())
+        return (nll + lam_eff * pen) / sw
+
+    value_and_grad = jax.value_and_grad(obj)
+    dK = d * K
+    b0 = B0.astype(sdt).reshape(dK)
+    f0, g0 = value_and_grad(b0)
+    carry0 = (b0, g0, f0,
+              jnp.zeros((m, dK), sdt), jnp.zeros((m, dK), sdt),
+              jnp.zeros((m,), sdt), jnp.asarray(0, jnp.int32),
+              jnp.asarray(0, jnp.int32))
+    out = _lbfgs_loop(obj, value_and_grad, carry0, max_iter, tol, m)
+    return out[0].reshape(d, K), out[8]
 
 
 # ---------------------------------------------------------------------------

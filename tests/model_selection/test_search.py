@@ -1010,3 +1010,26 @@ def test_batched_glm_invalid_c_runs_per_cell():
     assert np.all(scores[cs == 0.0] == -9.0)
     assert np.all(scores[cs != 0.0] > 0.5)
     assert gs.n_batched_cells_ == 4  # the two valid C values, both splits
+
+
+def test_batched_glm_solver_override_in_grid():
+    """A grid that OVERRIDES solver must plan members against the merged
+    solver, not the constructor default: C=0 with an lbfgs override is
+    planned out (per-cell failure only), the rest batch under lbfgs."""
+    from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.model_selection import GridSearchCV
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(120, 4).astype(np.float32)
+    y = (X[:, 0] > 0).astype(int)
+    est = LogisticRegression(solver="gradient_descent", max_iter=40)
+    with pytest.warns(Warning, match="fit failed"):
+        gs = GridSearchCV(est, {"solver": ["lbfgs"], "C": [0.0, 1.0, 10.0]},
+                          cv=2, refit=False, n_jobs=1,
+                          error_score=-9.0).fit(X, y)
+    res = gs.cv_results_
+    cs = np.asarray([p["C"] for p in res["params"]])
+    scores = np.asarray(res["mean_test_score"])
+    assert np.all(scores[cs == 0.0] == -9.0)
+    assert np.all(scores[cs != 0.0] > 0.5)  # group NOT poisoned
+    assert gs.n_batched_cells_ == 4
