@@ -443,6 +443,9 @@ class LogisticRegression(_GLM):
     family = "logistic"
 
     def _encode_y(self, y):
+        pre = getattr(self, "_precomputed_y_enc", None)
+        if pre is not None:
+            return pre  # fit() already encoded this exact target
         # The logistic loss needs y ∈ {0, 1}; arbitrary binary labels are
         # encoded like sklearn does (classes_ + positional remap). The
         # reference would silently diverge on e.g. {1, 2} labels — dask-glm
@@ -471,6 +474,13 @@ class LogisticRegression(_GLM):
             idx = self._encode_y(y)  # one unique pass; sets classes_
             if len(self.classes_) > 2:
                 return self._fit_multinomial(X, idx, sample_weight)
+            # binary fallback: hand the encoding we just computed to the
+            # base fit so y is not re-scanned
+            self._precomputed_y_enc = idx
+            try:
+                return super().fit(X, y, sample_weight=sample_weight)
+            finally:
+                self._precomputed_y_enc = None
         return super().fit(X, y, sample_weight=sample_weight)
 
     def _fit_multinomial(self, X, idx, sample_weight=None):
@@ -481,6 +491,13 @@ class LogisticRegression(_GLM):
                 "multiclass='multinomial' uses the smooth on-device L-BFGS "
                 "path; solver='admm' is not supported for it (use 'lbfgs', "
                 "or multiclass='ovr' for per-class ADMM)"
+            )
+        if self.checkpoint:
+            raise ValueError(
+                "checkpoint= is not supported with multiclass='multinomial' "
+                "yet (the softmax solve does not expose a resumable carry); "
+                "use multiclass='ovr', whose per-class solves checkpoint, "
+                "or drop checkpoint="
             )
         # the SAME validation + objective contract as every other fit path:
         # unknown solvers raise, unregularized solvers keep lamduh=0, and

@@ -620,6 +620,20 @@ def _sample_round(X, w, candidates, cand_valid, l, key):
     return (draws < p), phi
 
 
+@partial(jax.jit, static_argnames=("cap",))
+def _sample_round_packed(X, w, candidates, cand_valid, l, key, *, cap):
+    """:func:`_sample_round` with the selected ROW INDICES packed on device
+    (``jnp.nonzero(..., size=cap)``): the host fetches a (cap,)-int vector
+    + a count instead of the full n-row selection mask — on a slow host
+    link the mask fetch dominated every init round at KDD scale. ``cap``
+    bounds the draw (expected draws ≈ l; the buffer-truncation semantics
+    of the caller already drop overflow)."""
+    mask, phi = _sample_round(X, w, candidates, cand_valid, l, key)
+    idx = jnp.nonzero(mask, size=cap, fill_value=0)[0]
+    count = jnp.minimum(jnp.sum(mask), cap)
+    return idx, count, phi
+
+
 @jax.jit
 def _candidate_weights(X, w, candidates, cand_valid):
     """Weight of each candidate = total weight of the points nearest to it
@@ -684,10 +698,16 @@ def init_scalable(
     n_cand = 1
 
     valid = jnp.arange(max_cand) < n_cand
+    # device-packed index fetch per round: (cap,) ints instead of the full
+    # n-row selection mask; cap ≫ the expected l draws, and the candidate
+    # buffer truncates overflow exactly as before
+    cap = int(min(max(4 * int(np.ceil(l)) + 16, 64), n_padded))
     for r in range(n_rounds):
         key, kr = jax.random.split(key)
-        mask, _ = _sample_round(X, w, cand_dev, valid, l, kr)
-        idx = np.nonzero(np.asarray(mask))[0]
+        idx_dev, cnt_dev, _phi = _sample_round_packed(
+            X, w, cand_dev, valid, l, kr, cap=cap)
+        idx_h, cnt = jax.device_get((idx_dev, cnt_dev))  # ONE round trip
+        idx = np.asarray(idx_h)[: int(cnt)]
         if idx.size == 0:
             continue
         take = min(idx.size, max_cand - n_cand)

@@ -338,3 +338,14 @@ def test_logistic_multinomial_rejects_admm():
     with pytest.raises(ValueError, match="multinomial"):
         LogisticRegression(multiclass="multinomial",
                            solver="admm").fit(X, y)
+
+
+def test_multinomial_checkpoint_rejected_loudly():
+    """checkpoint= with multinomial has no resumable carry yet: loud error,
+    never a silently non-resumable fit."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(30, 3)
+    y = np.array([0, 1, 2] * 10)
+    with pytest.raises(ValueError, match="checkpoint"):
+        LogisticRegression(multiclass="multinomial", solver="lbfgs",
+                           checkpoint="/tmp/nope.ckpt").fit(X, y)
