@@ -232,6 +232,71 @@ class _GLM(BaseEstimator):
         eta = Xs @ (coef.T if coef.ndim == 2 else coef)
         return np.asarray(unpad_rows(eta, n))
 
+    # -- larger-than-HBM block streaming ----------------------------------
+
+    def fit_blocks(self, block_fn, n_blocks, n_samples, n_features,
+                   classes=None, sw_total=None):
+        """Fit from streamed row blocks — data larger than device memory.
+
+        ``block_fn(b) -> (X_b, y_b, w_b)`` is a TRACED function producing
+        block ``b`` on device (regenerate from a seed, gather host-pinned
+        rows via ``jax.pure_callback``, or slice a resident array): one
+        block is resident at a time inside the solver's scan
+        (models/glm.py ``admm_streamed``). ``y_b`` must already be numeric
+        — {0,1} for logistic (pass ``classes`` to fix ``classes_``), raw
+        targets for linear/poisson. Requires ``solver='admm'``, the
+        streamed consensus solver; blocks must NOT include an intercept
+        column (it is appended in-trace when ``fit_intercept``).
+
+        ``sw_total`` is the total sample weight over ALL blocks; it
+        defaults to ``n_samples``, which is only correct for UNIT weights —
+        pass it explicitly when block weights are not all 1 (the solver
+        normalizes its objective by 1/SW, so a wrong total mis-scales the
+        effective regularization).
+
+        The blueprint-scale bench fits 1e8×100 (40 GB of f32) this way on
+        one 16 GB chip.
+        """
+        if self.solver != "admm":
+            raise ValueError(
+                "fit_blocks streams through consensus ADMM; construct the "
+                "estimator with solver='admm'"
+            )
+        if self.checkpoint:
+            raise ValueError(
+                "checkpoint= is not wired into fit_blocks yet; drive "
+                "models.glm.admm_streamed's state/return_state carry "
+                "directly for resumable block-streamed fits"
+            )
+        self._pf_state = None  # block fit discards any streaming state
+        self._pf_classes = None
+        kwargs = self._get_solver_kwargs()
+        kwargs.pop("family", None)
+        d = int(n_features) + (1 if self.fit_intercept else 0)
+        mask = np.ones(d, dtype=np.float32)
+        if self.fit_intercept:
+            mask[-1] = 0.0
+
+        if self.fit_intercept:
+            def wrapped(b):
+                X_b, y_b, w_b = block_fn(b)
+                return add_intercept(X_b), y_b, w_b
+        else:
+            wrapped = block_fn
+
+        with profile_phase(logger, "glm-admm-streamed"):
+            beta, n_iter = core.admm_streamed(
+                wrapped, int(n_blocks), d,
+                float(n_samples if sw_total is None else sw_total),
+                jnp.asarray(mask), family=self.family, **kwargs)
+        self.n_iter_ = int(n_iter)
+        self._finalize_coef([np.asarray(beta)])
+        if classes is not None:
+            self.classes_ = np.asarray(classes)
+        elif self.family == "logistic":
+            self.classes_ = np.array([0, 1])
+        return self
+
     # -- streaming / incremental training --------------------------------
     #
     # The reference reaches streaming GLMs through the deprecated Partial*

@@ -162,3 +162,63 @@ def test_streamed_pca_weighted_blocks():
     np.testing.assert_allclose(est.mean_, oracle.mean_, rtol=1e-4, atol=1e-4)
     np.testing.assert_allclose(est.explained_variance_,
                                oracle.explained_variance_, rtol=1e-3)
+
+
+def test_facade_fit_blocks_matches_in_memory_fit(mesh8):
+    """LogisticRegression.fit_blocks (streamed consensus ADMM) matches the
+    in-memory admm fit of the same problem."""
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    X, y = _problem(n=640, d=5, seed=3)
+    n, d = X.shape
+    Xd, yd = jnp.asarray(X), jnp.asarray(y)
+    rows = n // 8
+
+    def block_fn(b):
+        Xb = jax.lax.dynamic_slice_in_dim(Xd, b * rows, rows, axis=0)
+        yb = jax.lax.dynamic_slice_in_dim(yd, b * rows, rows, axis=0)
+        return Xb, yb, jnp.ones((rows,), jnp.float32)
+
+    streamed = LogisticRegression(solver="admm", C=1.0, max_iter=40)
+    streamed.fit_blocks(block_fn, 8, n, d, classes=[0, 1])
+
+    in_mem = LogisticRegression(solver="admm", C=1.0, max_iter=40).fit(X, y)
+    np.testing.assert_allclose(streamed.coef_, in_mem.coef_,
+                               rtol=5e-2, atol=5e-3)
+    agree = np.mean(streamed.predict(X) == in_mem.predict(X))
+    assert agree > 0.99
+    assert streamed.score(X, y) > 0.9
+
+    with pytest.raises(ValueError, match="solver='admm'"):
+        LogisticRegression(solver="lbfgs").fit_blocks(block_fn, 8, n, d)
+
+
+def test_facade_fit_blocks_sw_total_for_weighted_blocks():
+    """Non-unit block weights need sw_total: with it, uniformly scaled
+    weights reproduce the unit-weight solution exactly (weighted-mean
+    objective invariance)."""
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    X, y = _problem(n=320, d=4, seed=4)
+    n, d = X.shape
+    Xd, yd = jnp.asarray(X), jnp.asarray(y)
+    rows = n // 4
+
+    def unit_blocks(b):
+        Xb = jax.lax.dynamic_slice_in_dim(Xd, b * rows, rows, axis=0)
+        yb = jax.lax.dynamic_slice_in_dim(yd, b * rows, rows, axis=0)
+        return Xb, yb, jnp.ones((rows,), jnp.float32)
+
+    def tripled_blocks(b):
+        Xb, yb, wb = unit_blocks(b)
+        return Xb, yb, 3.0 * wb
+
+    a = LogisticRegression(solver="admm", C=1.0, max_iter=30)
+    a.fit_blocks(unit_blocks, 4, n, d)
+    b = LogisticRegression(solver="admm", C=1.0, max_iter=30)
+    b.fit_blocks(tripled_blocks, 4, n, d, sw_total=3.0 * n)
+    np.testing.assert_allclose(a.coef_, b.coef_, rtol=1e-4, atol=1e-5)
+
+    with pytest.raises(ValueError, match="checkpoint"):
+        LogisticRegression(solver="admm", checkpoint="/tmp/x").fit_blocks(
+            unit_blocks, 4, n, d)
