@@ -1033,3 +1033,23 @@ def test_batched_glm_solver_override_in_grid():
     assert np.all(scores[cs == 0.0] == -9.0)
     assert np.all(scores[cs != 0.0] > 0.5)  # group NOT poisoned
     assert gs.n_batched_cells_ == 4
+
+
+def test_visualize_renders_shared_fit_dag(tmp_path):
+    """visualize() (reference parity: _search.py:870-894) renders the
+    memoized stage DAG with graphviz when available."""
+    pytest.importorskip("graphviz")
+    from dask_ml_tpu.model_selection import GridSearchCV
+
+    X = _spectral_X()
+    gs = GridSearchCV(_km_pipe(), {"km__n_clusters": [2, 3]}, cv=2,
+                      refit=False, n_jobs=1).fit(X)
+    g = gs.visualize(filename=None)  # no render: just the graph object
+    src = g.source
+    assert "StandardScaler" in src and "batch-cells" in src
+    # rendering to SVG additionally needs the `dot` BINARY, which this
+    # environment lacks — the graph object path above is the API contract
+
+    unfit = GridSearchCV(_km_pipe(), {"km__n_clusters": [2]}, cv=2)
+    with pytest.raises(AttributeError, match="Not fitted"):
+        unfit.visualize()
