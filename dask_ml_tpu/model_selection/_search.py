@@ -30,6 +30,7 @@ from __future__ import annotations
 import numbers
 import threading
 from concurrent.futures import Future, ThreadPoolExecutor
+from contextlib import contextmanager
 from typing import Optional
 
 import numpy as np
@@ -202,6 +203,21 @@ class _Memo:
         self._lock = threading.Lock()
         self._futures: dict[str, Future] = {}
         self._meta: dict[str, dict] = {}
+        self._peek_depth = 0
+
+    @contextmanager
+    def peek_scope(self):
+        """Scope whose ``get_or_run`` calls don't count as consumers — the
+        driver's batched-group pre-pass dispatches every group program for
+        bulk-fetching, but only CELLS consume results; counting the
+        pre-pass would inflate ``shared_fit_report``/``visualize`` by one
+        per (group, split) and per pre-fetched prefix node. Single-threaded
+        use only (the pre-pass runs before the worker pool starts)."""
+        self._peek_depth += 1
+        try:
+            yield
+        finally:
+            self._peek_depth -= 1
 
     def get_or_run(self, key: str, fn, label: Optional[str] = None,
                    parents: tuple = ()):
@@ -209,7 +225,8 @@ class _Memo:
             meta = self._meta.setdefault(
                 key, {"label": label, "parents": tuple(parents),
                       "consumers": 0})
-            meta["consumers"] += 1
+            if not self._peek_depth:
+                meta["consumers"] += 1
             if label and not meta["label"]:
                 meta["label"] = label
             fut = self._futures.get(key)
@@ -1198,13 +1215,19 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
                 group_cis: dict = {}
                 for ci, (group, _mi) in batch_plan.items():
                     group_cis.setdefault(id(group), (group, []))[1].append(ci)
+                def _cell_journaled(cj, si):
+                    if cell_keys[(cj, si)] in done_cells:
+                        return True
+                    lk = legacy_keys.get((cj, si))
+                    return lk is not None and lk in done_cells
+
                 pending = []
-                with config_lib.config_context(**caller_cfg):
+                with config_lib.config_context(**caller_cfg), \
+                        memo.peek_scope():
                     for group, cis in group_cis.values():
                         for si in range(n_splits):
                             if journal is not None and all(
-                                cell_keys[(cj, si)] in done_cells
-                                for cj in cis
+                                _cell_journaled(cj, si) for cj in cis
                             ):
                                 continue  # fully journaled: nothing to run
                             res, _tp = runner.batched_group_out(
@@ -1313,10 +1336,12 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
             lines.append(f"{m['consumers']:>9}  {label:<40} {key[:12]}")
         return "\n".join(lines)
 
-    def visualize(self, filename: Optional[str] = "mydask"):
+    def visualize(self, filename: Optional[str] = "mydask",
+                  format: Optional[str] = None, **kwargs):
         """Render the shared-fit DAG with graphviz (parity with the
-        reference's ``DaskBaseSearchCV.visualize``, _search.py:870-894).
-        Requires the optional ``graphviz`` package; use
+        reference's ``DaskBaseSearchCV.visualize``, _search.py:870-894 —
+        same ``(filename, format=None, **kwargs)`` surface, defaulting to
+        png). Requires the optional ``graphviz`` package; use
         :meth:`shared_fit_report` for the dependency-free text view."""
         if not hasattr(self, "_shared_fit_graph"):
             raise AttributeError("Not fitted; call fit first")
@@ -1337,7 +1362,8 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
                 if p in nodes:
                     g.edge(p[:12], key[:12])
         if filename:
-            g.render(filename, format="svg", cleanup=True)
+            g.render(filename, format=format or "png", cleanup=True,
+                     **kwargs)
         return g
 
     # -- post-fit delegation (reference: _search.py:728-762) -------------
