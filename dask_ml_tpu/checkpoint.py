@@ -107,7 +107,7 @@ def load_pytree(path: str):
 #: (resume takes the identical trajectory). The rest restart each chunk from
 #: the latest beta — exact for Newton (its carry IS beta), and correct but
 #: with a reset step-size schedule for gradient_descent / proximal_grad.
-STATEFUL_SOLVERS = ("lbfgs", "admm")
+STATEFUL_SOLVERS = ("lbfgs", "admm", "multinomial_lbfgs")
 
 
 _moments_prog = None
@@ -218,7 +218,10 @@ def solve_checkpointed(solver: str, X, y, w, beta0, mask, mesh=None, *,
     """
     from dask_ml_tpu.models import glm as glm_core
 
-    if solver not in glm_core.SOLVERS:
+    # "multinomial_lbfgs" is the softmax pseudo-solver (not in the facade's
+    # SOLVERS dispatch — reached via multiclass='multinomial'); beta/beta0
+    # are (d, K) matrices and **kwargs must carry n_classes
+    if solver not in glm_core.SOLVERS and solver != "multinomial_lbfgs":
         raise ValueError(f"unknown solver {solver!r}")
     if solver == "admm" and mesh is None:
         raise ValueError("admm requires a mesh")
@@ -271,6 +274,11 @@ def solve_checkpointed(solver: str, X, y, w, beta0, mask, mesh=None, *,
             converged = bool(done)
         elif solver == "lbfgs":
             beta, n_it, state, done = glm_core.lbfgs(
+                X, y, w, beta, mask, max_iter=budget, state=state,
+                return_state=True, **kwargs)
+            converged = bool(done)
+        elif solver == "multinomial_lbfgs":
+            beta, n_it, state, done = glm_core.multinomial_lbfgs(
                 X, y, w, beta, mask, max_iter=budget, state=state,
                 return_state=True, **kwargs)
             converged = bool(done)

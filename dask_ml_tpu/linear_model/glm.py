@@ -329,36 +329,57 @@ class _GLM(BaseEstimator):
     def _pf_width(self, n_features: int) -> int:
         return n_features + 1 if self.fit_intercept else n_features
 
+    def _pf_coef_shape(self, width: int) -> tuple:
+        """Streaming-state coefficient shape: (width,) for a single
+        problem; LogisticRegression widens to (width, K) for softmax
+        streaming."""
+        return (width,)
+
     def _pf_state_device(self, n_features: int):
         state = getattr(self, "_pf_state", None)
         if state is None:
             width = self._pf_width(n_features)
+            shape = self._pf_coef_shape(width)
             coef = getattr(self, "_coef", None)
-            if coef is not None and coef.shape == (width,):
+            if coef is not None:
                 # warm-start streaming from a batch-fitted solution, the
-                # sklearn partial_fit contract (continue, don't reset)
-                return (jnp.asarray(coef, jnp.float32),
-                        jnp.asarray(0.0, jnp.float32))
-            return (jnp.zeros((width,), jnp.float32),
+                # sklearn partial_fit contract (continue, don't reset);
+                # multiclass _coef is stored (K, width) — the stream state
+                # carries its transpose
+                if len(shape) == 1 and coef.shape == shape:
+                    return (jnp.asarray(coef, jnp.float32),
+                            jnp.asarray(0.0, jnp.float32))
+                if len(shape) == 2 and coef.shape == (shape[1], shape[0]):
+                    return (jnp.asarray(coef.T, jnp.float32),
+                            jnp.asarray(0.0, jnp.float32))
+            return (jnp.zeros(shape, jnp.float32),
                     jnp.asarray(0.0, jnp.float32))
         beta, t = state
-        if beta.shape[0] != self._pf_width(n_features):
+        if beta.shape != self._pf_coef_shape(self._pf_width(n_features)):
             raise ValueError(
                 f"partial_fit block has {n_features} features but the "
-                f"running state was built for "
-                f"{beta.shape[0] - int(self.fit_intercept)}"
+                f"running state was built for coefficient shape "
+                f"{beta.shape}"
             )
         return jnp.asarray(beta, jnp.float32), jnp.asarray(t, jnp.float32)
 
     def _store_pf_state(self, state):
         beta = np.asarray(state[0])
         self._pf_state = (beta, float(state[1]))
-        self._coef = beta
-        if self.fit_intercept:
-            self.coef_ = beta[:-1]
-            self.intercept_ = beta[-1]
+        if beta.ndim == 2:
+            self._coef = beta.T  # (K, width), the OVR/multinomial layout
+            if self.fit_intercept:
+                self.coef_ = self._coef[:, :-1]
+                self.intercept_ = self._coef[:, -1]
+            else:
+                self.coef_ = self._coef
         else:
-            self.coef_ = beta
+            self._coef = beta
+            if self.fit_intercept:
+                self.coef_ = beta[:-1]
+                self.intercept_ = beta[-1]
+            else:
+                self.coef_ = beta
         self.n_iter_ = int(float(state[1]))
 
     def partial_fit(self, X, y=None, classes=None, sample_weight=None):
@@ -557,13 +578,6 @@ class LogisticRegression(_GLM):
                 "path; solver='admm' is not supported for it (use 'lbfgs', "
                 "or multiclass='ovr' for per-class ADMM)"
             )
-        if self.checkpoint:
-            raise ValueError(
-                "checkpoint= is not supported with multiclass='multinomial' "
-                "yet (the softmax solve does not expose a resumable carry); "
-                "use multiclass='ovr', whose per-class solves checkpoint, "
-                "or drop checkpoint="
-            )
         # the SAME validation + objective contract as every other fit path:
         # unknown solvers raise, unregularized solvers keep lamduh=0, and
         # solver_kwargs overrides apply (the minimizer is always L-BFGS,
@@ -580,13 +594,33 @@ class LogisticRegression(_GLM):
         mask = np.ones(d, dtype=np.float32)
         if self.fit_intercept:
             mask[-1] = 0.0
+        B0 = jnp.zeros((d, K), jnp.float32)
+        mn_kwargs = dict(
+            n_classes=K, regularizer=kwargs["regularizer"],
+            lamduh=kwargs["lamduh"], tol=kwargs.get("tol", self.tol))
         with profile_phase(logger, "glm-multinomial-lbfgs"):
-            B, n_iter = core.multinomial_lbfgs(
-                Xd, data.y, data.weights,
-                jnp.zeros((d, K), jnp.float32), jnp.asarray(mask),
-                n_classes=K, regularizer=kwargs["regularizer"],
-                lamduh=kwargs["lamduh"], max_iter=int(kwargs["max_iter"]),
-                tol=kwargs.get("tol", self.tol))
+            if self.checkpoint:
+                # same per-problem fingerprint-suffixed snapshot scheme as
+                # the binary solvers in fit() (SURVEY §5.4): the softmax
+                # L-BFGS carry round-trips via solve_checkpointed's
+                # "multinomial_lbfgs" pseudo-solver branch
+                from dask_ml_tpu.checkpoint import (problem_fingerprint,
+                                                    solve_checkpointed)
+
+                fp = problem_fingerprint(
+                    "multinomial_lbfgs", Xd, data.y, data.weights, B0,
+                    jnp.asarray(mask), **mn_kwargs)
+                B, n_iter = solve_checkpointed(
+                    "multinomial_lbfgs", Xd, data.y, data.weights, B0,
+                    jnp.asarray(mask),
+                    path=f"{self.checkpoint}.{fp[:16]}",
+                    chunk_iters=int(self.checkpoint_every),
+                    max_iter=int(kwargs["max_iter"]), fingerprint=fp,
+                    **mn_kwargs)
+            else:
+                B, n_iter = core.multinomial_lbfgs(
+                    Xd, data.y, data.weights, B0, jnp.asarray(mask),
+                    max_iter=int(kwargs["max_iter"]), **mn_kwargs)
         self._coef = np.asarray(B).T  # (K, width), the OVR layout
         self.n_iter_ = int(n_iter)
         self.coef_ = (self._coef[:, :-1] if self.fit_intercept
@@ -614,7 +648,7 @@ class LogisticRegression(_GLM):
             self.coef_ = self._coef
 
     def _encode_y_partial(self, y, classes=None):
-        # Streaming blocks may not contain both classes; the class set is
+        # Streaming blocks may not contain every class; the class set is
         # pinned on the first call (explicitly via ``classes=`` — the same
         # requirement the reference's Partial* wrappers declare,
         # stochastic_gradient.py:7-15 — or inferred from the first block).
@@ -629,17 +663,49 @@ class LogisticRegression(_GLM):
                 )
             self._pf_classes = classes
         if getattr(self, "_pf_classes", None) is None:
-            self._pf_classes = np.unique(y)
-        if len(self._pf_classes) != 2:
+            # warm-starting a batch-fitted model: its class set carries
+            # over — inferring from one block would silently SHRINK
+            # classes_ (and reset the coefficients) when the block
+            # happens to miss a class
+            fitted = getattr(self, "classes_", None)
+            self._pf_classes = (np.asarray(fitted) if fitted is not None
+                                else np.unique(y))
+        k = len(self._pf_classes)
+        if k < 2:
             raise ValueError(
-                f"streaming partial_fit supports exactly 2 classes, got "
-                f"{len(self._pf_classes)}: {self._pf_classes!r} "
-                "(multiclass OVR is available through batch fit only)"
+                f"streaming partial_fit requires at least 2 classes, got "
+                f"{k}: {self._pf_classes!r} (pass classes= on the first "
+                "call when the first block can't show them all)"
+            )
+        if k > 2 and self.multiclass != "multinomial":
+            raise ValueError(
+                f"streaming partial_fit with {k} classes trains the "
+                "softmax (multinomial) objective; construct the estimator "
+                "with multiclass='multinomial' (per-class OVR streaming "
+                "is not provided — use batch fit for OVR)"
             )
         self.classes_ = self._pf_classes
         if not np.isin(y, self._pf_classes).all():
             raise ValueError("y contains labels outside `classes`")
-        return (y == self.classes_[1]).astype(np.float32)
+        if k == 2:
+            return (y == self.classes_[1]).astype(np.float32)
+        # class-index encoding robust to an unsorted explicit classes=
+        idx = np.argmax(
+            y[:, None] == np.asarray(self._pf_classes)[None, :], axis=1)
+        return idx.astype(np.float32)
+
+    def _sgd_config(self):
+        cfg = super()._sgd_config()
+        pf = getattr(self, "_pf_classes", None)
+        if pf is not None and len(pf) > 2:
+            cfg["n_classes"] = len(pf)
+        return cfg
+
+    def _pf_coef_shape(self, width: int) -> tuple:
+        pf = getattr(self, "_pf_classes", None)
+        if pf is not None and len(pf) > 2:
+            return (width, len(pf))
+        return (width,)
 
     def decision_function(self, X):
         return self._decision_function(X)

@@ -275,12 +275,14 @@ def test_logistic_rejects_unknown_multiclass():
         LogisticRegression(multiclass="auto").fit(X, y)
 
 
-def test_logistic_ovr_partial_fit_stays_binary():
+def test_logistic_ovr_partial_fit_multiclass_needs_multinomial():
+    """K>2 streaming trains the softmax objective, so the default OVR
+    estimator rejects it with a pointer at multiclass='multinomial'."""
     rng = np.random.RandomState(0)
     X = rng.randn(30, 3)
     y = np.array([0, 1, 2] * 10)
     est = LogisticRegression()
-    with pytest.raises(ValueError, match="partial_fit supports exactly 2"):
+    with pytest.raises(ValueError, match="multinomial"):
         est.partial_fit(X, y, classes=[0, 1, 2])
 
 
@@ -340,12 +342,84 @@ def test_logistic_multinomial_rejects_admm():
                            solver="admm").fit(X, y)
 
 
-def test_multinomial_checkpoint_rejected_loudly():
-    """checkpoint= with multinomial has no resumable carry yet: loud error,
-    never a silently non-resumable fit."""
+def test_multinomial_checkpoint_resume(tmp_path):
+    """checkpoint= with multiclass='multinomial' (VERDICT r4 #7): the
+    softmax L-BFGS carry round-trips through solve_checkpointed, so an
+    interrupted K=3 fit resumes to the uninterrupted trajectory."""
+    X, y = _three_class_problem()
+    path = str(tmp_path / "mn.ckpt")
+
+    full = LogisticRegression(
+        multiclass="multinomial", solver="lbfgs", max_iter=40, tol=0.0,
+        checkpoint=str(tmp_path / "mn_full.ckpt"), checkpoint_every=8,
+    ).fit(X, y)
+    assert full.coef_.shape == (3, X.shape[1])
+    # "killed" after 16 iterations, then resumed with the full budget
+    part = LogisticRegression(
+        multiclass="multinomial", solver="lbfgs", max_iter=16, tol=0.0,
+        checkpoint=path, checkpoint_every=8).fit(X, y)
+    assert part.n_iter_ <= 16
+    resumed = LogisticRegression(
+        multiclass="multinomial", solver="lbfgs", max_iter=40, tol=0.0,
+        checkpoint=path, checkpoint_every=8).fit(X, y)
+    assert resumed.n_iter_ == full.n_iter_
+    np.testing.assert_allclose(resumed.coef_, full.coef_,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_multinomial_partial_fit_three_classes():
+    """K=3 streaming partial_fit (VERDICT r4 #7): softmax proximal-SGD
+    blocks accumulate a (K, d) coefficient matrix; predictions reach the
+    batch multinomial fit's neighborhood and the state resumes across
+    calls."""
+    X, y = _three_class_problem()
+    est = LogisticRegression(multiclass="multinomial", C=10.0,
+                             solver_kwargs={"eta0": 0.5})
     rng = np.random.RandomState(0)
-    X = rng.randn(30, 3)
-    y = np.array([0, 1, 2] * 10)
-    with pytest.raises(ValueError, match="checkpoint"):
-        LogisticRegression(multiclass="multinomial", solver="lbfgs",
-                           checkpoint="/tmp/nope.ckpt").fit(X, y)
+    order = rng.permutation(len(X))
+    blocks = np.array_split(order, 10)
+    for epoch in range(30):
+        for blk in blocks:
+            est.partial_fit(X[blk], y[blk], classes=["ant", "bee", "cat"])
+    assert est.coef_.shape == (3, X.shape[1])
+    assert est.intercept_.shape == (3,)
+    proba = est.predict_proba(X)
+    assert proba.shape == (len(X), 3)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+    batch = LogisticRegression(multiclass="multinomial", solver="lbfgs",
+                               C=10.0, max_iter=300).fit(X, y)
+    agree = np.mean(est.predict(X) == batch.predict(X))
+    assert agree > 0.9, agree
+    # single-class follow-up block keeps streaming (class set is pinned)
+    only0 = np.where(y == "ant")[0][:20]
+    est.partial_fit(X[only0], y[only0])
+    assert est.coef_.shape == (3, X.shape[1])
+
+
+def test_multinomial_partial_fit_warm_starts_from_batch_fit():
+    """sklearn's partial_fit contract: continue from a batch-fitted
+    solution, don't reset — the (K, width) coef transposes into the
+    stream state."""
+    X, y = _three_class_problem()
+    est = LogisticRegression(multiclass="multinomial", solver="lbfgs",
+                             max_iter=200).fit(X, y)
+    coef_before = est.coef_.copy()
+    est.partial_fit(X[:30], y[:30])
+    assert est.coef_.shape == coef_before.shape
+    # one tiny SGD step on a warm solution must stay near it
+    assert np.linalg.norm(est.coef_ - coef_before) < 1.0
+
+
+def test_multinomial_partial_fit_after_fit_keeps_class_set():
+    """A batch-fitted model's class set carries into classes=-less
+    partial_fit even when the block misses a class — the fitted K=3 model
+    must not silently shrink to a fresh binary one (r5 review finding)."""
+    X, y = _three_class_problem()
+    est = LogisticRegression(multiclass="multinomial", solver="lbfgs",
+                             max_iter=200).fit(X, y)
+    coef_before = est.coef_.copy()
+    two = np.isin(y, ["ant", "bee"])
+    est.partial_fit(X[two][:30], y[two][:30])  # block shows only 2 classes
+    assert list(est.classes_) == ["ant", "bee", "cat"]
+    assert est.coef_.shape == coef_before.shape
+    assert np.linalg.norm(est.coef_ - coef_before) < 1.0
