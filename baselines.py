@@ -167,14 +167,43 @@ def bl_admm(budget):
                    "max_iter=100)"}
 
 
+def _logreg_teacher_seconds(n, d):
+    """Lean f32 teacher-model generator mirroring bench_admm_blueprint's
+    device workload (one array copy — make_classification's multi-copy
+    f64 pipeline OOMs this host at blueprint scale)."""
+    from sklearn.linear_model import LogisticRegression
+
+    rng = np.random.RandomState(0)
+    w_true = np.random.RandomState(3).randn(d).astype(np.float32)
+    X = np.empty((n, d), np.float32)
+    step = 2_000_000
+    for s in range(0, n, step):  # chunked gen keeps the f64 temp small
+        X[s:s + step] = rng.standard_normal(
+            (min(step, n - s), d)).astype(np.float32) * 2.0
+    y = (X @ w_true + rng.standard_normal(n).astype(np.float32)
+         > 0).astype(np.float32)
+    lr = LogisticRegression(solver="lbfgs", max_iter=100, C=1.0)
+    t0 = time.perf_counter()
+    lr.fit(X, y)
+    return time.perf_counter() - t0
+
+
 def bl_admm_blueprint(budget):
     cfg = ADMM_BP
+    # memory cap: X + sklearn's working copies ~4x n*d*4 bytes; stay
+    # under ~60 GB on this 125 GB host (an uncapped sized run OOM'd)
+    n_mem_cap = int(60e9 / (cfg["d"] * 4 * 4))
+
+    def run_at(n):
+        return _logreg_teacher_seconds(min(n, n_mem_cap), cfg["d"])
+
     n_run, t, _ = _sized_run(
-        cfg["n"], 200_000, lambda n: _logreg_seconds(n, cfg["d"]), budget)
+        min(cfg["n"], n_mem_cap), 1_000_000, run_at, budget)
     return {"seconds": t, "n": n_run, "d": cfg["d"], "full_n": cfg["n"],
             "direct_full_size": n_run == cfg["n"],
             "how": "sklearn LogisticRegression(solver='lbfgs', "
-                   "max_iter=100)"}
+                   "max_iter=100) on f32 teacher-model data (the bench "
+                   "workload's own generator)"}
 
 
 def bl_incremental(budget):

@@ -160,13 +160,23 @@ class PCA(BaseEstimator, TransformerMixin):
         )
         data = prepare_data(X, mesh=mesh, shard_features=shard_features)
         randomized = solver == "randomized"
+        # Bucket the randomized sketch rank to a 32-multiple: a CV sweep
+        # over n_components then shares ONE compiled fit program instead
+        # of one per value (VERDICT r4 #2 — five ~4.5 s `_fit_program`
+        # compiles dominated the sweep's cold start). The surplus
+        # components are sliced off below; the larger sketch only
+        # IMPROVES the rank-k approximation.
+        k_fit = n_components
+        if randomized:
+            k_fit = min(-(-n_components // 32) * 32,
+                        min(n_samples, n_features))
         key = check_random_state(self.random_state)
         with profile_phase(logger, "pca-fit-program"):
             # centering + masking + factorization + sign flip + total
             # variance as one dispatch (see _fit_program)
             mean, U, S, Vt, tv = _fit_program(
                 data.X, data.weights, key, float(n_samples),
-                k=n_components, n_power_iter=int(self.iterated_power),
+                k=k_fit, n_power_iter=int(self.iterated_power),
                 randomized=randomized, mesh=mesh)
 
         # tsvd on the padded array can return min(n_padded, d) singular
@@ -192,8 +202,11 @@ class PCA(BaseEstimator, TransformerMixin):
         # Probabilistic-PCA noise variance (reference: pca.py:262-276).
         if n_components < min(n_features, n_samples):
             if solver == "randomized":
+                # sum only the REQUESTED components: the bucketed sketch
+                # (k_fit >= n_components) returns surplus values that
+                # belong to the noise tail, not the explained mass
                 noise_variance = (
-                    (total_var - explained_variance.sum())
+                    (total_var - explained_variance[:n_components].sum())
                     / (min(n_features, n_samples) - n_components)
                 )
             else:

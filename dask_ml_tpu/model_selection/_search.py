@@ -147,7 +147,9 @@ class CVCache:
                 # here — slices stay untrusted, each estimator's own
                 # check_array raises inside methods.fit, and the cells
                 # follow error_score semantics exactly as host slicing did.
-                self._x_finite = bool(jnp.isfinite(x).all())
+                from dask_ml_tpu.utils.validation import _all_finite
+
+                self._x_finite = bool(_all_finite(x))
                 self._x_dev = x
         out = jnp.take(self._x_dev, jnp.asarray(np.asarray(idx)), axis=0)
         memo = _current_memo()
@@ -1221,19 +1223,60 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
                     lk = legacy_keys.get((cj, si))
                     return lk is not None and lk in done_cells
 
-                pending = []
-                with config_lib.config_context(**caller_cfg), \
-                        memo.peek_scope():
-                    for group, cis in group_cis.values():
-                        for si in range(n_splits):
-                            if journal is not None and all(
-                                _cell_journaled(cj, si) for cj in cis
-                            ):
-                                continue  # fully journaled: nothing to run
+                jobs = [
+                    (group, cis,
+                     [si for si in range(n_splits)
+                      if journal is None or not all(
+                          _cell_journaled(cj, si) for cj in cis)])
+                    for group, cis in group_cis.values()
+                ]
+                jobs = [j for j in jobs if j[2]]
+
+                def _dispatch_group(job, only_first=False):
+                    group, cis, sis = job
+                    out = []
+                    # config is thread-local: re-enter it per worker
+                    with config_lib.config_context(**caller_cfg):
+                        for si in (sis[:1] if only_first else sis):
                             res, _tp = runner.batched_group_out(
                                 candidate_params[cis[0]], si, group)
-                            if isinstance(res, tuple):
-                                pending.append(res[0])
+                            out.append(
+                                res[0] if isinstance(res, tuple) else None)
+                    return out
+
+                # Cold-start structure (VERDICT r4 #2), exploiting two
+                # facts: XLA compiles release the GIL (distinct programs
+                # CAN build concurrently), but jax has no in-flight
+                # compile dedup (two threads first-calling the same
+                # program both pay the full compile). So: (1) one
+                # serial warm-up job compiles everything the groups
+                # share — staging, prefix-fit, and (shape-bucketed)
+                # group programs; (2) the remaining groups then fan out
+                # on a pool, overlapping whatever group-specific
+                # compiles survive the bucketing, each program built
+                # exactly once. A group's splits run inside one job
+                # (same programs — racing them across workers would
+                # duplicate every compile). The memo/CVCache are
+                # lock-protected (the n_jobs>1 cell pool already drives
+                # them concurrently); the peek scope is entered once
+                # here, on this thread, before the workers start.
+                with memo.peek_scope():
+                    head = (_dispatch_group(jobs[0], only_first=True)
+                            if jobs else [])
+                    rests = ([(jobs[0][0], jobs[0][1], jobs[0][2][1:])]
+                             if jobs else [])
+                    rests += jobs[1:]
+                    rests = [j for j in rests if j[2]]
+                    if len(rests) <= 1:
+                        tails = [_dispatch_group(j) for j in rests]
+                    else:
+                        with ThreadPoolExecutor(
+                            max_workers=min(8, len(rests))
+                        ) as pre_pool:
+                            tails = list(
+                                pre_pool.map(_dispatch_group, rests))
+                pending = [p for chunk in [head] + tails for p in chunk
+                           if p is not None]
                 if pending:
                     import jax
 

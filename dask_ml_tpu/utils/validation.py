@@ -75,10 +75,21 @@ def staging_dtype(np_dtype):
 
 def _check_array_impl(X, ensure_2d, allow_nd, force_all_finite, dtype,
                       min_samples):
+    import scipy.sparse
+
+    if scipy.sparse.issparse(X):
+        # np.asarray on a scipy matrix yields a 0-d object array and a
+        # baffling downstream crash; fail with the real story instead
+        raise TypeError(
+            "scipy.sparse input is not supported by jax-native estimators "
+            "(dense device staging only); densify with .toarray(), or keep "
+            "a scikit-learn estimator for sparse data — the search driver "
+            "and wrappers pass sparse through to foreign estimators"
+        )
     arr = np.asarray(X) if not isinstance(X, jax.Array) else X
-    if ensure_2d and arr.ndim == 1:
+    if ensure_2d and arr.ndim != 2:
         raise ValueError(
-            f"Expected 2D array, got 1D array of shape {arr.shape}"
+            f"Expected 2D array, got {arr.ndim}D array of shape {arr.shape}"
         )
     if not allow_nd and arr.ndim > 2:
         raise ValueError(f"Expected <=2D array, got shape {arr.shape}")
@@ -107,10 +118,17 @@ def _check_array_impl(X, ensure_2d, allow_nd, force_all_finite, dtype,
                 # non-finite data) still get the scan below.
                 return out
         # Single fused reduction — the analogue of the reference's one-pass
-        # NaN/inf scan (reference: cluster/k_means.py:161-170).
-        if not bool(jnp.isfinite(out).all()):
+        # NaN/inf scan (reference: cluster/k_means.py:161-170). One jitted
+        # program, not two eager ops: on this backend every distinct tiny
+        # program costs ~0.7s of fixed compile overhead on first touch.
+        if not bool(_all_finite(out)):
             raise ValueError("Input contains NaN or infinity")
     return out
+
+
+@jax.jit
+def _all_finite(x):
+    return jnp.isfinite(x).all()
 
 
 KeyArray = jax.Array

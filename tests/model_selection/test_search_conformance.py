@@ -573,3 +573,74 @@ def test_multiple_metrics_with_refit_metric():
     with pytest.raises(ValueError, match="refit"):
         GridSearchCV(DecisionTreeClassifier(), {"max_depth": [1]}, cv=2,
                      scoring=scoring, refit=True).fit(X, y)
+
+
+class BrokenClassifier(BaseEstimator, ClassifierMixin):
+    """Asserts every fit lands on a FRESH clone (reference: :466-477 —
+    'broken classifier that cannot be fit twice'; refit used to break
+    sparse SVMs by reusing a fitted instance)."""
+
+    def __init__(self, parameter=None):
+        self.parameter = parameter
+
+    def fit(self, X, y):
+        assert not hasattr(self, "has_been_fit_")
+        self.has_been_fit_ = True
+        return self
+
+    def predict(self, X):
+        return np.zeros(X.shape[0])
+
+
+def test_refit_clones_estimator():
+    """reference: :481-491 — every cell fit AND the final refit get a
+    fresh clone; a reused fitted instance trips BrokenClassifier."""
+    X = np.arange(100).reshape(10, 10).astype(float)
+    y = np.array([0] * 5 + [1] * 5)
+    gs = GridSearchCV(BrokenClassifier(), {"parameter": [0, 1]},
+                      scoring="precision", refit=True, cv=2)
+    gs.fit(X, y)
+    assert hasattr(gs, "best_estimator_")
+
+
+def test_sparse_X_jax_native_terminal_fails_loudly():
+    """VERDICT r4 #4: sparse X reaching a JAX-NATIVE terminal estimator is
+    a loud, well-defined failure — error_score='raise' propagates, a
+    numeric error_score fills every cell (with a warning) and the batched
+    path reports zero completed cells. Never a silent wrong answer."""
+    from dask_ml_tpu.cluster import KMeans
+
+    X, _ = make_blobs(n_samples=60, n_features=5, random_state=0)
+    Xs = sp.csr_matrix(X)
+    est = KMeans(init="random", max_iter=5, random_state=0)
+    with pytest.raises((ValueError, TypeError)):
+        GridSearchCV(est, {"n_clusters": [2, 3]}, cv=2,
+                     error_score="raise", refit=False).fit(Xs)
+    with pytest.warns(FitFailedWarning):
+        gs = GridSearchCV(est, {"n_clusters": [2, 3]}, cv=2,
+                          error_score=-7.0, refit=False).fit(Xs)
+    assert np.all(np.asarray(gs.cv_results_["mean_test_score"]) == -7.0)
+    assert gs.n_batched_cells_ == 0
+
+
+def test_sparse_X_through_pipeline_to_jax_native_batched():
+    """VERDICT r4 #4 (the positive half): a sparse input densified by a
+    foreign prefix stage flows into the jax-native terminal's BATCHED
+    path — the full search runs, and the group programs actually
+    executed."""
+    from sklearn.decomposition import TruncatedSVD as SKTSVD
+
+    from dask_ml_tpu.cluster import KMeans
+
+    X, _ = make_blobs(n_samples=80, n_features=20, centers=3,
+                      random_state=0)
+    Xs = sp.csr_matrix(X)
+    pipe = Pipeline([
+        ("svd", SKTSVD(n_components=5, random_state=0)),  # sparse -> dense
+        ("km", KMeans(init="random", max_iter=5, random_state=0)),
+    ])
+    gs = GridSearchCV(pipe, {"km__n_clusters": [2, 3, 4]}, cv=2,
+                      refit=False).fit(Xs)
+    assert gs.n_batched_cells_ == 3 * 2
+    assert np.isfinite(
+        np.asarray(gs.cv_results_["mean_test_score"])).all()
