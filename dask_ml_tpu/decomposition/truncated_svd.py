@@ -65,9 +65,15 @@ class TruncatedSVD(BaseEstimator, TransformerMixin):
             u, s, v = u[:, :k], s[:k], v[:k]
         else:
             key = check_random_state(self.random_state)
+            # bucket the sketch rank to a 32-multiple so an n_components
+            # sweep shares one compiled program (same rationale as
+            # PCA._fit; the surplus components are sliced off below)
+            k_fit = min(-(-k // 32) * 32, min(int(X.shape[0]),
+                                              int(X.shape[1])))
             u, s, v = linalg.svd_compressed(
-                data.X, k, n_power_iter=int(self.n_iter), key=key, mesh=mesh,
-                weights=data.weights)
+                data.X, k_fit, n_power_iter=int(self.n_iter), key=key,
+                mesh=mesh, weights=data.weights)
+            u, s, v = u[:, :k], s[:k], v[:k]
         u, v = linalg.svd_flip(u, v)
 
         X_transformed = u * s

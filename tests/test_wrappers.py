@@ -412,3 +412,27 @@ def test_pandas_inputs_across_wrapper_paths():
     assert inc.score(df, ys) > 0.7
     inc.partial_fit(df, ys)  # resumes the fitted clone
     assert inc.predict(df).shape == (120,)
+
+
+def test_incremental_fused_scan_multinomial_three_classes():
+    """Incremental over the jax-native LogisticRegression with K=3 and
+    multiclass='multinomial' takes the fused lax.scan path end-to-end:
+    the (width, K) softmax-SGD state threads through incremental_scan and
+    the wrapper exposes the (K, d) learned attrs."""
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(600, 5).astype(np.float32)
+    W = rng.randn(3, 5).astype(np.float32) * 2
+    y = np.argmax(X @ W.T, axis=1)
+
+    inc = Incremental(
+        LogisticRegression(multiclass="multinomial", C=10.0,
+                           solver_kwargs={"eta0": 0.5}),
+        block_size=64)
+    for _ in range(20):
+        inc.partial_fit(X, y, classes=[0, 1, 2])
+    assert inc.coef_.shape == (3, 5)
+    assert inc.predict(X).shape == (600,)
+    acc = np.mean(inc.predict(X) == y)
+    assert acc > 0.9, acc
