@@ -695,12 +695,14 @@ def _init_scalable_device(X, w, l, tol, key, *, n_clusters: int,
     - drawn row indices are packed with ``nonzero(size=cap)`` and
       gathered device-side into the fixed ``(max_cand, d)`` buffer with a
       drop-mode scatter — nothing crosses the host boundary.
-    - candidate weights are a ``segment_sum`` of row weights over nearest
-      candidates (reference: cluster/k_means.py:407-416), then the buffer
-      is clustered down to k centers by on-device weighted greedy
-      k-means++ (:func:`_kmeanspp_on_candidates`) + a small weighted
-      Lloyd loop — replacing the reference's driver-local sklearn
-      finishing KMeans with the same math on device.
+    - candidate weights sum row weights over nearest candidates as a
+      ONE-HOT MATMUL on the MXU (reference: cluster/k_means.py:407-416;
+      a scatter-add ``segment_sum`` at this n is catastrophically slow on
+      TPU — colliding indices serialize the scatter), then the buffer is
+      clustered down to k centers by on-device weighted greedy k-means++
+      (:func:`_kmeanspp_on_candidates`) + a small weighted Lloyd loop —
+      replacing the reference's driver-local sklearn finishing KMeans
+      with the same math on device.
 
     Returns ``(centers, aux)`` where aux = (n_rounds, n_cand, φ₀,
     max round overflow beyond ``cap``) — all device scalars; the caller
@@ -732,7 +734,12 @@ def _init_scalable_device(X, w, l, tol, key, *, n_clusters: int,
         draws = jax.random.uniform(kr, (n_padded,))
         mask = draws < p
         total = jnp.sum(mask)
-        idx = jnp.nonzero(mask, size=cap, fill_value=0)[0]
+        # pack hit indices with top_k, NOT jnp.nonzero(size=...): nonzero
+        # lowers to a scatter, which serializes on TPU at this n (~40 ms a
+        # round); top_k is a fast custom call, and with hits as equal 1.0
+        # scores it returns hit indices (overflow beyond cap truncates —
+        # same semantics as the buffer cap)
+        _, idx = jax.lax.top_k(mask.astype(jnp.float32), cap)
         count = jnp.minimum(jnp.minimum(total, cap), max_cand - n_cand)
         rows = X[idx].astype(jnp.float32)  # (cap, d)
         ok = cap_iota < count
@@ -754,26 +761,39 @@ def _init_scalable_device(X, w, l, tol, key, *, n_clusters: int,
         (cand, jnp.asarray(1, jnp.int32), mind0, key,
          jnp.asarray(0, jnp.int32)))
 
-    # Degenerate draw (tiny data): top up to n_clusters with random real
-    # rows, like the reference's fallback to random sampling. Always
-    # traced (need == 0 in the common case makes it a no-op scatter).
+    # Degenerate draw (tiny data): top up to n_clusters with random
+    # distinct real rows, like the reference's fallback to random
+    # sampling. Behind a lax.cond (scalar predicate) so the common case
+    # pays nothing; inside, the k smallest per-row uniforms (masked to
+    # real rows) ARE a without-replacement uniform draw — top_k instead
+    # of random.choice(replace=False), whose full permutation sort costs
+    # tens of ms at millions of rows.
     need = jnp.clip(n_clusters - n_cand, 0, n_clusters)
-    p_row = (w > 0).astype(jnp.float32)
-    extra_idx = jax.random.choice(
-        k_extra, n_padded, shape=(n_clusters,), replace=False,
-        p=p_row / jnp.maximum(jnp.sum(p_row), 1.0))
-    fill_iota = jnp.arange(n_clusters)
-    fill_slots = jnp.where(fill_iota < need, n_cand + fill_iota, max_cand)
-    cand = cand.at[fill_slots].set(X[extra_idx].astype(jnp.float32),
-                                   mode="drop")
+
+    def top_up(cand):
+        u = jax.random.uniform(k_extra, (n_padded,))
+        u = jnp.where(w > 0, u, jnp.inf)
+        _, extra_idx = jax.lax.top_k(-u, n_clusters)
+        fill_iota = jnp.arange(n_clusters)
+        fill_slots = jnp.where(fill_iota < need, n_cand + fill_iota,
+                               max_cand)
+        return cand.at[fill_slots].set(X[extra_idx].astype(jnp.float32),
+                                       mode="drop")
+
+    cand = jax.lax.cond(need > 0, top_up, lambda c: c, cand)
     n_cand = n_cand + need
 
-    # candidate weights: total row weight assigned to each nearest candidate
+    # candidate weights: total row weight assigned to each nearest
+    # candidate, as a one-hot matmul contraction over the sharded sample
+    # axis (MXU + psum; scatter-add segment_sum serializes on TPU)
     valid = slot_iota < n_cand
     d2 = sq_euclidean(X, cand.astype(X.dtype))
     d2 = jnp.where(valid[None, :], d2, jnp.inf)
     nearest = jnp.argmin(d2, axis=1)
-    cw = jax.ops.segment_sum(w, nearest, num_segments=max_cand)
+    onehot = (slot_iota[None, :] == nearest[:, None])
+    cw = jax.lax.dot_general(
+        w, onehot.astype(jnp.float32), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)  # (max_cand,)
     cw = jnp.where(valid, cw, 0.0)
 
     # finishing: weighted greedy k-means++ then a small Lloyd loop, all on
