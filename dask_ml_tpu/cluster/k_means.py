@@ -150,11 +150,19 @@ class KMeans(TransformerMixin, BaseEstimator):
             raise AttributeError("Model not fitted; call fit first")
 
     def predict(self, X):
-        """Nearest-center labels (reference: cluster/k_means.py:196-216)."""
+        """Nearest-center labels (reference: cluster/k_means.py:196-216).
+        Host-path transfers travel as uint8 when k <= 255 (4x less
+        host-link traffic; int32 restored host-side)."""
         self._check_fitted()
         X = check_array(X)
         data = prepare_data(X)
         labels = core.predict_labels(data.X, jnp.asarray(self.cluster_centers_))
+        from dask_ml_tpu.config import get_config
+
+        if not get_config()["device_outputs"] and self.n_clusters <= 255:
+            return np.asarray(
+                unpad_rows(labels.astype(jnp.uint8), data.n)
+            ).astype(np.int32)
         return maybe_host(unpad_rows(labels, data.n))
 
     def transform(self, X):
