@@ -19,7 +19,9 @@ single host scatter of the (n, k) embedding.
 from __future__ import annotations
 
 import logging
+from functools import partial
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from sklearn.base import BaseEstimator, ClusterMixin
@@ -123,54 +125,19 @@ class SpectralClustering(BaseEstimator, ClusterMixin):
         log_array(logger, "spectral: staged X", Xs)
 
         # Row sample (reference: spectral.py:207-210) — indices drawn on
-        # host (l ints), rows gathered on device, replicated (l is small).
+        # host (l ints), rows gathered on device inside the program.
         keep = rng.choice(np.arange(n), l, replace=False)
         keep.sort()
-        keep_dev = jnp.asarray(keep)
-        Xk = replicate(jnp.take(Xs, keep_dev, axis=0))
 
-        # Kernel blocks. Instead of the reference's disjoint keep/rest
-        # split (which would need an (n-l)-row gather — a second copy of
-        # X), compute C = K(X, X_keep) over ALL rows, (n, l) sharded. The
-        # disjoint formulation falls out exactly: for keep rows the
-        # Nyström degree A·A⁻¹·C'1 equals C'1 (= a + b1), and for rest
-        # rows Bt·A⁻¹·a = Bt·1 = b2 since a = A·1 — so the unified
-        # degree d = C·A⁻¹·(C'1) reproduces the reference's d1/d2
-        # (spectral.py:225-246) and the embedding comes out already in
-        # ORIGINAL row order: the _slice_mostly_sorted re-ordering
-        # machinery (spectral.py:319-356) vanishes instead of becoming a
-        # host scatter.
-        if callable(self.affinity):
-            A = self.affinity(Xk, Xk, **params)
-            C = self.affinity(Xs, Xk, **params)
-        else:
-            A = pairwise_kernels(Xk, Xk, metric=self.affinity, **params)
-            C = pairwise_kernels(Xs, Xk, metric=self.affinity, **params)
-        row_valid = jnp.arange(C.shape[0]) < n_valid
-        C = jnp.where(row_valid[:, None], C, 0.0)  # padding rows drop out
-        log_array(logger, "spectral: kernel strip C", C)
-
-        colsum = C.sum(0)  # (l,) = a + b1: column degree over ALL rows
-        A_inv = jnp.linalg.pinv(A)
-        d_all = C @ (A_inv @ colsum)  # (n_pad,) approximate row degrees
-        d_si = 1.0 / jnp.sqrt(jnp.maximum(d_all, 1e-12))
-        d1_si = jnp.take(d_si, keep_dev)  # keep rows' exact a+b1 degrees
-
-        A2 = d1_si[:, None] * A * d1_si[None, :]
-        C2 = d_si[:, None] * C * d1_si[None, :]  # (n_pad, l) sharded
-
-        # Small replicated eigensolve (reference: delayed scipy svd,
-        # spectral.py:248-252).
-        U_A, S_A, _ = jnp.linalg.svd(A2)
-
-        # Nyström extension, Eq. 16 (reference: spectral.py:254-263),
-        # applied uniformly (C2's keep rows ARE A2's rows).
-        map_k = U_A[:, :k] * (1.0 / jnp.sqrt(S_A[:k]))[None, :]
-        V2 = np.sqrt(l / n) * (C2 @ map_k)  # (n_pad, k) sharded
-
-        # Row-normalize (Eq. 4, reference: spectral.py:266).
-        V2 = V2 / jnp.maximum(
-            jnp.linalg.norm(V2, axis=1, keepdims=True), 1e-12)
+        # hashable static closure over the kernel config: the whole
+        # embedding runs as ONE jitted program (the eager version paid
+        # ~15 separate compiles — most of a 47 s cold start at 1e6 rows)
+        params_t = tuple(sorted(params.items()))
+        V2, S_A = _nystrom_program(
+            Xs, jnp.asarray(keep),
+            jnp.asarray(n_valid, jnp.int32),
+            jnp.asarray(float(n), jnp.float32),
+            metric=self.affinity, params_t=params_t, k=k)
         U2 = unpad_rows(V2, n_valid)  # device, original row order
 
         logger.info("k-means for assign_labels [starting]")
@@ -188,6 +155,67 @@ class SpectralClustering(BaseEstimator, ClusterMixin):
     def fit_predict(self, X, y=None):
         self.fit(X)
         return self.labels_
+
+
+@partial(jax.jit, static_argnames=("metric", "params_t", "k"))
+def _nystrom_program(Xs, keep_idx, n_valid, n_true, *, metric, params_t,
+                     k: int):
+    """The ENTIRE Nyström embedding as one XLA program over the staged,
+    row-sharded X: device gather of the sampled rows, both kernel blocks,
+    unified degree normalization, the small replicated eigensolve, the
+    Eq. 16 extension, and row normalization.
+
+    Instead of the reference's disjoint keep/rest split (which would need
+    an (n-l)-row gather — a second copy of X), the kernel strip
+    C = K(X, X_keep) covers ALL rows, (n, l) sharded. The disjoint
+    formulation falls out exactly: for keep rows the Nyström degree
+    A·A⁻¹·C'1 equals C'1 (= a + b1), and for rest rows Bt·A⁻¹·a = Bt·1
+    = b2 since a = A·1 — so the unified degree d = C·A⁻¹·(C'1)
+    reproduces the reference's d1/d2 (spectral.py:225-246) and the
+    embedding comes out already in ORIGINAL row order: the
+    _slice_mostly_sorted re-ordering machinery (spectral.py:319-356)
+    vanishes instead of becoming a host scatter.
+
+    ``n_valid``/``n_true`` are traced scalars (padding mask and the l/n
+    scale), so refits across sizes with one padded shape share the
+    compile. ``metric`` (name or callable) and the kernel params are
+    static. Returns ``(V2 (n_pad, k) sharded row-normalized embedding,
+    S_A singular values)``.
+    """
+    params = dict(params_t)
+    Xk = jnp.take(Xs, keep_idx, axis=0)  # (l, d), replicated by GSPMD
+    if callable(metric):
+        A = metric(Xk, Xk, **params)
+        C = metric(Xs, Xk, **params)
+    else:
+        A = pairwise_kernels(Xk, Xk, metric=metric, **params)
+        C = pairwise_kernels(Xs, Xk, metric=metric, **params)
+    row_valid = jnp.arange(C.shape[0]) < n_valid
+    C = jnp.where(row_valid[:, None], C, 0.0)  # padding rows drop out
+
+    colsum = C.sum(0)  # (l,) = a + b1: column degree over ALL rows
+    A_inv = jnp.linalg.pinv(A)
+    d_all = C @ (A_inv @ colsum)  # (n_pad,) approximate row degrees
+    d_si = 1.0 / jnp.sqrt(jnp.maximum(d_all, 1e-12))
+    d1_si = jnp.take(d_si, keep_idx)  # keep rows' exact a+b1 degrees
+
+    A2 = d1_si[:, None] * A * d1_si[None, :]
+    C2 = d_si[:, None] * C * d1_si[None, :]  # (n_pad, l) sharded
+
+    # Small replicated eigensolve (reference: delayed scipy svd,
+    # spectral.py:248-252).
+    U_A, S_A, _ = jnp.linalg.svd(A2)
+
+    # Nyström extension, Eq. 16 (reference: spectral.py:254-263),
+    # applied uniformly (C2's keep rows ARE A2's rows).
+    map_k = U_A[:, :k] * (1.0 / jnp.sqrt(S_A[:k]))[None, :]
+    l_count = keep_idx.shape[0]
+    V2 = jnp.sqrt(l_count / n_true) * (C2 @ map_k)  # (n_pad, k) sharded
+
+    # Row-normalize (Eq. 4, reference: spectral.py:266).
+    V2 = V2 / jnp.maximum(
+        jnp.linalg.norm(V2, axis=1, keepdims=True), 1e-12)
+    return V2, S_A
 
 
 def embed(X_keep, X_rest, n_components, metric, kernel_params):
