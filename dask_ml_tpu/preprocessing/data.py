@@ -54,7 +54,12 @@ def _mean_var(X, w):
     sw = jnp.maximum(w.sum(), 1.0)
     mean = (w[:, None] * X).sum(0) / sw
     var = (w[:, None] * (X - mean) ** 2).sum(0) / sw
-    return mean, var
+    # scale in the SAME program (handle_zeros_in_scale semantics:
+    # constant features divide by 1) — an eager sqrt/where would add
+    # two more tiny programs, each ~0.7s of fixed compile cost on a
+    # tunneled backend, to every cold search
+    scale = jnp.sqrt(jnp.where(var == 0.0, 1.0, var))
+    return mean, var, scale
 
 
 @jax.jit
@@ -81,15 +86,14 @@ class StandardScaler(skdata.StandardScaler):
         self._reset()
         X = check_array(X)
         data = prepare_data(X)
-        mean, var = _mean_var(data.X, data.weights)
-        if get_config()["device_outputs"]:
-            # stay fully async: learned attrs as device arrays (np.asarray
-            # on access still works); the jnp handle-zeros matches
-            # handle_zeros_in_scale's divide-by-1-for-constant-features
-            scale = jnp.sqrt(jnp.where(var == 0.0, 1.0, var))
-        else:
-            mean, var = np.asarray(mean), np.asarray(var)
-            scale = np.sqrt(handle_zeros_in_scale(var))
+        mean, var, scale = _mean_var(data.X, data.weights)
+        if not get_config()["device_outputs"]:
+            # host attrs; device_outputs keeps them as device arrays
+            # (np.asarray on access still works). Either way the scale's
+            # handle-zeros rule matches handle_zeros_in_scale's
+            # divide-by-1-for-constant-features.
+            mean, var, scale = (np.asarray(mean), np.asarray(var),
+                                np.asarray(scale))
         # sklearn's attribute contract: disabled statistics are None, not
         # absent.
         self.mean_ = mean if self.with_mean else None
