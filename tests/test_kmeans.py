@@ -229,3 +229,24 @@ def test_pallas_auto_rule():
         # unsupported shapes never
         assert not _pallas_auto_wins(256, 50, jnp.float32)
         assert not _pallas_auto_wins(128, 1024, jnp.bfloat16)
+
+
+def test_init_round_overflow_is_observable():
+    """No-silent-caps (ADVICE r4): a k-means|| round that draws more
+    candidates than the per-round cap reports the overflow in the init
+    program's aux outputs (init_scalable warns on it)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dask_ml_tpu.models import kmeans as core
+
+    rng = np.random.RandomState(0)
+    X = jnp.asarray(rng.randn(512, 4), jnp.float32)
+    w = jnp.ones((512,), jnp.float32)
+    tol = jnp.asarray(0.0, jnp.float32)
+    # huge oversampling + cap=1: every round truncates
+    _, aux = core._init_scalable_device(
+        X, w, jnp.asarray(256.0, jnp.float32), tol, jax.random.key(0),
+        n_clusters=4, max_rounds=3, max_cand=64, cap=1, n_trials=2,
+        finish_iters=5)
+    assert int(aux[3]) > 0  # overflow observed, not silently dropped
