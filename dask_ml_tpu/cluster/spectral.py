@@ -129,15 +129,22 @@ class SpectralClustering(BaseEstimator, ClusterMixin):
         keep = rng.choice(np.arange(n), l, replace=False)
         keep.sort()
 
-        # hashable static closure over the kernel config: the whole
-        # embedding runs as ONE jitted program (the eager version paid
-        # ~15 separate compiles — most of a 47 s cold start at 1e6 rows)
+        # String metrics run the whole embedding as ONE jitted program
+        # (the eager chain paid ~15 separate compiles). CALLABLE metrics
+        # keep the eager path: users may close over numpy/sklearn code
+        # that cannot trace (np.asarray on a tracer raises), and a fresh
+        # callable per fit would leak a static jit-cache entry each time.
         params_t = tuple(sorted(params.items()))
-        V2, S_A = _nystrom_program(
-            Xs, jnp.asarray(keep),
-            jnp.asarray(n_valid, jnp.int32),
-            jnp.asarray(float(n), jnp.float32),
-            metric=self.affinity, params_t=params_t, k=k)
+        if callable(self.affinity):
+            V2, S_A = _nystrom_eager(
+                Xs, jnp.asarray(keep), n_valid, float(n),
+                self.affinity, params, k)
+        else:
+            V2, S_A = _nystrom_program(
+                Xs, jnp.asarray(keep),
+                jnp.asarray(n_valid, jnp.int32),
+                jnp.asarray(float(n), jnp.float32),
+                metric=self.affinity, params_t=params_t, k=k)
         U2 = unpad_rows(V2, n_valid)  # device, original row order
 
         logger.info("k-means for assign_labels [starting]")
@@ -178,18 +185,22 @@ def _nystrom_program(Xs, keep_idx, n_valid, n_true, *, metric, params_t,
 
     ``n_valid``/``n_true`` are traced scalars (padding mask and the l/n
     scale), so refits across sizes with one padded shape share the
-    compile. ``metric`` (name or callable) and the kernel params are
-    static. Returns ``(V2 (n_pad, k) sharded row-normalized embedding,
-    S_A singular values)``.
+    compile. ``metric`` (a kernel NAME — callables take
+    :func:`_nystrom_eager` instead) and the kernel params are static.
+    Returns ``(V2 (n_pad, k) sharded row-normalized embedding, S_A
+    singular values)``.
     """
     params = dict(params_t)
     Xk = jnp.take(Xs, keep_idx, axis=0)  # (l, d), replicated by GSPMD
-    if callable(metric):
-        A = metric(Xk, Xk, **params)
-        C = metric(Xs, Xk, **params)
-    else:
-        A = pairwise_kernels(Xk, Xk, metric=metric, **params)
-        C = pairwise_kernels(Xs, Xk, metric=metric, **params)
+    A = pairwise_kernels(Xk, Xk, metric=metric, **params)
+    C = pairwise_kernels(Xs, Xk, metric=metric, **params)
+    return _nystrom_core(A, C, keep_idx, n_valid, n_true, k)
+
+
+def _nystrom_core(A, C, keep_idx, n_valid, n_true, k: int):
+    """The post-kernel Nyström math (degree normalization, eigensolve,
+    Eq. 16, row normalization) — ONE definition shared by the fully-jitted
+    string-metric program and the eager callable-metric path."""
     row_valid = jnp.arange(C.shape[0]) < n_valid
     C = jnp.where(row_valid[:, None], C, 0.0)  # padding rows drop out
 
@@ -216,6 +227,23 @@ def _nystrom_program(Xs, keep_idx, n_valid, n_true, *, metric, params_t,
     V2 = V2 / jnp.maximum(
         jnp.linalg.norm(V2, axis=1, keepdims=True), 1e-12)
     return V2, S_A
+
+
+_nystrom_core_jit = partial(jax.jit, static_argnames=("k",))(_nystrom_core)
+
+
+def _nystrom_eager(Xs, keep_idx, n_valid: int, n_true: float, metric,
+                   params: dict, k: int):
+    """Callable-metric path: the kernel blocks run EAGERLY (the callable
+    may use numpy/sklearn code that cannot trace, and making it a static
+    jit arg would leak a compile-cache entry per callable instance); the
+    block math still runs as one jitted, callable-independent program."""
+    Xk = replicate(jnp.take(Xs, keep_idx, axis=0))
+    A = jnp.asarray(metric(Xk, Xk, **params))
+    C = jnp.asarray(metric(Xs, Xk, **params))
+    return _nystrom_core_jit(
+        A, C, keep_idx, jnp.asarray(n_valid, jnp.int32),
+        jnp.asarray(n_true, jnp.float32), k=k)
 
 
 def embed(X_keep, X_rest, n_components, metric, kernel_params):

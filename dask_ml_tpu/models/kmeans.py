@@ -692,9 +692,11 @@ def _init_scalable_device(X, w, l, tol, key, *, n_clusters: int,
       only distances to the ≤``cap`` rows drawn *this* round are
       computed (O(n·cap·d) per round instead of O(n·max_cand·d) against
       the whole buffer).
-    - drawn row indices are packed with ``nonzero(size=cap)`` and
-      gathered device-side into the fixed ``(max_cand, d)`` buffer with a
-      drop-mode scatter — nothing crosses the host boundary.
+    - drawn row indices are packed with a stable ``top_k`` over the hit
+      mask (``jnp.nonzero(size=...)`` lowers to a scatter, which
+      serializes on TPU at this n) and gathered device-side into the
+      fixed ``(max_cand, d)`` buffer with a small drop-mode scatter —
+      nothing crosses the host boundary.
     - candidate weights sum row weights over nearest candidates as a
       ONE-HOT MATMUL on the MXU (reference: cluster/k_means.py:407-416;
       a scatter-add ``segment_sum`` at this n is catastrophically slow on

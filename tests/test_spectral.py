@@ -135,3 +135,19 @@ def test_larger_n_grouping(mesh8):
     labels = sc.fit_predict(X)
     assert labels.shape == (60_000,)
     assert adjusted_rand_score(y, labels) == 1.0
+
+
+def test_numpy_based_callable_affinity(blobs, mesh8):
+    """Callable affinities may use numpy/sklearn code that cannot trace —
+    they run eagerly (device arrays convert via __array__) while the
+    block math stays jitted (r5 review finding: routing the callable
+    through jit raised TracerArrayConversionError)."""
+    from sklearn.metrics.pairwise import rbf_kernel as np_rbf
+
+    X, y = blobs
+    sc = SpectralClustering(
+        n_clusters=3, n_components=50, random_state=0,
+        affinity=lambda a, b, **kw: np_rbf(np.asarray(a), np.asarray(b),
+                                           gamma=0.25))
+    sc.fit(X)
+    assert adjusted_rand_score(y, sc.labels_) == 1.0
