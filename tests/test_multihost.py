@@ -62,6 +62,15 @@ WORKER = textwrap.dedent("""
     beta = np.asarray(beta)
     assert np.isfinite(beta).all()
     print("BETA", " ".join(f"{b:.5f}" for b in beta), flush=True)
+
+    # --- the flagship fused Lloyd loop across both processes ------------
+    from dask_ml_tpu.models import kmeans as kmcore
+    c0 = jnp.asarray(Xg[:3])  # deterministic init rows, replicated
+    centers, inertia, n_it, _ = kmcore.lloyd_loop_fused(
+        X, w, c0, jnp.asarray(0.0, jnp.float32), mesh=mesh, max_iter=5)
+    centers = np.asarray(centers)
+    assert np.isfinite(centers).all()
+    print("KMC", " ".join(f"{v:.5f}" for v in centers.ravel()), flush=True)
     print(f"proc {pid}: ok", flush=True)
 """)
 
@@ -139,3 +148,20 @@ def test_two_process_runtime(tmp_path):
     got = np.array([float(v) for v in betas[0].split()[1:]])
     np.testing.assert_allclose(got, np.asarray(beta_oracle),
                                rtol=1e-3, atol=1e-4)
+
+    # the cross-process fused Lloyd run matches the replicated
+    # single-process Lloyd on the same data and init (psums over the
+    # process boundary reduce to the same M-step)
+    kmcs = [
+        line for out in outs for line in out.splitlines()
+        if line.startswith("KMC")
+    ]
+    assert len(kmcs) == 2 and kmcs[0] == kmcs[1]
+    from dask_ml_tpu.models import kmeans as kmcore
+
+    c_oracle, _, _, _ = kmcore.lloyd_loop(
+        jnp.asarray(Xg), jnp.ones((64,), jnp.float32),
+        jnp.asarray(Xg[:3]), jnp.asarray(0.0, jnp.float32), max_iter=5)
+    got_c = np.array([float(v) for v in kmcs[0].split()[1:]]).reshape(3, 5)
+    np.testing.assert_allclose(got_c, np.asarray(c_oracle),
+                               rtol=1e-4, atol=1e-5)
