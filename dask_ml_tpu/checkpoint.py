@@ -107,7 +107,8 @@ def load_pytree(path: str):
 #: (resume takes the identical trajectory). The rest restart each chunk from
 #: the latest beta — exact for Newton (its carry IS beta), and correct but
 #: with a reset step-size schedule for gradient_descent / proximal_grad.
-STATEFUL_SOLVERS = ("lbfgs", "admm", "multinomial_lbfgs")
+STATEFUL_SOLVERS = ("lbfgs", "admm", "multinomial_lbfgs",
+                    "admm_multinomial")
 
 
 _moments_prog = None
@@ -218,11 +219,15 @@ def solve_checkpointed(solver: str, X, y, w, beta0, mask, mesh=None, *,
     """
     from dask_ml_tpu.models import glm as glm_core
 
-    # "multinomial_lbfgs" is the softmax pseudo-solver (not in the facade's
-    # SOLVERS dispatch — reached via multiclass='multinomial'); beta/beta0
-    # are (d, K) matrices and **kwargs must carry n_classes
-    if solver not in glm_core.SOLVERS and solver != "multinomial_lbfgs":
+    # "multinomial_lbfgs" / "admm_multinomial" are the softmax
+    # pseudo-solvers (not in the facade's SOLVERS dispatch — reached via
+    # multiclass='multinomial'); beta/beta0 are (d, K) matrices and
+    # **kwargs must carry n_classes
+    _MULTINOMIAL = ("multinomial_lbfgs", "admm_multinomial")
+    if solver not in glm_core.SOLVERS and solver not in _MULTINOMIAL:
         raise ValueError(f"unknown solver {solver!r}")
+    if solver == "admm_multinomial" and mesh is None:
+        raise ValueError("admm_multinomial requires a mesh")
     if solver == "admm" and mesh is None:
         raise ValueError("admm requires a mesh")
     if fingerprint is None:
@@ -280,6 +285,11 @@ def solve_checkpointed(solver: str, X, y, w, beta0, mask, mesh=None, *,
         elif solver == "multinomial_lbfgs":
             beta, n_it, state, done = glm_core.multinomial_lbfgs(
                 X, y, w, beta, mask, max_iter=budget, state=state,
+                return_state=True, **kwargs)
+            converged = bool(done)
+        elif solver == "admm_multinomial":
+            beta, n_it, state, done = glm_core.admm_multinomial(
+                X, y, w, beta, mask, mesh, max_iter=budget, state=state,
                 return_state=True, **kwargs)
             converged = bool(done)
         else:

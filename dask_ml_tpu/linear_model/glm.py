@@ -570,14 +570,10 @@ class LogisticRegression(_GLM):
         return super().fit(X, y, sample_weight=sample_weight)
 
     def _fit_multinomial(self, X, idx, sample_weight=None):
-        """One softmax problem over all classes (see class docstring).
+        """One softmax problem over all classes (see class docstring):
+        on-device L-BFGS for the smooth solvers, matrix-valued consensus
+        ADMM for ``solver='admm'`` (models/glm.py ``admm_multinomial``).
         ``idx`` is the already-encoded class-index vector from fit()."""
-        if self.solver == "admm":
-            raise ValueError(
-                "multiclass='multinomial' uses the smooth on-device L-BFGS "
-                "path; solver='admm' is not supported for it (use 'lbfgs', "
-                "or multiclass='ovr' for per-class ADMM)"
-            )
         # the SAME validation + objective contract as every other fit path:
         # unknown solvers raise, unregularized solvers keep lamduh=0, and
         # solver_kwargs overrides apply (the minimizer is always L-BFGS,
@@ -595,28 +591,46 @@ class LogisticRegression(_GLM):
         if self.fit_intercept:
             mask[-1] = 0.0
         B0 = jnp.zeros((d, K), jnp.float32)
-        mn_kwargs = dict(
-            n_classes=K, regularizer=kwargs["regularizer"],
-            lamduh=kwargs["lamduh"], tol=kwargs.get("tol", self.tol))
-        with profile_phase(logger, "glm-multinomial-lbfgs"):
+        use_admm = self.solver == "admm"
+        if use_admm:
+            solver_name = "admm_multinomial"
+            mesh = mesh_lib.default_mesh()
+            mn_kwargs = dict(
+                n_classes=K, regularizer=kwargs["regularizer"],
+                lamduh=kwargs["lamduh"])
+            # admm's extra knobs (rho, abstol, ...) from solver_kwargs
+            mn_kwargs.update({k: v for k, v in kwargs.items()
+                              if k not in ("max_iter", "family",
+                                           "regularizer", "lamduh")})
+        else:
+            solver_name = "multinomial_lbfgs"
+            mesh = None
+            mn_kwargs = dict(
+                n_classes=K, regularizer=kwargs["regularizer"],
+                lamduh=kwargs["lamduh"], tol=kwargs.get("tol", self.tol))
+        with profile_phase(logger, f"glm-{solver_name}"):
             if self.checkpoint:
                 # same per-problem fingerprint-suffixed snapshot scheme as
                 # the binary solvers in fit() (SURVEY §5.4): the softmax
-                # L-BFGS carry round-trips via solve_checkpointed's
-                # "multinomial_lbfgs" pseudo-solver branch
+                # L-BFGS / consensus-ADMM carries round-trip via
+                # solve_checkpointed's pseudo-solver branches
                 from dask_ml_tpu.checkpoint import (problem_fingerprint,
                                                     solve_checkpointed)
 
                 fp = problem_fingerprint(
-                    "multinomial_lbfgs", Xd, data.y, data.weights, B0,
+                    solver_name, Xd, data.y, data.weights, B0,
                     jnp.asarray(mask), **mn_kwargs)
                 B, n_iter = solve_checkpointed(
-                    "multinomial_lbfgs", Xd, data.y, data.weights, B0,
-                    jnp.asarray(mask),
+                    solver_name, Xd, data.y, data.weights, B0,
+                    jnp.asarray(mask), mesh,
                     path=f"{self.checkpoint}.{fp[:16]}",
                     chunk_iters=int(self.checkpoint_every),
                     max_iter=int(kwargs["max_iter"]), fingerprint=fp,
                     **mn_kwargs)
+            elif use_admm:
+                B, n_iter = core.admm_multinomial(
+                    Xd, data.y, data.weights, B0, jnp.asarray(mask),
+                    mesh, max_iter=int(kwargs["max_iter"]), **mn_kwargs)
             else:
                 B, n_iter = core.multinomial_lbfgs(
                     Xd, data.y, data.weights, B0, jnp.asarray(mask),

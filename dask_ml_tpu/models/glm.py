@@ -620,6 +620,144 @@ def admm(X, y, w, beta0, mask, mesh, *, family="logistic", regularizer="l2",
 # ---------------------------------------------------------------------------
 
 
+@partial(jax.jit, static_argnames=("mesh", "n_classes", "regularizer",
+                                   "max_iter", "inner_max_iter"))
+def _admm_multinomial_impl(X, y_idx, w, z0, x0, u0, mask, lamduh, rho,
+                           abstol, reltol, inner_tol, *, mesh, n_classes,
+                           regularizer, max_iter, inner_max_iter):
+    """Softmax consensus ADMM body (see :func:`admm_multinomial`): the
+    binary :func:`_admm_impl` with (d, K) coefficient matrices. The local
+    prox subproblem's Newton solves the full rho-regularized (dK × dK)
+    Hessian — dense and positive definite, built as one einsum over the
+    shard's rows (H = Σᵢ wᵢ · xᵢxᵢᵀ ⊗ (diag(pᵢ) − pᵢpᵢᵀ) / SW + ρI)."""
+    _, pen_prox = _penalty(regularizer)
+    n_shards = mesh.shape[DATA_AXIS]
+    d = X.shape[1]
+    K = n_classes
+    dK = d * K
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
+                  P(), P(DATA_AXIS, None, None), P(DATA_AXIS, None, None),
+                  P(), P(), P(), P(), P(), P()),
+        out_specs=(P(), P(), P(DATA_AXIS, None, None),
+                   P(DATA_AXIS, None, None), P()),
+    )
+    def run(X_loc, y_loc, w_loc, z0, x0_loc, u0_loc, mask_, lamduh, rho,
+            abstol, reltol, inner_tol):
+        sw = jnp.maximum(lax.psum(jnp.sum(w_loc), DATA_AXIS), 1.0)
+        lam_eff = lamduh / sw
+        Yoh = jax.nn.one_hot(y_loc.astype(jnp.int32), K, dtype=z0.dtype)
+
+        def local_newton(x, z, u):
+            def grad_probs(B):
+                logits = X_loc @ B  # (n_loc, K)
+                Pm = jax.nn.softmax(logits, axis=1)
+                g = (X_loc.T @ (w_loc[:, None] * (Pm - Yoh))) / sw \
+                    + rho * (B - z + u)
+                return g, Pm
+
+            def nt_cond(s):
+                _, g, _, it = s
+                return jnp.logical_and(it < inner_max_iter,
+                                       jnp.max(jnp.abs(g)) > inner_tol)
+
+            def nt_body(s):
+                B, g, Pm, it = s
+                # per-row K×K curvature M_i = diag(p_i) - p_i p_i^T
+                M = (Pm[:, :, None] * jnp.eye(K, dtype=Pm.dtype)
+                     - Pm[:, :, None] * Pm[:, None, :])
+                M = M * w_loc[:, None, None]
+                H = jnp.einsum("ij,ick,il->jckl", X_loc, M, X_loc) / sw
+                H = H.reshape(dK, dK) + rho * jnp.eye(dK, dtype=B.dtype)
+                step = jnp.linalg.solve(H, g.reshape(dK)).reshape(d, K)
+                B_new = B - step
+                g_new, P_new = grad_probs(B_new)
+                return B_new, g_new, P_new, it + 1
+
+            g0, P0 = grad_probs(x)
+            B, _, _, _ = lax.while_loop(
+                nt_cond, nt_body, (x, g0, P0, jnp.asarray(0, jnp.int32)))
+            return B
+
+        def cond(state):
+            _, _, _, it, done = state
+            return jnp.logical_and(it < max_iter, ~done)
+
+        def body(state):
+            z, x, u, it, _ = state
+            x = local_newton(x, z, u)
+            zbar = lax.psum(x + u, DATA_AXIS) / n_shards
+            t = lam_eff / (rho * n_shards)
+            z_new = jnp.where(mask_[:, None] > 0, pen_prox(zbar, t), zbar)
+            u = u + x - z_new
+            pri2 = lax.psum(jnp.sum((x - z_new) ** 2), DATA_AXIS)
+            dual = (rho * jnp.sqrt(float(n_shards))
+                    * jnp.linalg.norm((z_new - z).ravel()))
+            xnorm2 = lax.psum(jnp.sum(x * x), DATA_AXIS)
+            unorm2 = lax.psum(jnp.sum(u * u), DATA_AXIS)
+            eps_pri = (jnp.sqrt(float(n_shards * dK)) * abstol
+                       + reltol * jnp.maximum(
+                           jnp.sqrt(xnorm2),
+                           jnp.sqrt(float(n_shards))
+                           * jnp.linalg.norm(z_new.ravel())))
+            eps_dual = (jnp.sqrt(float(n_shards * dK)) * abstol
+                        + reltol * rho * jnp.sqrt(unorm2))
+            done = jnp.logical_and(jnp.sqrt(pri2) < eps_pri,
+                                   dual < eps_dual)
+            return z_new, x, u, it + 1, done
+
+        init = (z0, x0_loc[0], u0_loc[0],
+                jnp.asarray(0, jnp.int32), jnp.asarray(False))
+        z, x, u, n_iter, done = lax.while_loop(cond, body, init)
+        return z, n_iter, x[None], u[None], done
+
+    return run(X, y_idx, w, z0, x0, u0, mask, lamduh, rho, abstol, reltol,
+               inner_tol)
+
+
+def admm_multinomial(X, y_idx, w, B0, mask, mesh, *, n_classes,
+                     regularizer="l2", lamduh=0.0, rho=1.0, max_iter=250,
+                     abstol=1e-4, reltol=1e-2, inner_max_iter=20,
+                     inner_tol=1e-8, state=None, return_state=False):
+    """Consensus ADMM for SOFTMAX logistic regression (Boyd §7.1.1 with
+    matrix-valued per-shard variables) — closes the binary solver suite's
+    last multiclass gap: every shard keeps (d, K) primal/dual state and
+    solves its softmax prox subproblem with full-Hessian Newton on its
+    own rows; only the (d, K) z-consensus and the stopping residuals
+    cross the ICI as psums. Same carry/checkpoint contract as
+    :func:`admm` with ``state = (z, x, u)``, x/u stacked
+    ``(n_shards, d, K)``. Returns ``(B (d, K), n_iter)``."""
+    dt = _state_dtype(X)
+    d = X.shape[1]
+    K = int(n_classes)
+    n_shards = mesh.shape[DATA_AXIS]
+    if state is None:
+        z0 = B0.astype(dt)
+        x0 = jnp.broadcast_to(B0, (n_shards, d, K)).astype(dt)
+        u0 = jnp.zeros((n_shards, d, K), dt)
+    else:
+        z0, x0, u0 = (jnp.asarray(s, dt) for s in state)
+        if x0.shape != (n_shards, d, K) or u0.shape != (n_shards, d, K):
+            raise ValueError(
+                f"multinomial ADMM state has per-shard x/u of shape "
+                f"{x0.shape}; this mesh/problem expects "
+                f"{(n_shards, d, K)} — consensus state cannot move "
+                "between meshes with different shard counts"
+            )
+    scalars = [jnp.asarray(v, dt) for v in (lamduh, rho, abstol, reltol,
+                                            inner_tol)]
+    z, n_iter, x, u, done = _admm_multinomial_impl(
+        X, y_idx, w, z0, x0, u0, mask, *scalars, mesh=mesh, n_classes=K,
+        regularizer=regularizer, max_iter=int(max_iter),
+        inner_max_iter=int(inner_max_iter))
+    if return_state:
+        return z, n_iter, (z, x, u), done
+    return z, n_iter
+
+
 @partial(jax.jit, static_argnames=("n_classes", "regularizer", "max_iter",
                                    "m", "return_state"))
 def multinomial_lbfgs(X, y_idx, w, B0, mask, *, n_classes, regularizer="l2",

@@ -333,13 +333,16 @@ def test_logistic_multinomial_binary_falls_back():
     assert est.predict_proba(X).ndim == 1
 
 
-def test_logistic_multinomial_rejects_admm():
+def test_logistic_multinomial_admm_small_fit():
+    """multinomial + admm is a supported combination (r5; it used to be a
+    documented cliff) — a tiny fit produces the multiclass surface."""
     rng = np.random.RandomState(0)
-    X = rng.randn(30, 3)
-    y = np.array([0, 1, 2] * 10)
-    with pytest.raises(ValueError, match="multinomial"):
-        LogisticRegression(multiclass="multinomial",
-                           solver="admm").fit(X, y)
+    X = rng.randn(60, 3).astype(np.float32)
+    y = np.array([0, 1, 2] * 20)
+    est = LogisticRegression(multiclass="multinomial", solver="admm",
+                             max_iter=30).fit(X, y)
+    assert est.coef_.shape == (3, 3)
+    assert est.predict(X).shape == (60,)
 
 
 def test_multinomial_checkpoint_resume(tmp_path):
@@ -423,3 +426,56 @@ def test_multinomial_partial_fit_after_fit_keeps_class_set():
     assert list(est.classes_) == ["ant", "bee", "cat"]
     assert est.coef_.shape == coef_before.shape
     assert np.linalg.norm(est.coef_ - coef_before) < 1.0
+
+
+def test_logistic_multinomial_admm_matches_lbfgs(mesh8):
+    """solver='admm' with multiclass='multinomial' (r5: closes the last
+    multiclass solver gap): matrix-valued consensus ADMM agrees with the
+    softmax L-BFGS path on predictions and near-agrees on centered
+    coefficients."""
+    # A SOFT three-class problem (moderate true coefficients): consensus
+    # ADMM converges to modest accuracy quickly and high accuracy slowly
+    # (Boyd §3.2.2), and its consensus mixing slows with shard count and
+    # with coefficient magnitude — near-separable data (the shared
+    # _three_class_problem) needs thousands of outer iterations on the
+    # 8-shard test mesh, while this problem converges in hundreds.
+    rng = np.random.RandomState(0)
+    X = rng.randn(900, 6).astype(np.float32)
+    W = rng.randn(3, 6).astype(np.float32) * 0.7
+    logits = X @ W.T + 1.0 * rng.randn(900, 3)
+    y = np.argmax(logits, axis=1)
+    ref = LogisticRegression(multiclass="multinomial", solver="lbfgs",
+                             C=1.0, max_iter=300, tol=1e-6).fit(X, y)
+    adm = LogisticRegression(
+        multiclass="multinomial", solver="admm", C=1.0, max_iter=800,
+        solver_kwargs={"abstol": 1e-7, "reltol": 1e-6}).fit(X, y)
+    assert adm.coef_.shape == (3, X.shape[1])
+    agree = np.mean(adm.predict(X) == ref.predict(X))
+    assert agree >= 0.99, agree
+    ours = adm.coef_ - adm.coef_.mean(axis=0, keepdims=True)
+    theirs = ref.coef_ - ref.coef_.mean(axis=0, keepdims=True)
+    scale = np.max(np.abs(theirs))
+    assert np.max(np.abs(ours - theirs)) / scale < 0.1
+
+
+def test_multinomial_admm_checkpoint_resume(tmp_path, mesh8):
+    """The multinomial consensus carry (z, x, u) round-trips through
+    solve_checkpointed exactly like binary ADMM's."""
+    X, y = _three_class_problem(n=300)
+    path = str(tmp_path / "mn_admm.ckpt")
+    full = LogisticRegression(
+        multiclass="multinomial", solver="admm", max_iter=30,
+        checkpoint=str(tmp_path / "full.ckpt"), checkpoint_every=10,
+        solver_kwargs={"abstol": 0.0, "reltol": 0.0},
+    ).fit(X, y)
+    part = LogisticRegression(
+        multiclass="multinomial", solver="admm", max_iter=10,
+        checkpoint=path, checkpoint_every=5,
+        solver_kwargs={"abstol": 0.0, "reltol": 0.0}).fit(X, y)
+    assert part.n_iter_ <= 10
+    resumed = LogisticRegression(
+        multiclass="multinomial", solver="admm", max_iter=30,
+        checkpoint=path, checkpoint_every=5,
+        solver_kwargs={"abstol": 0.0, "reltol": 0.0}).fit(X, y)
+    np.testing.assert_allclose(resumed.coef_, full.coef_,
+                               rtol=1e-4, atol=1e-5)
