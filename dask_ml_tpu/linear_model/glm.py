@@ -517,9 +517,11 @@ class LogisticRegression(_GLM):
     classes, ``multiclass="ovr"`` fits one binary problem per class against
     the SAME staged data (the class-indicator targets are built on device,
     so X uploads once) with sigmoid-normalized ``predict_proba``;
-    ``multiclass="multinomial"`` fits ONE softmax cross-entropy problem by
-    on-device L-BFGS over the (d, K) coefficient matrix with softmax
-    ``predict_proba`` (models/glm.py ``multinomial_lbfgs``). Either way
+    ``multiclass="multinomial"`` fits ONE softmax cross-entropy problem
+    over the (d, K) coefficient matrix with softmax ``predict_proba`` —
+    by on-device L-BFGS (models/glm.py ``multinomial_lbfgs``), or by
+    matrix-valued consensus ADMM when ``solver="admm"``
+    (``admm_multinomial``). Either way
     ``coef_`` is (n_classes, n_features) and ``decision_function`` returns
     (n, n_classes). Binary fits keep the reference's exact surface (1-D
     ``coef_``, 1-D ``predict_proba``). Other ``multiclass`` values are
@@ -576,8 +578,9 @@ class LogisticRegression(_GLM):
         ``idx`` is the already-encoded class-index vector from fit()."""
         # the SAME validation + objective contract as every other fit path:
         # unknown solvers raise, unregularized solvers keep lamduh=0, and
-        # solver_kwargs overrides apply (the minimizer is always L-BFGS,
-        # but the OBJECTIVE follows the estimator's configuration)
+        # solver_kwargs overrides apply (the minimizer is L-BFGS for every
+        # smooth solver name and consensus ADMM for 'admm'; the OBJECTIVE
+        # follows the estimator's configuration either way)
         kwargs = self._get_solver_kwargs()
         self._pf_state = None
         self._pf_classes = None

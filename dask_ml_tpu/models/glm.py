@@ -670,7 +670,12 @@ def _admm_multinomial_impl(X, y_idx, w, z0, x0, u0, mask, lamduh, rho,
                 M = (Pm[:, :, None] * jnp.eye(K, dtype=Pm.dtype)
                      - Pm[:, :, None] * Pm[:, None, :])
                 M = M * w_loc[:, None, None]
-                H = jnp.einsum("ij,ick,il->jckl", X_loc, M, X_loc) / sw
+                # H[(j,c),(l,k)] = Σᵢ wᵢ xᵢⱼ xᵢₗ M_{i,ck}: the output
+                # axis order must be (j, c, l, k) so BOTH reshape axes
+                # flatten feature-major, matching g.reshape(dK) — a
+                # (j,c,k,l) order silently column-permutes the matrix
+                # and Newton diverges on strong-signal data
+                H = jnp.einsum("ij,ick,il->jclk", X_loc, M, X_loc) / sw
                 H = H.reshape(dK, dK) + rho * jnp.eye(dK, dtype=B.dtype)
                 step = jnp.linalg.solve(H, g.reshape(dK)).reshape(d, K)
                 B_new = B - step
