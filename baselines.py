@@ -266,6 +266,44 @@ def bl_gridsearch(budget):
                    "500-point bench grid"}
 
 
+SPECTRAL = dict(n=1_000_000, d=50, l=200, k=8)
+
+
+def _spectral_nystrom_seconds(n, d, l, k):
+    """sklearn's version of the same approximation bench.py runs on device:
+    Nystroem landmark features + KMeans on the map (exact sklearn
+    SpectralClustering is O(n²) memory — 8 TB at 1e6 rows — so the
+    approximate pipeline is the only feasible CPU baseline)."""
+    from sklearn.cluster import KMeans
+    from sklearn.datasets import make_blobs
+    from sklearn.kernel_approximation import Nystroem
+
+    X, _ = make_blobs(n_samples=n, n_features=d, centers=k,
+                      cluster_std=1.0, random_state=0)
+    X = X.astype(np.float32)
+    X = (X - X.mean(0)) / np.maximum(X.std(0), 1e-6)
+    t0 = time.perf_counter()
+    F = Nystroem(n_components=l, random_state=0).fit_transform(X)
+    KMeans(n_clusters=k, n_init=1, random_state=0).fit(F)
+    return time.perf_counter() - t0
+
+
+def bl_spectral(budget):
+    """Nystroem(200) + KMeans(8) on the 1e6x50 spectral config, probe-sized
+    to the budget like the other baselines (VERDICT r5 "What's missing" #2:
+    spectral_nystrom_1e6_fit was the last vs_baseline: null)."""
+    cfg = SPECTRAL
+    n_run, t, _ = _sized_run(
+        cfg["n"], 50_000,
+        lambda n: _spectral_nystrom_seconds(n, cfg["d"], cfg["l"], cfg["k"]),
+        budget)
+    return {"seconds": t, "n": n_run, "d": cfg["d"],
+            "n_components": cfg["l"], "k": cfg["k"], "full_n": cfg["n"],
+            "direct_full_size": n_run == cfg["n"],
+            "how": "sklearn Nystroem(n_components=200) fit_transform + "
+                   "KMeans(n_clusters=8, n_init=1)"}
+
+
 def bl_kdd(budget):
     """sklearn KMeans end-to-end on the SAME KDD matrix bench.py fits —
     full size, n_init=1 k-means++ (the reference's finishing config)."""
@@ -295,6 +333,7 @@ WORKLOADS = {
     "admm_blueprint": bl_admm_blueprint,
     "incremental": bl_incremental,
     "gridsearch": bl_gridsearch,
+    "spectral": bl_spectral,
     "kdd": bl_kdd,
 }
 

@@ -62,16 +62,21 @@ class _BigPartialFitMixin(BaseEstimator):
 
     @classmethod
     def _get_param_names(cls):
-        """Underlying estimator's params + the extra init kwargs — the same
-        MRO walk the reference performs (reference: _partial.py:84-96)."""
+        """Underlying estimator's params + the extra init kwargs.
+
+        Only the FIRST non-mixin base (the concrete sklearn estimator)
+        contributes: walking the whole MRO like the reference does
+        (reference: _partial.py:84-96) picks up constructor params of
+        sklearn-internal bases — e.g. ``BaseSGD.__init__``'s ``C`` —
+        that the public class rejects, which breaks ``clone()``."""
         bases = [
             base for base in cls.__mro__
             if not issubclass(base, _BigPartialFitMixin)
             and hasattr(base, "_get_param_names")
         ]
         params = set(cls._init_kwargs)
-        for base in bases:
-            params |= set(base._get_param_names())
+        if bases:
+            params |= set(bases[0]._get_param_names())
         return sorted(params)
 
     def fit(self, X, y=None, block_size: int = wrappers.DEFAULT_BLOCK_SIZE):

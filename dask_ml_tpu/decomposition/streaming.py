@@ -8,9 +8,13 @@ sufficient statistics (weighted count, column sums, Gram matrix — 4 MB at
 d=1000), then an eigendecomposition of the d×d covariance. One pass over
 the data, peak HBM = one block + the Gram, exact covariance PCA.
 
-``block_fn(b) -> (X_b, w_b)`` is traced inside the scan: it can regenerate
-blocks from a seed (nothing ever resident), pull host-pinned rows via
-``jax.pure_callback``, or slice a resident array (tests). Numerical note:
+``block_fn(b) -> (X_b, w_b)`` is either traced inside the scan — it can
+regenerate blocks from a seed (nothing ever resident) or slice a resident
+array (tests) — or a :class:`dask_ml_tpu.parallel.stream.HostBlockSource`
+streaming real host-resident blocks through the double-buffered transfer
+pipeline (block b+1's ``device_put`` overlaps block b's Gram matmul; both
+modes accumulate through one shared per-block step, so their moments are
+identical). Numerical note:
 the Gram squares the condition number, so tiny trailing eigenvalues carry
 ~cond²·eps relative error — the same regime where the in-memory exact path
 falls back to Householder. For the top-k components of tall-skinny data
@@ -29,29 +33,77 @@ import numpy as np
 __all__ = ["streamed_moments", "pca_fit_blocks"]
 
 
-@partial(jax.jit, static_argnames=("block_fn", "n_blocks"))
-def streamed_moments(*, block_fn, n_blocks):
-    """One scan over all blocks → ``(sw, sums, gram)``:
-    Σw, Σ w·x (d,), Σ w·xxᵀ (d, d) — f32 accumulation."""
+def _accumulate_block(carry, X_b, w_b):
+    """One block's moment update — the single implementation both
+    block-source modes run (traced scan and host-streamed driver)."""
+    sw, s, G = carry
+    Xw = X_b * w_b[:, None]
+    sw = sw + jnp.sum(w_b)
+    s = s + jnp.sum(Xw, axis=0)
+    G = G + jax.lax.dot_general(
+        Xw, X_b, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return sw, s, G
 
+
+def _moments_init(d):
+    return (jnp.asarray(0.0, jnp.float32), jnp.zeros((d,), jnp.float32),
+            jnp.zeros((d, d), jnp.float32))
+
+
+@partial(jax.jit, static_argnames=("block_fn", "n_blocks"))
+def _streamed_moments_device(*, block_fn, n_blocks):
     def body(carry, b):
-        sw, s, G = carry
         X_b, w_b = block_fn(b)
-        Xw = X_b * w_b[:, None]
-        sw = sw + jnp.sum(w_b)
-        s = s + jnp.sum(Xw, axis=0)
-        G = G + jax.lax.dot_general(
-            Xw, X_b, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return (sw, s, G), None
+        return _accumulate_block(carry, X_b, w_b), None
 
     shapes = jax.eval_shape(block_fn, jnp.asarray(0, jnp.int32))
-    d = shapes[0].shape[1]
-    init = (jnp.asarray(0.0, jnp.float32), jnp.zeros((d,), jnp.float32),
-            jnp.zeros((d, d), jnp.float32))
+    init = _moments_init(shapes[0].shape[1])
     (sw, s, G), _ = jax.lax.scan(
         body, init, jnp.arange(n_blocks, dtype=jnp.int32))
     return sw, s, G
+
+
+@partial(jax.jit, static_argnames=("transform",))
+def _moments_step(carry, blk, *, transform):
+    if transform is not None:
+        blk = transform(blk)
+    X_b, w_b = blk
+    return _accumulate_block(carry, X_b, w_b)
+
+
+def _streamed_moments_host(source):
+    """Host-driven accumulation over a ``HostBlockSource``: block b+1's
+    transfer overlaps block b's Gram matmul (depth = ``source.prefetch``;
+    0 = the strict serial overlap-off baseline)."""
+    from dask_ml_tpu.parallel.stream import prefetched_scan
+
+    d = source.out_struct[0].shape[1]
+
+    def step(carry, b, blk):
+        carry = _moments_step(carry, blk, transform=source.transform)
+        return carry, None
+
+    carry, _ = prefetched_scan(step, _moments_init(d), source)
+    return carry
+
+
+def streamed_moments(*, block_fn, n_blocks):
+    """One pass over all blocks → ``(sw, sums, gram)``:
+    Σw, Σ w·x (d,), Σ w·xxᵀ (d, d) — f32 accumulation. ``block_fn`` is a
+    traced callable (one compiled scan) or a
+    :class:`~dask_ml_tpu.parallel.stream.HostBlockSource` (double-buffered
+    host streaming); both run :func:`_accumulate_block` per block, so the
+    moments are identical across modes."""
+    from dask_ml_tpu.parallel.stream import HostBlockSource
+
+    if isinstance(block_fn, HostBlockSource):
+        if block_fn.n_blocks != int(n_blocks):
+            raise ValueError(
+                f"n_blocks={n_blocks} does not match the HostBlockSource's "
+                f"{block_fn.n_blocks} blocks")
+        return _streamed_moments_host(block_fn)
+    return _streamed_moments_device(block_fn=block_fn, n_blocks=int(n_blocks))
 
 
 @jax.jit

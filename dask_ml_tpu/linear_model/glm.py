@@ -42,6 +42,14 @@ def add_intercept(X):
     return jnp.concatenate([X, ones], axis=1)
 
 
+def _intercept_block(blk):
+    """Block-tuple intercept append for host-streamed fits. Module-level so
+    the streamed solver's per-block program (which keys its compile cache
+    on the transform's identity) compiles once across estimator fits."""
+    X_b, y_b, w_b = blk
+    return add_intercept(X_b), y_b, w_b
+
+
 class _GLM(BaseEstimator):
     """Shared GLM facade (reference: linear_model/glm.py:86-177)."""
 
@@ -239,10 +247,13 @@ class _GLM(BaseEstimator):
         """Fit from streamed row blocks — data larger than device memory.
 
         ``block_fn(b) -> (X_b, y_b, w_b)`` is a TRACED function producing
-        block ``b`` on device (regenerate from a seed, gather host-pinned
-        rows via ``jax.pure_callback``, or slice a resident array): one
-        block is resident at a time inside the solver's scan
-        (models/glm.py ``admm_streamed``). ``y_b`` must already be numeric
+        block ``b`` on device (regenerate from a seed, or slice a resident
+        array), or a :class:`dask_ml_tpu.parallel.stream.HostBlockSource`
+        streaming real host-resident blocks through the double-buffered
+        transfer pipeline: either way one block is resident at a time
+        inside the solver (models/glm.py ``admm_streamed``), and the two
+        modes take the same trajectory (shared per-block programs).
+        ``y_b`` must already be numeric
         — {0,1} for logistic (pass ``classes`` to fix ``classes_``), raw
         targets for linear/poisson. Requires ``solver='admm'``, the
         streamed consensus solver; blocks must NOT include an intercept
@@ -277,18 +288,33 @@ class _GLM(BaseEstimator):
         if self.fit_intercept:
             mask[-1] = 0.0
 
-        if self.fit_intercept:
+        from dask_ml_tpu.parallel.stream import HostBlockSource
+
+        if not self.fit_intercept:
+            wrapped = block_fn
+        elif isinstance(block_fn, HostBlockSource):
+            # the intercept append rides INSIDE the per-block compiled
+            # program (stable module-level transform identity keeps the
+            # compile cache warm across fits)
+            wrapped = block_fn.with_transform(_intercept_block)
+        else:
             def wrapped(b):
                 X_b, y_b, w_b = block_fn(b)
                 return add_intercept(X_b), y_b, w_b
-        else:
-            wrapped = block_fn
 
-        with profile_phase(logger, "glm-admm-streamed"):
-            beta, n_iter = core.admm_streamed(
-                wrapped, int(n_blocks), d,
-                float(n_samples if sw_total is None else sw_total),
-                jnp.asarray(mask), family=self.family, **kwargs)
+        try:
+            with profile_phase(logger, "glm-admm-streamed"):
+                beta, n_iter = core.admm_streamed(
+                    wrapped, int(n_blocks), d,
+                    float(n_samples if sw_total is None else sw_total),
+                    jnp.asarray(mask), family=self.family, **kwargs)
+        finally:
+            if wrapped is not block_fn and isinstance(wrapped,
+                                                      HostBlockSource):
+                # surface transfer accounting on the CALLER's source (the
+                # intercept wrap is a stats-reset copy)
+                block_fn.bytes_streamed += wrapped.bytes_streamed
+                block_fn.blocks_started += wrapped.blocks_started
         self.n_iter_ = int(n_iter)
         self._finalize_coef([np.asarray(beta)])
         if classes is not None:

@@ -1026,6 +1026,29 @@ def _normalize_n_jobs(n_jobs):
     return int(n_jobs)
 
 
+def _max_concurrent_device_jobs(n):
+    """Cap a device-dispatching worker pool for the active backend.
+
+    XLA:CPU's cross-module collectives (the psums every mesh-wide program
+    carries) DEADLOCK when two programs execute concurrently over the same
+    virtual device set: each launch's per-device participant threads
+    rendezvous keyed by (device set, op id), and interleaved launches from
+    a thread pool strand both runs waiting for the other's participants
+    (observed as indefinite hangs of the cell pool on the 8-virtual-device
+    test mesh; XLA logs "This thread has been waiting for 5000ms and may
+    be stuck"). Real accelerator backends serialize launches on each
+    device's stream, so the overlap this pool exists for — hiding the
+    ~100 ms host↔device round-trip per cell — is both safe and profitable
+    there. The cpu backend has no round-trip to hide, so concurrency buys
+    nothing and only carries the hazard: cap the pool at one worker."""
+    if n > 1:
+        import jax
+
+        if jax.default_backend() == "cpu":
+            return 1
+    return n
+
+
 class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
     """Shared driver for grid and randomized search
     (reference: _search.py:669-894 ``DaskBaseSearchCV``)."""
@@ -1094,7 +1117,8 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
             for ci in range(n_candidates)
             for si in range(n_splits)
         ]
-        n_workers = _normalize_n_jobs(self.n_jobs)
+        n_workers = _max_concurrent_device_jobs(
+            _normalize_n_jobs(self.n_jobs))
 
         # Batched-candidate fast path: bucket homogeneous candidates and let
         # the terminal estimator fit+score each bucket as one compiled
@@ -1271,7 +1295,8 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
                         tails = [_dispatch_group(j) for j in rests]
                     else:
                         with ThreadPoolExecutor(
-                            max_workers=min(8, len(rests))
+                            max_workers=_max_concurrent_device_jobs(
+                                min(8, len(rests)))
                         ) as pre_pool:
                             tails = list(
                                 pre_pool.map(_dispatch_group, rests))

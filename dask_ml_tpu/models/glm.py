@@ -44,7 +44,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from dask_ml_tpu.parallel.mesh import DATA_AXIS
+from dask_ml_tpu.parallel.mesh import DATA_AXIS, shard_map
 
 # ---------------------------------------------------------------------------
 # Families: pointwise loss ℓ(eta, y) and curvature h(eta, y) = ∂²ℓ/∂eta²
@@ -477,7 +477,7 @@ def _admm_impl(X, y, w, beta0, x0, u0, mask, lamduh, rho, abstol, reltol,
     d = X.shape[1]
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
                   P(), P(DATA_AXIS, None), P(DATA_AXIS, None),
@@ -637,7 +637,7 @@ def _admm_multinomial_impl(X, y_idx, w, z0, x0, u0, mask, lamduh, rho,
     dK = d * K
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
                   P(), P(DATA_AXIS, None, None), P(DATA_AXIS, None, None),
@@ -886,70 +886,86 @@ def batched_eval_scores(E, y, w, betas, *, family):
 # ---------------------------------------------------------------------------
 
 
+def _streamed_block_newton(X_b, y_b, w_b, x, z, u, rho, inner_tol, sw_total,
+                           *, family, inner_max_iter):
+    """One block's local Newton prox-solve — the SINGLE implementation both
+    streamed block-source modes run (traced ``block_fn`` scan and the
+    host-streamed ``HostBlockSource`` driver), which is what makes their
+    trajectories identical."""
+    loss_fn, hess_fn = FAMILIES[family]
+    d = z.shape[0]
+    dloss = jax.grad(lambda e: jnp.sum(loss_fn(e, y_b)))
+
+    def grad_eta(xx):
+        eta = X_b @ xx
+        g = X_b.T @ (w_b * dloss(eta)) / sw_total + rho * (xx - z + u)
+        return g, eta
+
+    def nt_cond(s):
+        _, g, _, it = s
+        return jnp.logical_and(it < inner_max_iter,
+                               jnp.max(jnp.abs(g)) > inner_tol)
+
+    def nt_body(s):
+        xx, g, eta, it = s
+        h = w_b * hess_fn(eta, y_b)
+        H = (X_b.T @ (h[:, None] * X_b)) / sw_total
+        H = H + rho * jnp.eye(d, dtype=xx.dtype)
+        xx_new = xx - jnp.linalg.solve(H, g)
+        g_new, eta_new = grad_eta(xx_new)
+        return xx_new, g_new, eta_new, it + 1
+
+    g0, eta0 = grad_eta(x)
+    xx, _, _, _ = lax.while_loop(
+        nt_cond, nt_body, (x, g0, eta0, jnp.asarray(0, jnp.int32)))
+    return xx
+
+
+def _streamed_consensus(z, x_new, u, mask, lamduh, rho, abstol, reltol,
+                        sw_total, *, regularizer):
+    """The streamed z-update + Boyd stopping, shared by both block-source
+    modes (identical to the sharded solver with n_shards → n_blocks)."""
+    _, pen_prox = _penalty(regularizer)
+    n_blocks, d = x_new.shape
+    lam_eff = lamduh / sw_total
+    zbar = jnp.mean(x_new + u, axis=0)
+    t = lam_eff / (rho * n_blocks)
+    z_new = jnp.where(mask > 0, pen_prox(zbar, t), zbar)
+    u_new = u + x_new - z_new
+    pri2 = jnp.sum((x_new - z_new) ** 2)
+    dual = rho * jnp.sqrt(float(n_blocks)) * jnp.linalg.norm(z_new - z)
+    eps_pri = (jnp.sqrt(float(n_blocks * d)) * abstol
+               + reltol * jnp.maximum(
+                   jnp.sqrt(jnp.sum(x_new * x_new)),
+                   jnp.sqrt(float(n_blocks)) * jnp.linalg.norm(z_new)))
+    eps_dual = (jnp.sqrt(float(n_blocks * d)) * abstol
+                + reltol * rho * jnp.sqrt(jnp.sum(u_new * u_new)))
+    done = jnp.logical_and(jnp.sqrt(pri2) < eps_pri, dual < eps_dual)
+    return z_new, u_new, done
+
+
 @partial(jax.jit, static_argnames=("block_fn", "n_blocks", "family",
                                    "regularizer", "max_iter",
                                    "inner_max_iter"))
 def _admm_streamed_impl(z0, x0, u0, mask, lamduh, rho, abstol, reltol,
                         inner_tol, sw_total, *, block_fn, n_blocks, family,
                         regularizer, max_iter, inner_max_iter):
-    loss_fn, hess_fn = FAMILIES[family]
-    _, pen_prox = _penalty(regularizer)
-    d = z0.shape[0]
-    lam_eff = lamduh / sw_total
-
-    def local_newton(X_b, y_b, w_b, x, z, u):
-        dloss = jax.grad(lambda e: jnp.sum(loss_fn(e, y_b)))
-
-        def grad_eta(xx):
-            eta = X_b @ xx
-            g = X_b.T @ (w_b * dloss(eta)) / sw_total + rho * (xx - z + u)
-            return g, eta
-
-        def nt_cond(s):
-            _, g, _, it = s
-            return jnp.logical_and(it < inner_max_iter,
-                                   jnp.max(jnp.abs(g)) > inner_tol)
-
-        def nt_body(s):
-            xx, g, eta, it = s
-            h = w_b * hess_fn(eta, y_b)
-            H = (X_b.T @ (h[:, None] * X_b)) / sw_total
-            H = H + rho * jnp.eye(d, dtype=xx.dtype)
-            xx_new = xx - jnp.linalg.solve(H, g)
-            g_new, eta_new = grad_eta(xx_new)
-            return xx_new, g_new, eta_new, it + 1
-
-        g0, eta0 = grad_eta(x)
-        xx, _, _, _ = lax.while_loop(
-            nt_cond, nt_body, (x, g0, eta0, jnp.asarray(0, jnp.int32)))
-        return xx
-
     def body(state):
         z, x, u, it, _ = state  # x, u: (B, d)
 
         def per_block(_, inp):
             b, x_b, u_b = inp
             X_b, y_b, w_b = block_fn(b)
-            return None, local_newton(X_b, y_b, w_b, x_b, z, u_b)
+            return None, _streamed_block_newton(
+                X_b, y_b, w_b, x_b, z, u_b, rho, inner_tol, sw_total,
+                family=family, inner_max_iter=inner_max_iter)
 
         _, x_new = lax.scan(
             per_block, None,
             (jnp.arange(n_blocks, dtype=jnp.int32), x, u))
-        zbar = jnp.mean(x_new + u, axis=0)
-        t = lam_eff / (rho * n_blocks)
-        z_new = jnp.where(mask > 0, pen_prox(zbar, t), zbar)
-        u_new = u + x_new - z_new
-        # Boyd stopping, identical to the sharded solver with
-        # n_shards → n_blocks
-        pri2 = jnp.sum((x_new - z_new) ** 2)
-        dual = rho * jnp.sqrt(float(n_blocks)) * jnp.linalg.norm(z_new - z)
-        eps_pri = (jnp.sqrt(float(n_blocks * d)) * abstol
-                   + reltol * jnp.maximum(
-                       jnp.sqrt(jnp.sum(x_new * x_new)),
-                       jnp.sqrt(float(n_blocks)) * jnp.linalg.norm(z_new)))
-        eps_dual = (jnp.sqrt(float(n_blocks * d)) * abstol
-                    + reltol * rho * jnp.sqrt(jnp.sum(u_new * u_new)))
-        done = jnp.logical_and(jnp.sqrt(pri2) < eps_pri, dual < eps_dual)
+        z_new, u_new, done = _streamed_consensus(
+            z, x_new, u, mask, lamduh, rho, abstol, reltol, sw_total,
+            regularizer=regularizer)
         return z_new, x_new, u_new, it + 1, done
 
     def cond(state):
@@ -959,6 +975,74 @@ def _admm_streamed_impl(z0, x0, u0, mask, lamduh, rho, abstol, reltol,
     init = (z0, x0, u0, jnp.asarray(0, jnp.int32), jnp.asarray(False))
     z, x, u, n_iter, done = lax.while_loop(cond, body, init)
     return z, n_iter, x, u, done
+
+
+@partial(jax.jit, static_argnames=("family", "inner_max_iter", "transform"))
+def _host_block_prox(blk, b, z, x, u, rho, inner_tol, sw_total, *,
+                     family, inner_max_iter, transform):
+    """One host-streamed block's prox-solve as a standalone program: the
+    block arrives as already-transferred device arrays, the per-block
+    primal/dual rows are sliced in-trace, and the (optional) source
+    transform — e.g. the facade's intercept append — fuses into the same
+    compiled program."""
+    if transform is not None:
+        blk = transform(blk)
+    X_b, y_b, w_b = blk
+    x_b = lax.dynamic_index_in_dim(x, b, keepdims=False)
+    u_b = lax.dynamic_index_in_dim(u, b, keepdims=False)
+    return _streamed_block_newton(
+        X_b, y_b, w_b, x_b, z, u_b, rho, inner_tol, sw_total,
+        family=family, inner_max_iter=inner_max_iter)
+
+
+@partial(jax.jit, static_argnames=("regularizer",))
+def _host_consensus(z, x_new, u, mask, lamduh, rho, abstol, reltol,
+                    sw_total, *, regularizer):
+    return _streamed_consensus(z, x_new, u, mask, lamduh, rho, abstol,
+                               reltol, sw_total, regularizer=regularizer)
+
+
+def _admm_streamed_host(source, z0, x0, u0, mask, lamduh, rho, abstol,
+                        reltol, inner_tol, sw_total, *, check_done, family,
+                        regularizer, max_iter, inner_max_iter):
+    """Host-driven outer loop over a :class:`HostBlockSource`: block ``b+1``
+    transfers (and, across the epoch boundary, block 0 of the next outer
+    iteration) while block ``b``'s Newton prox-solve runs. Same math as
+    :func:`_admm_streamed_impl` — both modes call
+    :func:`_streamed_block_newton` / :func:`_streamed_consensus`.
+
+    ``check_done`` fetches the Boyd convergence flag once per outer
+    iteration (one scalar round-trip); the caller disables it when both
+    tolerances are exactly 0, keeping the zero-tolerance bench/equivalence
+    runs free of per-iteration syncs."""
+    from dask_ml_tpu.parallel.stream import prefetched_scan
+
+    n_blocks = int(x0.shape[0])
+    z, x, u = z0, x0, u0
+    done = jnp.asarray(False)
+    n_iter = 0
+    b32 = [jnp.asarray(b, jnp.int32) for b in range(n_blocks)]
+
+    def step(carry, b, blk):
+        z, x, u = carry
+        x_b = _host_block_prox(
+            blk, b32[b], z, x, u, rho, inner_tol, sw_total,
+            family=family, inner_max_iter=inner_max_iter,
+            transform=source.transform)
+        return carry, x_b
+
+    for it in range(max_iter):
+        _, xs = prefetched_scan(step, (z, x, u), source,
+                                wrap=it + 1 < max_iter)
+        x = jnp.stack(xs)
+        z, u, done = _host_consensus(
+            z, x, u, mask, lamduh, rho, abstol, reltol, sw_total,
+            regularizer=regularizer)
+        n_iter = it + 1
+        if check_done and bool(done):
+            break
+    source.discard_inflight()
+    return z, jnp.asarray(n_iter, jnp.int32), x, u, done
 
 
 def admm_streamed(block_fn, n_blocks, d, sw_total, mask=None, *,
@@ -977,19 +1061,31 @@ def admm_streamed(block_fn, n_blocks, d, sw_total, mask=None, *,
     (VERDICT r3 #3: the blueprint's 1e8×100 ADMM config is 40 GB, over a
     single chip's HBM).
 
-    ``block_fn`` is traced: it can REGENERATE blocks from a seed (synthetic
-    benchmarks; nothing ever resident), gather a block's rows from host
-    memory via ``jax.pure_callback`` (host-pinned streaming), or slice a
-    resident array (testing). The consensus math is identical to the
-    sharded solver with blocks standing in for shards, so B streamed
-    blocks and a B-shard mesh produce the same trajectory. ``sw_total`` is
-    the total sample weight over ALL blocks (= n for unit weights),
-    fixing the objective's 1/SW normalization without a pre-pass.
+    ``block_fn`` is either TRACED or a HOST BLOCK SOURCE:
+
+    - a traced callable REGENERATES blocks on device (synthetic
+      benchmarks; nothing ever resident) or slices a resident array
+      (testing) inside the compiled scan;
+    - a :class:`dask_ml_tpu.parallel.stream.HostBlockSource` streams real
+      host-resident blocks through a depth-``source.prefetch``
+      double-buffered pipeline — the async ``device_put`` of block b+1
+      overlaps block b's inner Newton solve instead of serializing inside
+      the scan body (see ``parallel/stream.py`` for why a host-driven
+      outer loop beats ``io_callback``-fed buffers here).
+
+    The consensus math is identical to the sharded solver with blocks
+    standing in for shards, so B streamed blocks and a B-shard mesh
+    produce the same trajectory — in BOTH block-source modes, which share
+    one per-block implementation (:func:`_streamed_block_newton`).
+    ``sw_total`` is the total sample weight over ALL blocks (= n for unit
+    weights), fixing the objective's 1/SW normalization without a
+    pre-pass.
 
     Returns ``(z, n_iter)``; with ``return_state=True``:
     ``(z, n_iter, (z, x, u), done)`` — the same checkpointable carry
     contract as :func:`admm`, with x/u stacked ``(n_blocks, d)``.
     """
+    from dask_ml_tpu.parallel.stream import HostBlockSource
     if state is None:
         z0 = jnp.zeros((d,), dtype)
         x0 = jnp.zeros((n_blocks, d), dtype)
@@ -1006,11 +1102,24 @@ def admm_streamed(block_fn, n_blocks, d, sw_total, mask=None, *,
         mask = jnp.ones((d,), dtype)
     scalars = [jnp.asarray(v, dtype) for v in (lamduh, rho, abstol, reltol,
                                                inner_tol, sw_total)]
-    z, n_iter, x, u, done = _admm_streamed_impl(
-        z0, x0, u0, jnp.asarray(mask, dtype), *scalars,
-        block_fn=block_fn, n_blocks=int(n_blocks), family=family,
-        regularizer=regularizer, max_iter=int(max_iter),
-        inner_max_iter=int(inner_max_iter))
+    if isinstance(block_fn, HostBlockSource):
+        if block_fn.n_blocks != int(n_blocks):
+            raise ValueError(
+                f"n_blocks={n_blocks} does not match the HostBlockSource's "
+                f"{block_fn.n_blocks} blocks")
+        lam_d, rho_d, abstol_d, reltol_d, tol_d, sw_d = scalars
+        z, n_iter, x, u, done = _admm_streamed_host(
+            block_fn, z0, x0, u0, jnp.asarray(mask, dtype), lam_d, rho_d,
+            abstol_d, reltol_d, tol_d, sw_d,
+            check_done=(float(abstol) != 0.0 or float(reltol) != 0.0),
+            family=family, regularizer=regularizer, max_iter=int(max_iter),
+            inner_max_iter=int(inner_max_iter))
+    else:
+        z, n_iter, x, u, done = _admm_streamed_impl(
+            z0, x0, u0, jnp.asarray(mask, dtype), *scalars,
+            block_fn=block_fn, n_blocks=int(n_blocks), family=family,
+            regularizer=regularizer, max_iter=int(max_iter),
+            inner_max_iter=int(inner_max_iter))
     if return_state:
         return z, n_iter, (z, x, u), done
     return z, n_iter

@@ -66,7 +66,7 @@ def _tsqr_householder_impl(X, *, mesh):
     n_shards = mesh.shape[DATA_AXIS]
 
     @partial(
-        jax.shard_map,
+        mesh_lib.shard_map,
         mesh=mesh,
         in_specs=P(DATA_AXIS, None),
         out_specs=(P(DATA_AXIS, None), P()),

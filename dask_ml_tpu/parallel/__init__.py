@@ -29,6 +29,10 @@ from dask_ml_tpu.parallel.sharding import (  # noqa: F401
     shard_rows,
     unpad_rows,
 )
+from dask_ml_tpu.parallel.stream import (  # noqa: F401
+    HostBlockSource,
+    prefetched_scan,
+)
 
 # runtime (multi-host bootstrap) is imported lazily by users that need it:
 #   from dask_ml_tpu.parallel import runtime; runtime.initialize(...)

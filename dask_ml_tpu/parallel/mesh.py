@@ -26,6 +26,27 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` across jax versions.
+
+    Newer jax exposes ``jax.shard_map`` directly (with the vma checker
+    controlled by ``check_vma``); on older releases the same transform
+    lives at ``jax.experimental.shard_map.shard_map``, whose ``check_rep``
+    replication checker predates the vma machinery and rejects collectives
+    inside ``lax.while_loop`` bodies — every solver here keeps its whole
+    optimization loop on device, so the checker is disabled on that path
+    (the new-API path keeps its own vma checks)."""
+    native = getattr(jax, "shard_map", None)
+    if native is not None:
+        kwargs = {} if check_vma is None else {"check_vma": check_vma}
+        return native(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kwargs)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=False)
+
 _lock = threading.Lock()
 _default_mesh: Optional[Mesh] = None
 _mesh_stack: list[Mesh] = []

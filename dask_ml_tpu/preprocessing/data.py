@@ -364,8 +364,15 @@ class Categorizer(BaseEstimator, TransformerMixin):
             categories = dict(self.categories)
         else:
             if self.columns is None:
-                columns = X.select_dtypes(
-                    include=["object", "str", "category"]).columns
+                try:
+                    columns = X.select_dtypes(
+                        include=["object", "str", "category"]).columns
+                except TypeError:
+                    # pandas < 3 maps "str" to the rejected numpy str_
+                    # dtype (the dedicated str dtype doesn't exist yet);
+                    # object covers strings there
+                    columns = X.select_dtypes(
+                        include=["object", "category"]).columns
             else:
                 columns = pd.Index(self.columns)
             categories = {}

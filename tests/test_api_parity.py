@@ -77,6 +77,7 @@ def test_reference_surface_resolves(module):
     assert not missing, f"{module} missing: {missing}"
 
 
+@pytest.mark.slow  # ~65s: one fresh interpreter per subpackage
 def test_every_subpackage_imports_first_in_fresh_process():
     """Each public module must import as the FIRST dask_ml_tpu import of a
     process. pytest imports everything through conftest in one order, which

@@ -14,6 +14,7 @@ import sys
 import textwrap
 
 import numpy as np
+import pytest
 
 WORKER = textwrap.dedent("""
     import sys
@@ -122,6 +123,12 @@ def test_two_process_runtime(tmp_path):
             if p.poll() is None:
                 p.kill()
                 p.wait()
+    if any("aren't implemented on the CPU backend" in out for out in outs):
+        # this jaxlib's CPU backend has no cross-process collectives at
+        # all — the capability under test cannot exist here; newer
+        # jaxlibs (which CI installs) run it for real
+        pytest.skip("this jaxlib's CPU backend lacks multiprocess "
+                    "collectives")
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {pid} failed:\n{out[-2000:]}"
         assert f"proc {pid}: ok" in out

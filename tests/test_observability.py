@@ -73,6 +73,7 @@ def test_profile_phase_logs_and_annotates(caplog):
     assert any("unit-test-phase" in r.getMessage() for r in caplog.records)
 
 
+@pytest.mark.slow  # ~20s: starts/stops a full jax.profiler trace capture
 def test_profile_phase_captures_trace(tmp_path, monkeypatch):
     import jax
     import jax.numpy as jnp

@@ -15,6 +15,7 @@ import jax.numpy as jnp
 
 from dask_ml_tpu.models import glm as glm_core
 from dask_ml_tpu.parallel.sharding import prepare_data
+from dask_ml_tpu.parallel.stream import HostBlockSource
 
 
 def _problem(n=640, d=6, seed=0):
@@ -25,8 +26,15 @@ def _problem(n=640, d=6, seed=0):
     return X, y
 
 
+def _host_source(X, y, n_blocks, **kw):
+    return HostBlockSource(
+        (X, y, np.ones(len(X), np.float32)), n_blocks, **kw)
+
+
 def test_streamed_admm_matches_sharded(mesh8):
-    """8 streamed blocks == 8 mesh shards: identical consensus math."""
+    """8 streamed blocks == 8 mesh shards: identical consensus math, in
+    BOTH block-source modes (traced device slices and host-streamed
+    HostBlockSource, with and without prefetch)."""
     X, y = _problem()
     n, d = X.shape
     data = prepare_data(X, y=y, mesh=mesh8)
@@ -51,6 +59,27 @@ def test_streamed_admm_matches_sharded(mesh8):
     assert int(n_iter) == 8
     np.testing.assert_allclose(np.asarray(z_stream), np.asarray(z_shard),
                                rtol=1e-4, atol=1e-5)
+
+    # host-streamed source: same blocks, same math — the two modes share
+    # one per-block implementation but compile it into different programs
+    # (scan-inlined vs standalone), so equality is asserted to a tight
+    # float tolerance (bit-identical on the CPU test mesh in practice);
+    # prefetch depth must not change values
+    for prefetch in (2, 0):
+        src = _host_source(X, y, 8, prefetch=prefetch)
+        z_host, n_iter_h = glm_core.admm_streamed(
+            src, 8, d, float(n), mask, max_iter=8, **kw)
+        assert int(n_iter_h) == 8
+        np.testing.assert_allclose(np.asarray(z_host),
+                                   np.asarray(z_stream),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_streamed_admm_host_source_validation():
+    X, y = _problem(n=320, d=4)
+    src = _host_source(X, y, 8)
+    with pytest.raises(ValueError, match="does not match"):
+        glm_core.admm_streamed(src, 4, 4, 320.0, max_iter=2)
 
 
 def test_streamed_admm_converges_and_masks_intercept():
@@ -78,31 +107,68 @@ def test_streamed_admm_converges_and_masks_intercept():
     assert agree > 0.97, agree
 
 
-def test_streamed_admm_state_roundtrip():
-    """Chunked streamed runs thread (z, x, u) exactly like the sharded
-    solver's checkpoint contract."""
+@pytest.mark.parametrize("mode", ["device", "host"])
+def test_streamed_admm_state_roundtrip(mode):
+    """Checkpoint/resume: a run chunked through (z, x, u) state takes the
+    SAME trajectory as an uninterrupted run — for both the
+    device-generated (traced) and the host-streamed block source."""
     X, y = _problem(n=320, d=4, seed=2)
     n, d = X.shape
     Xd, yd = jnp.asarray(X), jnp.asarray(y)
     rows = n // 4
 
-    def block_fn(b):
+    def device_blocks(b):
         Xb = jax.lax.dynamic_slice_in_dim(Xd, b * rows, rows, axis=0)
         yb = jax.lax.dynamic_slice_in_dim(yd, b * rows, rows, axis=0)
         return Xb, yb, jnp.ones((rows,), jnp.float32)
 
+    def source():
+        return (device_blocks if mode == "device"
+                else _host_source(X, y, 4))
+
     kw = dict(family="logistic", regularizer="l1", lamduh=0.3,
               abstol=0.0, reltol=0.0)
     z_full, _, _, _ = glm_core.admm_streamed(
-        block_fn, 4, d, float(n), max_iter=9, return_state=True, **kw)
+        source(), 4, d, float(n), max_iter=9, return_state=True, **kw)
 
     state = None
     for _ in range(3):
-        z, _, state, _done = glm_core.admm_streamed(
-            block_fn, 4, d, float(n), max_iter=3, state=state,
+        z, n_iter, state, _done = glm_core.admm_streamed(
+            source(), 4, d, float(n), max_iter=3, state=state,
             return_state=True, **kw)
+        assert int(n_iter) == 3
     np.testing.assert_allclose(np.asarray(z), np.asarray(z_full),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_streamed_admm_state_crosses_block_source_modes():
+    """The (z, x, u) carry is mode-agnostic: a run interrupted in traced
+    mode resumes in host-streamed mode, and the combined trajectory
+    matches the uninterrupted host-streamed run to float tolerance
+    (both modes share one per-block implementation)."""
+    X, y = _problem(n=320, d=4, seed=5)
+    n, d = X.shape
+    Xd, yd = jnp.asarray(X), jnp.asarray(y)
+    rows = n // 4
+
+    def device_blocks(b):
+        Xb = jax.lax.dynamic_slice_in_dim(Xd, b * rows, rows, axis=0)
+        yb = jax.lax.dynamic_slice_in_dim(yd, b * rows, rows, axis=0)
+        return Xb, yb, jnp.ones((rows,), jnp.float32)
+
+    kw = dict(family="logistic", regularizer="l2", lamduh=0.5,
+              abstol=0.0, reltol=0.0)
+    z_full, _, _, _ = glm_core.admm_streamed(
+        _host_source(X, y, 4), 4, d, float(n), max_iter=8,
+        return_state=True, **kw)
+
+    _, _, state, _ = glm_core.admm_streamed(
+        device_blocks, 4, d, float(n), max_iter=5, return_state=True, **kw)
+    z, _, _, _ = glm_core.admm_streamed(
+        _host_source(X, y, 4), 4, d, float(n), max_iter=3, state=state,
+        return_state=True, **kw)
+    np.testing.assert_allclose(np.asarray(z), np.asarray(z_full),
+                               rtol=1e-5, atol=1e-6)
 
 
 def test_streamed_pca_matches_in_memory():
@@ -162,6 +228,79 @@ def test_streamed_pca_weighted_blocks():
     np.testing.assert_allclose(est.mean_, oracle.mean_, rtol=1e-4, atol=1e-4)
     np.testing.assert_allclose(est.explained_variance_,
                                oracle.explained_variance_, rtol=1e-3)
+
+
+def test_streamed_pca_host_source_matches_device():
+    """streamed_moments over a HostBlockSource == the traced-scan moments
+    (shared per-block accumulate), and pca_fit_blocks accepts the source
+    directly."""
+    from dask_ml_tpu.decomposition.streaming import (pca_fit_blocks,
+                                                     streamed_moments)
+
+    rng = np.random.RandomState(0)
+    n, d, k = 2000, 12, 4
+    X = (rng.randn(n, 5) @ rng.randn(5, d)).astype(np.float32) + 3.0
+    w = np.ones(n, np.float32)
+    Xd = jnp.asarray(X)
+    rows = n // 8
+
+    def block_fn(b):
+        Xb = jax.lax.dynamic_slice_in_dim(Xd, b * rows, rows, axis=0)
+        return Xb, jnp.ones((rows,), jnp.float32)
+
+    m_dev = streamed_moments(block_fn=block_fn, n_blocks=8)
+    for prefetch in (2, 0):
+        src = HostBlockSource((X, w), 8, prefetch=prefetch)
+        m_host = streamed_moments(block_fn=src, n_blocks=8)
+        for a, b in zip(m_dev, m_host):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-5)
+
+    with pytest.raises(ValueError, match="does not match"):
+        streamed_moments(block_fn=HostBlockSource((X, w), 8), n_blocks=4)
+
+    est = pca_fit_blocks(HostBlockSource((X, w), 8), 8, k)
+    from dask_ml_tpu.decomposition import PCA
+
+    oracle = PCA(n_components=k, svd_solver="tsqr").fit(X)
+    np.testing.assert_allclose(est.mean_, oracle.mean_, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(est.explained_variance_,
+                               oracle.explained_variance_, rtol=1e-3)
+
+
+def test_facade_fit_blocks_host_source(mesh8):
+    """LogisticRegression.fit_blocks over a HostBlockSource: the intercept
+    rides in as a device-side block transform, and the fit matches the
+    traced-block fit of the same data to float tolerance."""
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    X, y = _problem(n=640, d=5, seed=3)
+    n, d = X.shape
+    Xd, yd = jnp.asarray(X), jnp.asarray(y)
+    rows = n // 8
+
+    def block_fn(b):
+        Xb = jax.lax.dynamic_slice_in_dim(Xd, b * rows, rows, axis=0)
+        yb = jax.lax.dynamic_slice_in_dim(yd, b * rows, rows, axis=0)
+        return Xb, yb, jnp.ones((rows,), jnp.float32)
+
+    traced = LogisticRegression(solver="admm", C=1.0, max_iter=40)
+    traced.fit_blocks(block_fn, 8, n, d, classes=[0, 1])
+
+    host = LogisticRegression(solver="admm", C=1.0, max_iter=40)
+    host.fit_blocks(_host_source(X, y, 8), 8, n, d, classes=[0, 1])
+
+    np.testing.assert_allclose(host.coef_, traced.coef_,
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(host.intercept_, traced.intercept_,
+                               rtol=1e-5, atol=1e-6)
+    assert host.score(X, y) > 0.9
+    # the caller's source is untouched (the facade wraps a COPY with the
+    # intercept transform)
+    src = _host_source(X, y, 8)
+    LogisticRegression(solver="admm", max_iter=5).fit_blocks(
+        src, 8, n, d, classes=[0, 1])
+    assert src.transform is None
 
 
 def test_facade_fit_blocks_matches_in_memory_fit(mesh8):
