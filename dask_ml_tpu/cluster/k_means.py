@@ -108,6 +108,7 @@ class KMeans(TransformerMixin, BaseEstimator):
             init=self.init,
             oversampling_factor=self.oversampling_factor,
             max_iter=self.init_max_iter,
+            mesh=data.mesh,
         )
         t_init = tic()
         logger.info("init (%s) finished in %.2fs", self.init, t_init - t0)
@@ -328,7 +329,8 @@ def k_init(X, n_clusters, init="k-means||", random_state=None, max_iter=None,
     data, key = _staged_for_init(X, random_state)
     return np.asarray(core.k_init(
         data.X, data.weights, data.n, int(n_clusters), key, init=init,
-        oversampling_factor=oversampling_factor, max_iter=max_iter))
+        oversampling_factor=oversampling_factor, max_iter=max_iter,
+        mesh=data.mesh))
 
 
 def init_scalable(X, n_clusters, random_state=None, max_iter=None,
@@ -337,7 +339,8 @@ def init_scalable(X, n_clusters, random_state=None, max_iter=None,
     data, key = _staged_for_init(X, random_state)
     return np.asarray(core.init_scalable(
         data.X, data.weights, data.n, int(n_clusters), key,
-        oversampling_factor=oversampling_factor, max_iter=max_iter))
+        oversampling_factor=oversampling_factor, max_iter=max_iter,
+        mesh=data.mesh))
 
 
 def init_random(X, n_clusters, random_state=None):

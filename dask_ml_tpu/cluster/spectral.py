@@ -136,16 +136,23 @@ class SpectralClustering(BaseEstimator, ClusterMixin):
         # callable per fit would leak a static jit-cache entry each time.
         params_t = tuple(sorted(params.items()))
         if callable(self.affinity):
-            V2, S_A = _nystrom_eager(
+            V2, S_A, Xk, ext = _nystrom_eager(
                 Xs, jnp.asarray(keep), n_valid, float(n),
                 self.affinity, params, k)
         else:
-            V2, S_A = _nystrom_program(
+            V2, S_A, Xk, ext = _nystrom_program(
                 Xs, jnp.asarray(keep),
                 jnp.asarray(n_valid, jnp.int32),
                 jnp.asarray(float(n), jnp.float32),
                 metric=self.affinity, params_t=params_t, k=k)
         U2 = unpad_rows(V2, n_valid)  # device, original row order
+
+        # persist the Nyström extension state (landmarks + degree/eigenmap
+        # factors, all small) so predict() can map NEW rows through the
+        # same Eq. 16 extension and assign them to the fitted centers
+        self._landmarks_ = np.asarray(Xk)
+        self._extension_ = tuple(np.asarray(e) for e in ext)
+        self._n_fit_rows_ = float(n)
 
         logger.info("k-means for assign_labels [starting]")
         if isinstance(km, KMeans):
@@ -162,6 +169,57 @@ class SpectralClustering(BaseEstimator, ClusterMixin):
     def fit_predict(self, X, y=None):
         self.fit(X)
         return self.labels_
+
+    def predict(self, X):
+        """Labels for NEW rows via the Nyström landmark-assignment path:
+        kernel strip against the fitted landmarks, the same Eq. 16
+        extension the fit used (:func:`_nystrom_extend` — training rows
+        re-extend to their fit embedding exactly), then nearest-center
+        assignment through the fused distance-reduction family
+        (ops/fused_distance.py). The reference's SpectralClustering has no
+        out-of-sample story at all; Nyström gives one for free."""
+        if not hasattr(self, "assign_labels_"):
+            raise AttributeError("Model not fitted; call fit first")
+        X = check_array(X)
+        Xs, n_valid = shard_rows(X)
+        Xk = jnp.asarray(self._landmarks_)
+        ainv_colsum, d1_si, map_k = (
+            jnp.asarray(e) for e in self._extension_)
+        l = int(self.n_components)
+        scale = jnp.asarray(
+            np.sqrt(l / self._n_fit_rows_), jnp.float32)
+
+        params = dict(self.kernel_params or {})
+        params["gamma"] = self.gamma
+        params["degree"] = self.degree
+        params["coef0"] = self.coef0
+
+        km = self.assign_labels_
+        if isinstance(km, KMeans) and not callable(self.affinity):
+            from dask_ml_tpu.parallel.mesh import default_mesh
+
+            labels = _nystrom_assign_program(
+                Xs, Xk, ainv_colsum, d1_si, map_k, scale,
+                jnp.asarray(km.cluster_centers_),
+                metric=self.affinity,
+                params_t=tuple(sorted(params.items())),
+                mesh=default_mesh())
+            return np.asarray(
+                unpad_rows(labels, n_valid)).astype(np.int32)
+        # callable metrics run their kernel strip eagerly (same reasoning
+        # as _nystrom_eager); foreign estimators assign on host
+        if callable(self.affinity):
+            C = jnp.asarray(self.affinity(Xs, replicate(Xk), **params))
+        else:
+            C = pairwise_kernels(Xs, Xk, metric=self.affinity, **params)
+        V = _nystrom_extend_jit(C, ainv_colsum, d1_si, map_k, scale)
+        V = unpad_rows(V, n_valid)
+        if isinstance(km, KMeans):
+            from dask_ml_tpu.models.kmeans import predict_labels
+
+            return np.asarray(predict_labels(
+                V, jnp.asarray(km.cluster_centers_))).astype(np.int32)
+        return np.asarray(km.predict(np.asarray(V)))
 
 
 @partial(jax.jit, static_argnames=("metric", "params_t", "k"))
@@ -188,30 +246,49 @@ def _nystrom_program(Xs, keep_idx, n_valid, n_true, *, metric, params_t,
     compile. ``metric`` (a kernel NAME — callables take
     :func:`_nystrom_eager` instead) and the kernel params are static.
     Returns ``(V2 (n_pad, k) sharded row-normalized embedding, S_A
-    singular values)``.
+    singular values, Xk landmarks, extension factors)``.
     """
     params = dict(params_t)
     Xk = jnp.take(Xs, keep_idx, axis=0)  # (l, d), replicated by GSPMD
     A = pairwise_kernels(Xk, Xk, metric=metric, **params)
     C = pairwise_kernels(Xs, Xk, metric=metric, **params)
-    return _nystrom_core(A, C, keep_idx, n_valid, n_true, k)
+    V2, S_A, ext = _nystrom_core(A, C, keep_idx, n_valid, n_true, k)
+    return V2, S_A, Xk, ext
+
+
+def _nystrom_extend(C, ainv_colsum, d1_si, map_k, scale):
+    """Map a kernel strip ``C = K(rows, landmarks)`` through the fitted
+    Nyström machinery: approximate degree, unified normalization, the
+    Eq. 16 eigenmap, row normalization. ONE definition used for the
+    training rows (:func:`_nystrom_core`) and for out-of-sample rows
+    (:meth:`SpectralClustering.predict`) — training-row re-extension
+    reproduces the fit embedding exactly."""
+    d_row = C @ ainv_colsum  # approximate row degrees
+    d_si = 1.0 / jnp.sqrt(jnp.maximum(d_row, 1e-12))
+    C2 = d_si[:, None] * C * d1_si[None, :]
+    V = scale * (C2 @ map_k)
+    # Row-normalize (Eq. 4, reference: spectral.py:266).
+    return V / jnp.maximum(jnp.linalg.norm(V, axis=1, keepdims=True), 1e-12)
 
 
 def _nystrom_core(A, C, keep_idx, n_valid, n_true, k: int):
     """The post-kernel Nyström math (degree normalization, eigensolve,
     Eq. 16, row normalization) — ONE definition shared by the fully-jitted
-    string-metric program and the eager callable-metric path."""
+    string-metric program and the eager callable-metric path. Returns the
+    embedding, the singular values, and the extension factors
+    ``(ainv_colsum, d1_si, map_k)`` that :func:`_nystrom_extend` needs to
+    map further rows into the same embedding."""
     row_valid = jnp.arange(C.shape[0]) < n_valid
     C = jnp.where(row_valid[:, None], C, 0.0)  # padding rows drop out
 
     colsum = C.sum(0)  # (l,) = a + b1: column degree over ALL rows
     A_inv = jnp.linalg.pinv(A)
-    d_all = C @ (A_inv @ colsum)  # (n_pad,) approximate row degrees
+    ainv_colsum = A_inv @ colsum  # (l,) degree functional
+    d_all = C @ ainv_colsum  # (n_pad,) approximate row degrees
     d_si = 1.0 / jnp.sqrt(jnp.maximum(d_all, 1e-12))
     d1_si = jnp.take(d_si, keep_idx)  # keep rows' exact a+b1 degrees
 
     A2 = d1_si[:, None] * A * d1_si[None, :]
-    C2 = d_si[:, None] * C * d1_si[None, :]  # (n_pad, l) sharded
 
     # Small replicated eigensolve (reference: delayed scipy svd,
     # spectral.py:248-252).
@@ -221,15 +298,13 @@ def _nystrom_core(A, C, keep_idx, n_valid, n_true, k: int):
     # applied uniformly (C2's keep rows ARE A2's rows).
     map_k = U_A[:, :k] * (1.0 / jnp.sqrt(S_A[:k]))[None, :]
     l_count = keep_idx.shape[0]
-    V2 = jnp.sqrt(l_count / n_true) * (C2 @ map_k)  # (n_pad, k) sharded
-
-    # Row-normalize (Eq. 4, reference: spectral.py:266).
-    V2 = V2 / jnp.maximum(
-        jnp.linalg.norm(V2, axis=1, keepdims=True), 1e-12)
-    return V2, S_A
+    scale = jnp.sqrt(l_count / n_true)
+    V2 = _nystrom_extend(C, ainv_colsum, d1_si, map_k, scale)
+    return V2, S_A, (ainv_colsum, d1_si, map_k)
 
 
 _nystrom_core_jit = partial(jax.jit, static_argnames=("k",))(_nystrom_core)
+_nystrom_extend_jit = jax.jit(_nystrom_extend)
 
 
 def _nystrom_eager(Xs, keep_idx, n_valid: int, n_true: float, metric,
@@ -241,9 +316,27 @@ def _nystrom_eager(Xs, keep_idx, n_valid: int, n_true: float, metric,
     Xk = replicate(jnp.take(Xs, keep_idx, axis=0))
     A = jnp.asarray(metric(Xk, Xk, **params))
     C = jnp.asarray(metric(Xs, Xk, **params))
-    return _nystrom_core_jit(
+    V2, S_A, ext = _nystrom_core_jit(
         A, C, keep_idx, jnp.asarray(n_valid, jnp.int32),
         jnp.asarray(n_true, jnp.float32), k=k)
+    return V2, S_A, Xk, ext
+
+
+@partial(jax.jit, static_argnames=("metric", "params_t", "mesh"))
+def _nystrom_assign_program(Xs, Xk, ainv_colsum, d1_si, map_k, scale,
+                            centers, *, metric, params_t, mesh):
+    """Out-of-sample Nyström landmark assignment as ONE jitted program:
+    kernel strip against the fitted landmarks, the Eq. 16 extension, and
+    the nearest-center assignment — the last step routed through the
+    fused distance-reduction family (ops/fused_distance.py), so at the
+    1e6+-row scale this path is built for no (n × k) distance matrix is
+    materialized between the embedding and its labels."""
+    from dask_ml_tpu.ops.fused_distance import fused_argmin_min
+
+    C = pairwise_kernels(Xs, Xk, metric=metric, **dict(params_t))
+    V = _nystrom_extend(C, ainv_colsum, d1_si, map_k, scale)
+    labels, _ = fused_argmin_min(V, centers, mesh=mesh)
+    return labels
 
 
 def embed(X_keep, X_rest, n_components, metric, kernel_params):

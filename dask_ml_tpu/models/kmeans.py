@@ -33,7 +33,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dask_ml_tpu.ops.pairwise import sq_euclidean
+from dask_ml_tpu.ops.fused_distance import (
+    fused_argmin_min,
+    fused_argmin_weight,
+    fused_rowwise_min,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -44,10 +48,10 @@ logger = logging.getLogger(__name__)
 
 
 def _assign(X, w, centers):
-    """Fused assignment: labels, weighted min-distances, inertia."""
-    d2 = sq_euclidean(X, centers)
-    labels = jnp.argmin(d2, axis=1)
-    mind = jnp.min(d2, axis=1)
+    """Fused assignment: labels, weighted min-distances, inertia — routed
+    through the fused distance-reduction family (ops/fused_distance.py),
+    the single implementation of the distance+reduce idiom."""
+    labels, mind = fused_argmin_min(X, centers)
     inertia = jnp.sum(mind * w)
     return labels, mind, inertia
 
@@ -417,8 +421,7 @@ def compute_inertia(X, w, centers):
 
 @jax.jit
 def predict_labels(X, centers):
-    d2 = sq_euclidean(X, centers)
-    return jnp.argmin(d2, axis=1)
+    return fused_argmin_min(X, centers)[0]
 
 
 @jax.jit
@@ -687,9 +690,13 @@ def _init_seed_phase(X, w, k0, *, max_rounds: int, max_cand: int):
 
 
 def _init_rounds_phase(X, w, l, cand, mind0, n_rounds, key, *,
-                       max_rounds: int, max_cand: int, cap: int):
+                       max_rounds: int, max_cand: int, cap: int,
+                       mesh=None, kernel: str = "auto"):
     """k-means|| phase 2 — the sampling rounds (incremental min-distance
-    maintenance + top_k index packing; see :func:`_init_scalable_device`)."""
+    maintenance + top_k index packing; see :func:`_init_scalable_device`).
+    The per-round distance+mask+min against the new rows routes through
+    the fused family — on TPU the (n × cap) distance block never reaches
+    HBM (``kernel='auto'`` dispatch, ops/fused_distance.py)."""
     n_padded = X.shape[0]
     cap_iota = jnp.arange(cap)
 
@@ -712,11 +719,12 @@ def _init_rounds_phase(X, w, l, cand, mind0, n_rounds, key, *,
         ok = cap_iota < count
         slots = jnp.where(ok, n_cand + cap_iota, max_cand)  # OOB → dropped
         cand = cand.at[slots].set(rows, mode="drop")
-        # incremental min-distance update against ONLY the new rows
-        d2new = sq_euclidean(X, rows.astype(X.dtype))  # (n, cap)
-        d2new = jnp.where(ok[None, :], d2new, jnp.inf)
-        mind = jnp.where(
-            w > 0, jnp.minimum(mind, jnp.min(d2new, axis=1)), 0.0)
+        # incremental min-distance update against ONLY the new rows; the
+        # ok-mask keeps unfilled slots at +inf inside the fused reduction,
+        # so an empty round leaves mind unchanged
+        dmin_new = fused_rowwise_min(X, rows, mask=ok, kernel=kernel,
+                                     mesh=mesh)
+        mind = jnp.where(w > 0, jnp.minimum(mind, dmin_new), 0.0)
         overflow = jnp.maximum(overflow, total - count)
         return cand, n_cand + count, mind, key, overflow
 
@@ -731,9 +739,13 @@ def _init_rounds_phase(X, w, l, cand, mind0, n_rounds, key, *,
 
 
 def _init_weights_phase(X, w, cand, n_cand, k_extra, *, n_clusters: int,
-                        max_cand: int):
-    """k-means|| phase 3 — degenerate-draw top-up + candidate weighting
-    via the one-hot matmul (see :func:`_init_scalable_device`)."""
+                        max_cand: int, mesh=None, kernel: str = "auto"):
+    """k-means|| phase 3 — degenerate-draw top-up + candidate weighting.
+    The O(n·max_cand·d) argmin + one-hot contraction routes through
+    :func:`~dask_ml_tpu.ops.fused_distance.fused_argmin_weight` — one
+    implementation shared with ``pairwise_distances_argmin_min`` and the
+    spectral assignment path; on TPU neither the (n × max_cand) distance
+    matrix nor the one-hot ever reaches HBM."""
     slot_iota = jnp.arange(max_cand)
 
     # Degenerate draw (tiny data): top up to n_clusters with random
@@ -759,17 +771,12 @@ def _init_weights_phase(X, w, cand, n_cand, k_extra, *, n_clusters: int,
     n_cand = n_cand + need
 
     # candidate weights: total row weight assigned to each nearest
-    # candidate, as a one-hot matmul contraction over the sharded sample
-    # axis (MXU + psum; scatter-add segment_sum serializes on TPU)
+    # candidate — the fused argmin+weighted-accumulation epilogue (XLA
+    # path: one-hot matmul contraction on the MXU + psum over the sharded
+    # sample axis; scatter-add segment_sum serializes on TPU)
     valid = slot_iota < n_cand
-    d2 = sq_euclidean(X, cand.astype(X.dtype))
-    d2 = jnp.where(valid[None, :], d2, jnp.inf)
-    nearest = jnp.argmin(d2, axis=1)
-    onehot = (slot_iota[None, :] == nearest[:, None])
-    cw = jax.lax.dot_general(
-        w, onehot.astype(jnp.float32), (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)  # (max_cand,)
-    cw = jnp.where(valid, cw, 0.0)
+    _nearest, cw = fused_argmin_weight(X, w, cand, mask=valid,
+                                       kernel=kernel, mesh=mesh)
     return cand, n_cand, cw
 
 
@@ -785,10 +792,11 @@ def _init_finish_phase(cand, cw, tol, k_pp, *, n_clusters: int,
 
 @partial(jax.jit, static_argnames=(
     "n_clusters", "max_rounds", "max_cand", "cap", "n_trials",
-    "finish_iters"))
+    "finish_iters", "mesh", "kernel"))
 def _init_scalable_device(X, w, l, tol, key, *, n_clusters: int,
                           max_rounds: int, max_cand: int, cap: int,
-                          n_trials: int, finish_iters: int):
+                          n_trials: int, finish_iters: int,
+                          mesh=None, kernel: str = "auto"):
     """The ENTIRE k-means|| init as ONE XLA program — zero host round
     trips (VERDICT r4 #1: the previous host round loop paid ~1 RTT per
     round plus host fetches for φ, candidate weights, the candidate
@@ -827,10 +835,14 @@ def _init_scalable_device(X, w, l, tol, key, *, n_clusters: int,
       serializes on TPU at this n) and gathered device-side into the
       fixed ``(max_cand, d)`` buffer with a small drop-mode scatter —
       nothing crosses the host boundary.
-    - candidate weights sum row weights over nearest candidates as a
-      ONE-HOT MATMUL on the MXU (reference: cluster/k_means.py:407-416;
-      a scatter-add ``segment_sum`` at this n is catastrophically slow on
-      TPU — colliding indices serialize the scatter), then the buffer is
+    - candidate weights sum row weights over nearest candidates through
+      the fused family's argmin+weighted-accumulation epilogue
+      (ops/fused_distance.py; its XLA lowering is a ONE-HOT MATMUL on the
+      MXU — reference: cluster/k_means.py:407-416; a scatter-add
+      ``segment_sum`` at this n is catastrophically slow on TPU —
+      colliding indices serialize the scatter; the pallas lowering keeps
+      the (n × max_cand) distances AND one-hot out of HBM entirely),
+      then the buffer is
       clustered down to k centers by on-device weighted greedy k-means++
       (:func:`_kmeanspp_on_candidates`) + a small weighted Lloyd loop —
       replacing the reference's driver-local sklearn finishing KMeans
@@ -847,14 +859,15 @@ def _init_scalable_device(X, w, l, tol, key, *, n_clusters: int,
     with jax.named_scope("kmeans-init-rounds"):
         cand, n_cand, overflow = _init_rounds_phase(
             X, w, l, cand, mind0, n_rounds, key,
-            max_rounds=max_rounds, max_cand=max_cand, cap=cap)
+            max_rounds=max_rounds, max_cand=max_cand, cap=cap,
+            mesh=mesh, kernel=kernel)
     with jax.named_scope("kmeans-init-weights"):
         # (includes the degenerate-draw top-up; the finishing weighted
         # greedy k-means++ and small Lloyd loop run on the replicated
         # candidate buffer — zero-weight invalid rows contribute nothing)
         cand, n_cand, cw = _init_weights_phase(
             X, w, cand, n_cand, k_extra, n_clusters=n_clusters,
-            max_cand=max_cand)
+            max_cand=max_cand, mesh=mesh, kernel=kernel)
     with jax.named_scope("kmeans-init-finish"):
         centers = _init_finish_phase(
             cand, cw, tol, k_pp, n_clusters=n_clusters, n_trials=n_trials,
@@ -882,30 +895,79 @@ def _init_scalable_config(n_padded: int, n_clusters: int,
     )
 
 
+def _init_phase_traffic(n: int, d: int, itemsize: int, *, n_rounds: int,
+                        cap: int, max_cand: int, n_clusters: int,
+                        n_trials: int, finish_iters: int,
+                        fused_rounds: bool, fused_weights: bool) -> dict:
+    """LOGICAL bytes moved per init phase — dominant terms only, so the
+    roofline ratio (bytes / wall-seconds = effective GB/s) is honest about
+    what each phase fundamentally must stream, not what a given lowering
+    happens to spill. Per phase:
+
+    - ``seed``: one full X pass for the first-center distances plus the
+      (n,) mind write.
+    - ``rounds``: per executed round, one X pass for the incremental
+      min-distance update, the (n,) mind read+write, and the (n,) draw;
+      the UNFUSED lowering adds the (n × cap) f32 distance intermediate's
+      write + re-read — the term the fused kernel deletes (physical TPU
+      traffic is larger still: the minor dim lane-pads to 128).
+    - ``weights``: one X pass + the (n,) weights read + nearest write;
+      unfused adds write+read of the (n × max_cand) f32 distances AND the
+      (n × max_cand) bool one-hot.
+    - ``finish``: replicated candidate-buffer passes (k-means++ trials +
+      the small Lloyd loop) — noise at any real n.
+    """
+    row = n * d * itemsize
+    seed = row + 4 * n
+    per_round = row + 3 * 4 * n
+    if not fused_rounds:
+        per_round += 2 * n * cap * 4
+    rounds = max(int(n_rounds), 0) * per_round
+    weights = row + 2 * 4 * n
+    if not fused_weights:
+        weights += 2 * n * max_cand * 4 + 2 * n * max_cand
+    finish = (n_clusters * n_trials + 2 * finish_iters) * max_cand * d * 4
+    return dict(seed=seed, rounds=rounds, weights=weights, finish=finish)
+
+
 def measure_init_phases(X, w, n_clusters: int, key,
                         oversampling_factor: float = 2.0,
-                        max_iter: Optional[int] = None) -> dict:
+                        max_iter: Optional[int] = None,
+                        mesh=None, kernel: str = "auto") -> dict:
     """Roofline breakdown of the k-means|| init: run the four sub-phases
-    (seeding / sampling rounds / candidate-weighting one-hot matmul /
-    finishing k-means++) as SEPARATE jitted programs — the exact helper
-    functions the fused :func:`_init_scalable_device` inlines — with a
-    completion fetch between phases, and return ``{phase: seconds}``.
+    (seeding / sampling rounds / candidate weighting / finishing
+    k-means++) as SEPARATE jitted programs — the exact helper functions
+    the fused :func:`_init_scalable_device` inlines — with a completion
+    fetch between phases. Returns::
+
+        {"seconds":        {phase: wall seconds},
+         "bytes_moved":    {phase: logical bytes streamed},
+         "effective_gbps": {phase: bytes_moved / seconds / 1e9},
+         "fused":          {"rounds": bool, "weights": bool}}
+
+    ``bytes_moved`` follows :func:`_init_phase_traffic` (logical, dominant
+    terms, reflecting whether the fused kernel family or the unfused XLA
+    lowering actually ran), so ``effective_gbps`` next to the wall times
+    shows each phase's position against the HBM roofline and the BENCH
+    trajectory can track it across PRs.
 
     This is a measurement harness, not a production path: the fused
     program stays one XLA program (splitting it would reintroduce host
     round-trips between phases). Each phase is warmed once so compile time
     never lands in a reported number; each timed phase runs under
     :func:`~dask_ml_tpu.utils._log.profile_phase` so externally-captured
-    traces see the same names. ``bench_kdd`` records the result as
-    ``init_phase_seconds`` (VERDICT r5 "What's weak" #2: init is the
-    dominant share of the warm KDD fit and had no phase attribution).
+    traces see the same names. ``bench_kdd`` records the result under
+    ``init_phase_seconds`` / ``init_phase_bytes_moved`` /
+    ``init_phase_effective_gbps`` (VERDICT r5 "What's weak" #2: init is
+    the dominant share of the warm KDD fit and had no phase attribution).
     """
     import time
 
+    from dask_ml_tpu.ops.fused_distance import _use_pallas
     from dask_ml_tpu.utils._log import profile_phase
 
-    cfg = _init_scalable_config(X.shape[0], n_clusters,
-                                oversampling_factor, max_iter)
+    n, d = int(X.shape[0]), int(X.shape[1])
+    cfg = _init_scalable_config(n, n_clusters, oversampling_factor, max_iter)
     max_rounds, max_cand, cap = (cfg["max_rounds"], cfg["max_cand"],
                                  cfg["cap"])
     tol = scaled_tolerance(X, w, 1e-4)
@@ -915,9 +977,11 @@ def measure_init_phases(X, w, n_clusters: int, key,
     seed_fn = jax.jit(partial(_init_seed_phase, max_rounds=max_rounds,
                               max_cand=max_cand))
     rounds_fn = jax.jit(partial(_init_rounds_phase, max_rounds=max_rounds,
-                                max_cand=max_cand, cap=cap))
+                                max_cand=max_cand, cap=cap,
+                                mesh=mesh, kernel=kernel))
     weights_fn = jax.jit(partial(_init_weights_phase, n_clusters=n_clusters,
-                                 max_cand=max_cand))
+                                 max_cand=max_cand,
+                                 mesh=mesh, kernel=kernel))
     finish_fn = jax.jit(partial(_init_finish_phase, n_clusters=n_clusters,
                                 n_trials=cfg["n_trials"], finish_iters=100))
 
@@ -944,7 +1008,23 @@ def measure_init_phases(X, w, n_clusters: int, key,
     cand, n_cand, cw = timed(
         "weights", weights_fn, X, w, cand, n_cand, k_extra)
     timed("finish", finish_fn, cand, cw, tol, k_pp)
-    return phases
+
+    fused = {
+        "rounds": _use_pallas(kernel, n, cap, d, X.dtype, mesh),
+        "weights": _use_pallas(kernel, n, max_cand, d, X.dtype, mesh),
+    }
+    traffic = _init_phase_traffic(
+        n, d, int(jnp.dtype(X.dtype).itemsize),
+        n_rounds=int(jax.device_get(n_rounds)), cap=cap, max_cand=max_cand,
+        n_clusters=n_clusters, n_trials=cfg["n_trials"], finish_iters=100,
+        fused_rounds=fused["rounds"], fused_weights=fused["weights"])
+    return {
+        "seconds": phases,
+        "bytes_moved": traffic,
+        "effective_gbps": {
+            p: traffic[p] / max(phases[p], 1e-9) / 1e9 for p in phases},
+        "fused": fused,
+    }
 
 
 def init_scalable(
@@ -955,6 +1035,8 @@ def init_scalable(
     key,
     oversampling_factor: float = 2.0,
     max_iter: Optional[int] = None,
+    mesh=None,
+    kernel: str = "auto",
 ):
     """k-means|| (Scalable K-Means++, Bahmani et al. 2012, Algorithm 2;
     reference: cluster/k_means.py:357-422) — one fused device program
@@ -975,7 +1057,8 @@ def init_scalable(
         X, w, jnp.asarray(cfg["l"], jnp.float32), tol, key,
         n_clusters=int(n_clusters), max_rounds=cfg["max_rounds"],
         max_cand=cfg["max_cand"], cap=cfg["cap"],
-        n_trials=cfg["n_trials"], finish_iters=100)
+        n_trials=cfg["n_trials"], finish_iters=100,
+        mesh=mesh, kernel=kernel)
     # ONE host round trip, for observability only (centers stay on device);
     # also serves as the init-phase completion barrier for phase timing.
     n_rounds, n_cand, phi0, overflow = jax.device_get(aux)
@@ -1022,6 +1105,7 @@ def k_init(
     init: str = "k-means||",
     oversampling_factor: float = 2.0,
     max_iter: Optional[int] = None,
+    mesh=None,
 ):
     """Init dispatch (reference: cluster/k_means.py:254-325)."""
     if isinstance(init, (np.ndarray, jnp.ndarray)) or hasattr(init, "shape"):
@@ -1036,6 +1120,7 @@ def k_init(
         return init_scalable(
             X, w, n_valid, n_clusters, key,
             oversampling_factor=oversampling_factor, max_iter=max_iter,
+            mesh=mesh,
         )
     if init == "k-means++":
         return init_pp(X, n_valid, n_clusters, key)

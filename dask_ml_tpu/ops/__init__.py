@@ -1,4 +1,6 @@
-"""Compute primitives: pairwise kernels, distributed linear algebra,
-segment reductions. The TPU-native replacement for the reference's L3
-primitives layer (reference: dask_ml/metrics/pairwise.py, the Cython
-``_k_means.pyx`` kernel, and the ``da.linalg`` routines it borrows)."""
+"""Compute primitives: pairwise kernels, the fused distance-reduction
+kernel family (``ops.fused_distance`` — see docs/kernels.md), distributed
+linear algebra, segment reductions. The TPU-native replacement for the
+reference's L3 primitives layer (reference: dask_ml/metrics/pairwise.py,
+the Cython ``_k_means.pyx`` kernel, and the ``da.linalg`` routines it
+borrows)."""

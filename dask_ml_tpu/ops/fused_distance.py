@@ -1,0 +1,392 @@
+"""Fused distance-reduction kernel family.
+
+Every assignment-style hot path in this package computes the same thing:
+squared Euclidean distances from n query rows to m small/replicated target
+rows, immediately reduced along the target axis — a per-row min (k-means||
+round updates), argmin+min (``pairwise_distances_argmin_min``, label
+assignment), or argmin followed by a weighted per-target accumulation (the
+k-means|| candidate-weighting contraction). The lowered-XLA formulation
+writes the full (n × m) distance matrix to HBM only to immediately reduce
+it — and TPU tiling lane-pads m up to 128, so even an m=8 intermediate
+costs a full (n × 128) write + read. This module fuses the reduction into
+the distance pass, flash-attention-style: distances for one row block are
+computed on the MXU into VMEM, the *online* epilogue (min / argmin /
+one-hot weight accumulation in VMEM scratch) consumes them before the
+block leaves fast memory, and the (n × m) intermediate never exists.
+
+The family (all honoring a validity mask over Y rows, so padded candidate
+slots never need a ``jnp.inf`` re-masking pass over an (n × m) matrix):
+
+- :func:`fused_rowwise_min` — per-row min squared distance.
+- :func:`fused_argmin_min` — per-row (argmin index, min squared distance).
+- :func:`fused_argmin_weight` — per-row argmin plus the per-target sum of
+  row weights (the candidate-weighting / M-step-count contraction).
+
+Each has three implementations selected by ``kernel=``:
+
+- ``"xla"`` — the jnp reference: one expression XLA lowers itself. This is
+  also the family's semantic ground truth; the property tests pin the
+  pallas path against it bit-for-bit where FP arithmetic is exact.
+- ``"pallas"`` — the tiled single-pass kernel. Off-TPU it runs in Pallas
+  interpret mode (slow, CPU CI only).
+- ``"auto"`` — the measured-dispatch default, following the
+  ``_pallas_auto_wins`` precedent from the Lloyd kernel
+  (models/kmeans.py): pallas only on TPU, only in regimes where the fusion
+  is expected to win (:func:`_fused_auto_wins`), XLA everywhere else.
+  ``bench.py --fused`` measures fused-vs-unfused over an (n, m, d) grid to
+  populate/validate the thresholds — see docs/kernels.md.
+
+Score convention (shared by ALL implementations so ties break identically):
+the reduction runs over ``s_j = |y_j|² − 2·x·y_j`` — the per-row-constant
+``|x|²`` term does not affect the argmin and is added back (then clamped at
+0 against cancellation, same guard as ``sq_euclidean``) only to the
+returned min VALUE. Masked Y rows score ``+inf`` and can never win; when
+every row is masked, argmin is 0 and the min is ``+inf`` (the jnp
+``argmin``-over-all-inf convention).
+
+Sharding: the XLA path is a plain traced expression — GSPMD partitions it
+like any other op. A ``pallas_call`` has no GSPMD partitioning rule, so
+for sharded inputs the pallas path must run *per shard*: pass ``mesh=`` and
+the call is wrapped in ``shard_map`` over the data axis (row-wise outputs
+stay sharded; the weight accumulation psums). Without a mesh, auto never
+selects pallas on a multi-device backend — replicating the operands into
+an unpartitioned kernel would gather the shard.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+# lanes per X-row block streamed through VMEM. At the support bounds
+# (m=1024, d=512) one grid step holds Xb (2 MB) + Y (2 MB) + scores (4 MB)
+# + the one-hot temporary (4 MB) — comfortable margin under the ~16 MB
+# VMEM budget. Module-level so tests can shrink it to force multi-block
+# grids on small inputs.
+_FUSED_BLK = 1024
+
+
+def _fused_supported(m: int, d: int) -> bool:
+    """Shapes the kernel handles with comfortable VMEM margins: Y and one
+    (m × blk) score block must both sit in VMEM alongside the X block.
+    Beyond the bound an explicit ``kernel='pallas'`` raises; ``'auto'``
+    silently keeps XLA."""
+    return 1 <= m <= 1024 and 1 <= d <= 512
+
+
+def _fused_auto_wins(n: int, m: int, d: int, dtype, mesh) -> bool:
+    """The regimes where ``kernel='auto'`` selects the fused pallas path.
+
+    PROVISIONAL, roofline-derived — to be re-cut from measurement the same
+    way the Lloyd kernel's ``_pallas_auto_wins`` table was (bench.py
+    ``--fused`` emits fused-vs-unfused wall times over an (n, m, d) grid
+    for exactly this purpose; docs/kernels.md records the methodology).
+    The reasoning: the unfused path writes + re-reads an (n × m) f32
+    intermediate that TPU tiling lane-pads to (n × ⌈m/128⌉·128) — for any
+    m ≤ 128 that is 1 KiB of extra HBM traffic per row, several times the
+    row itself at the d ≤ 128 shapes these consumers run (the KDD init's
+    d=41, assignment/embedding d=k). The fusion can only pay once n is
+    large enough to amortize Mosaic's pipeline spin-up (the PR-1 lesson:
+    halving logical traffic loses when the kernel can't saturate HBM on a
+    small grid), and the rule deliberately keeps XLA at wide d, where the
+    X read dominates the intermediate and the Lloyd sweep measured f32
+    parity bands — widen only once the grid shows a win there.
+
+    TPU only — off-TPU the kernel runs in interpret mode, where the
+    unfused XLA path always wins (the CPU CI mesh exercises pallas through
+    the property tests, never through auto).
+    """
+    if not _fused_supported(m, d):
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    if mesh is None and jax.device_count() > 1:
+        return False  # no GSPMD rule for pallas_call: would gather the shard
+    return n >= (1 << 18) and m >= 16 and d <= 128
+
+
+def _check_kernel(kernel: str, m: int, d: int) -> None:
+    if kernel not in ("auto", "pallas", "xla"):
+        raise ValueError(f"kernel must be auto|pallas|xla, got {kernel!r}")
+    if kernel == "pallas" and not _fused_supported(m, d):
+        raise ValueError(
+            f"kernel='pallas' supports 1<=m<=1024, d<=512; got m={m}, d={d}")
+
+
+def _use_pallas(kernel, n, m, d, dtype, mesh):
+    _check_kernel(kernel, m, d)
+    return kernel == "pallas" or (
+        kernel == "auto" and _fused_auto_wins(n, m, d, dtype, mesh))
+
+
+def _row_sumsq(X):
+    """Per-row Σx² as a ones-matmul, f32-accumulated — the SAME op (and
+    accumulation order) the kernel uses in VMEM, so reference and fused
+    values agree bit-for-bit wherever the arithmetic is exact."""
+    Xf = X.astype(jnp.float32)
+    ones = jnp.ones((1, X.shape[1]), jnp.float32)
+    return jax.lax.dot_general(
+        ones, Xf * Xf, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)[0]  # (n,)
+
+
+def _scores_ref(X, Y, mask):
+    """(n, m) reduction scores ``|y|² − 2·x·y`` with masked rows at +inf —
+    the reference the pallas kernel must reproduce (same compute dtype:
+    Y is cast to X's dtype for the MXU, accumulation in f32)."""
+    Yc = Y.astype(X.dtype)
+    y2 = jnp.sum(Yc.astype(jnp.float32) ** 2, axis=1)  # (m,)
+    prod = jax.lax.dot_general(
+        X, Yc, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)  # (n, m)
+    s = y2[None, :] - 2.0 * prod
+    if mask is not None:
+        s = jnp.where(mask[None, :], s, jnp.inf)
+    return s
+
+
+def _min_ref(X, Y, mask):
+    s = _scores_ref(X, Y, mask)
+    return jnp.maximum(jnp.min(s, axis=1) + _row_sumsq(X), 0.0)
+
+
+def _argmin_min_ref(X, Y, mask):
+    s = _scores_ref(X, Y, mask)
+    idx = jnp.argmin(s, axis=1).astype(jnp.int32)
+    mind = jnp.maximum(jnp.min(s, axis=1) + _row_sumsq(X), 0.0)
+    return idx, mind
+
+
+def _argmin_weight_ref(X, w, Y, mask):
+    s = _scores_ref(X, Y, mask)
+    idx = jnp.argmin(s, axis=1).astype(jnp.int32)
+    onehot = (jnp.arange(Y.shape[0], dtype=jnp.int32)[None, :]
+              == idx[:, None])
+    # contraction over the (possibly sharded) sample axis — GSPMD inserts
+    # the psum; a scatter-add segment_sum serializes on TPU
+    cw = jax.lax.dot_general(
+        w.astype(jnp.float32), onehot.astype(jnp.float32),
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)  # (m,)
+    if mask is not None:
+        cw = jnp.where(mask, cw, 0.0)
+    return idx, cw
+
+
+# ---------------------------------------------------------------------------
+# the tiled single-pass kernel
+# ---------------------------------------------------------------------------
+
+
+def _fused_pallas(X, Y, maskf, w2d, epilogue: str):
+    """One pass over row blocks of X with the whole (m, d) Y resident in
+    VMEM. Per block: scores on the MXU in (m, blk) layout (m on sublanes —
+    the block's minor dim stays the 128-lane-aligned ``blk``), then the
+    online epilogue on the VPU. Row-wise outputs are written per grid step;
+    the (m,) weight accumulation lives in VMEM scratch and is written once
+    on the final step (the Lloyd kernel's accumulator discipline —
+    revisited output blocks would serialize the loop on tiny DMAs).
+
+    ``maskf`` is the (m, 1) f32 validity mask (1=real row); ``w2d`` the
+    (1, n) f32 row weights (``epilogue='argmin_weight'`` only).
+    """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    m, d = Y.shape
+    n = X.shape[0]
+    blk = _FUSED_BLK
+    grid = (n + blk - 1) // blk
+    interpret = jax.default_backend() != "tpu"
+
+    def kernel(y_ref, mask_ref, x_ref, *rest):
+        if epilogue == "argmin_weight":
+            w_ref, am_ref, cw_ref, acc_cw = rest
+        elif epilogue == "argmin_min":
+            am_ref, mn_ref = rest
+        else:  # "min"
+            (mn_ref,) = rest
+        i = pl.program_id(0)
+
+        Yb = y_ref[:]  # (m, d), X's compute dtype
+        col = i * blk + jax.lax.broadcasted_iota(jnp.int32, (1, blk), 1)
+        valid_col = col < n
+        # zero OOB columns of the final partial block with a SELECT: their
+        # contents are undefined (NaN in interpret mode) and 0·NaN = NaN
+        # would survive a multiplicative mask into the matmul contraction
+        Xb = jnp.where(
+            jax.lax.broadcasted_iota(jnp.int32, (blk, 1), 0) + i * blk < n,
+            x_ref[:], 0)  # (blk, d)
+
+        y2 = jnp.sum(Yb.astype(jnp.float32) ** 2, axis=1,
+                     keepdims=True)  # (m, 1)
+        prod = jax.lax.dot_general(
+            Yb, Xb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (m, blk) on the MXU
+        scores = y2 - 2.0 * prod
+        scores = jnp.where(mask_ref[:] > 0, scores, jnp.inf)
+
+        if epilogue == "argmin_weight":
+            best = jnp.argmin(scores, axis=0, keepdims=True)  # (1, blk)
+            am_ref[:] = best.astype(jnp.int32)
+
+            @pl.when(i == 0)
+            def _():
+                acc_cw[:] = jnp.zeros_like(acc_cw)
+
+            wv = jnp.where(valid_col, w_ref[:], 0.0)  # (1, blk)
+            kiota = jax.lax.broadcasted_iota(jnp.int32, (m, blk), 0)
+            oh_w = (kiota == best).astype(jnp.float32) * wv  # (m, blk)
+            acc_cw[:] += jnp.sum(oh_w, axis=1, keepdims=True)  # (m, 1)
+
+            @pl.when(i == grid - 1)
+            def _():
+                # masked rows can still absorb weight in the all-masked
+                # degenerate case (argmin of all-inf is 0) — zero them,
+                # matching the reference's final where(mask, cw, 0)
+                cw_ref[:] = acc_cw[:] * jnp.minimum(mask_ref[:], 1.0)
+            return
+
+        if epilogue == "argmin_min":
+            best = jnp.argmin(scores, axis=0, keepdims=True)
+            am_ref[:] = best.astype(jnp.int32)
+        # min value: add the per-row |x|² back (ones-matmul, f32 — the
+        # SAME op order as _row_sumsq so values match the reference
+        # bit-for-bit where exact), clamp cancellation at 0
+        ones = jnp.ones((1, d), jnp.float32)
+        Xf = Xb.astype(jnp.float32)
+        x2 = jax.lax.dot_general(
+            ones, Xf * Xf, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (1, blk)
+        mn_ref[:] = jnp.maximum(
+            jnp.min(scores, axis=0, keepdims=True) + x2, 0.0)
+
+    y_spec = pl.BlockSpec((m, d), lambda i: (0, 0), memory_space=pltpu.VMEM)
+    mask_spec = pl.BlockSpec((m, 1), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM)
+    x_spec = pl.BlockSpec((blk, d), lambda i: (i, 0),
+                          memory_space=pltpu.VMEM)
+    row_spec = pl.BlockSpec((1, blk), lambda i: (0, i),
+                            memory_space=pltpu.VMEM)
+
+    Yc = Y.astype(X.dtype)
+    if epilogue == "argmin_weight":
+        am, cw = pl.pallas_call(
+            kernel,
+            grid=(grid,),
+            in_specs=[y_spec, mask_spec, x_spec, row_spec],
+            out_specs=[
+                row_spec,
+                pl.BlockSpec((m, 1), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((1, n), jnp.int32),
+                jax.ShapeDtypeStruct((m, 1), jnp.float32),
+            ],
+            scratch_shapes=[pltpu.VMEM((m, 1), jnp.float32)],
+            interpret=interpret,
+        )(Yc, maskf, X, w2d)
+        return am[0], cw[:, 0]
+    if epilogue == "argmin_min":
+        am, mn = pl.pallas_call(
+            kernel,
+            grid=(grid,),
+            in_specs=[y_spec, mask_spec, x_spec],
+            out_specs=[row_spec, row_spec],
+            out_shape=[
+                jax.ShapeDtypeStruct((1, n), jnp.int32),
+                jax.ShapeDtypeStruct((1, n), jnp.float32),
+            ],
+            interpret=interpret,
+        )(Yc, maskf, X)
+        return am[0], mn[0]
+    mn = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[y_spec, mask_spec, x_spec],
+        out_specs=row_spec,
+        out_shape=jax.ShapeDtypeStruct((1, n), jnp.float32),
+        interpret=interpret,
+    )(Yc, maskf, X)
+    return mn[0]
+
+
+def _maskf(mask, m):
+    if mask is None:
+        return jnp.ones((m, 1), jnp.float32)
+    return mask.astype(jnp.float32).reshape(m, 1)
+
+
+# ---------------------------------------------------------------------------
+# public family
+# ---------------------------------------------------------------------------
+
+
+def fused_rowwise_min(X, Y, mask=None, *, kernel: str = "auto", mesh=None):
+    """Per-row ``min_j d²(x_i, y_j)`` over valid Y rows, shape (n,) f32.
+
+    Masked rows score +inf; all-masked returns +inf per row (so an
+    incremental-min consumer's ``jnp.minimum(prev, ...)`` is a no-op for
+    empty rounds). ``mesh`` wraps the pallas path in ``shard_map`` over
+    the data axis for row-sharded X (see module docstring)."""
+    m, d = Y.shape
+    if not _use_pallas(kernel, X.shape[0], m, d, X.dtype, mesh):
+        return _min_ref(X, Y, mask)
+    maskf = _maskf(mask, m)
+    if mesh is None:
+        return _fused_pallas(X, Y, maskf, None, "min")
+    from dask_ml_tpu.parallel.mesh import DATA_AXIS, shard_map
+
+    fn = shard_map(
+        lambda Xl, Yl, ml: _fused_pallas(Xl, Yl, ml, None, "min"),
+        mesh=mesh, in_specs=(P(DATA_AXIS, None), P(), P()),
+        out_specs=P(DATA_AXIS), check_vma=False)
+    return fn(X, Y, maskf)
+
+
+def fused_argmin_min(X, Y, mask=None, *, kernel: str = "auto", mesh=None):
+    """Per-row (argmin index int32, min squared distance f32) over valid
+    Y rows — the assignment primitive. Ties break to the lowest index,
+    identically across implementations."""
+    m, d = Y.shape
+    if not _use_pallas(kernel, X.shape[0], m, d, X.dtype, mesh):
+        return _argmin_min_ref(X, Y, mask)
+    maskf = _maskf(mask, m)
+    if mesh is None:
+        return _fused_pallas(X, Y, maskf, None, "argmin_min")
+    from dask_ml_tpu.parallel.mesh import DATA_AXIS, shard_map
+
+    fn = shard_map(
+        lambda Xl, Yl, ml: _fused_pallas(Xl, Yl, ml, None, "argmin_min"),
+        mesh=mesh, in_specs=(P(DATA_AXIS, None), P(), P()),
+        out_specs=(P(DATA_AXIS), P(DATA_AXIS)), check_vma=False)
+    return fn(X, Y, maskf)
+
+
+def fused_argmin_weight(X, w, Y, mask=None, *, kernel: str = "auto",
+                        mesh=None):
+    """Per-row argmin (int32, shape (n,)) plus the per-target weighted
+    count ``cw[j] = Σ_i w_i · [argmin_i == j]`` (f32, shape (m,)) — the
+    k-means|| candidate-weighting / M-step-count contraction, fused so
+    neither the (n × m) distance matrix nor the (n × m) one-hot ever
+    reaches HBM. Masked rows always get ``cw == 0``."""
+    m, d = Y.shape
+    if not _use_pallas(kernel, X.shape[0], m, d, X.dtype, mesh):
+        return _argmin_weight_ref(X, w, Y, mask)
+    maskf = _maskf(mask, m)
+    w2d = w.astype(jnp.float32)[None, :]
+    if mesh is None:
+        return _fused_pallas(X, Y, maskf, w2d, "argmin_weight")
+    from dask_ml_tpu.parallel.mesh import DATA_AXIS, shard_map
+
+    def local(Xl, wl, Yl, ml):
+        am, cw = _fused_pallas(Xl, Yl, ml, wl, "argmin_weight")
+        return am, jax.lax.psum(cw, DATA_AXIS)
+
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(None, DATA_AXIS), P(), P()),
+        out_specs=(P(DATA_AXIS), P()), check_vma=False)
+    return fn(X, w2d, Y, maskf)

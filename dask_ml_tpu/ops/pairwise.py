@@ -83,16 +83,21 @@ def euclidean_distances(X: jax.Array, Y: jax.Array | None = None) -> jax.Array:
     return jnp.sqrt(sq_euclidean(X, Y))
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("kernel", "mesh"))
 def pairwise_distances_argmin_min(
-    X: jax.Array, Y: jax.Array
+    X: jax.Array, Y: jax.Array, *, kernel: str = "auto", mesh=None
 ) -> tuple[jax.Array, jax.Array]:
     """For each row of X, the index of and distance to the nearest row of Y
-    (reference: metrics/pairwise.py:20-50). Fused distance+argmin per shard;
-    no (n × k) matrix survives the epilogue."""
-    d2 = sq_euclidean(X, Y)
-    argmin = jnp.argmin(d2, axis=1)
-    mind = jnp.min(d2, axis=1)
+    (reference: metrics/pairwise.py:20-50). Routed through the fused
+    distance-reduction family (:mod:`dask_ml_tpu.ops.fused_distance`):
+    ``kernel='auto'`` (default) picks the tiled single-pass Pallas kernel
+    in its measured winning regimes and the XLA-lowered expression
+    elsewhere; no (n × m) matrix survives either epilogue on TPU, and the
+    pallas path never even materializes it in HBM. Pass ``mesh`` for
+    row-sharded X when forcing ``kernel='pallas'`` (see docs/kernels.md)."""
+    from dask_ml_tpu.ops.fused_distance import fused_argmin_min
+
+    argmin, mind = fused_argmin_min(X, Y, kernel=kernel, mesh=mesh)
     return argmin, jnp.sqrt(mind)
 
 

@@ -45,6 +45,11 @@ SECTIONS = [
     ("dask_ml_tpu.metrics", "Metrics",
      "Sharded classification/regression metrics, pairwise kernels, and "
      "the scorer registry."),
+    ("dask_ml_tpu.ops.fused_distance", "Fused distance-reduction kernels",
+     "Tiled single-pass distance+reduction primitives (online min / "
+     "argmin / weighted-accumulation epilogues) with measured "
+     "fused-vs-XLA dispatch — see docs/kernels.md for the family's "
+     "design, thresholds, and measurement method."),
     ("dask_ml_tpu.datasets", "Datasets",
      "Device-generated, mesh-sharded synthetic datasets."),
     ("dask_ml_tpu", "Top level",
@@ -64,6 +69,9 @@ EXTRA = {
         "get_scorer", "check_scoring", "euclidean_distances",
         "pairwise_distances", "pairwise_distances_argmin_min",
         "pairwise_kernels",
+    ],
+    "dask_ml_tpu.ops.fused_distance": [
+        "fused_rowwise_min", "fused_argmin_min", "fused_argmin_weight",
     ],
     "dask_ml_tpu.datasets": ["make_blobs", "make_regression",
                              "make_classification", "make_counts"],

@@ -1,0 +1,252 @@
+"""Property tests for the fused distance-reduction kernel family
+(ops/fused_distance.py): the Pallas path must reproduce the jnp reference
+bit-for-bit where FP arithmetic is exact (integer-valued inputs), break
+argmin ties identically (lowest index), and never let a masked Y row win —
+across odd/non-tile-aligned shapes, f32/bf16 inputs, all-masked edge cases,
+and the shard_map (mesh) path. Everything runs in Pallas INTERPRET mode on
+the CPU CI mesh (the kernels smoke job in CI runs exactly this file), so
+kernel regressions surface without TPU hardware."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dask_ml_tpu.ops import fused_distance as fd
+
+
+@pytest.fixture(autouse=True)
+def small_blocks():
+    """Shrink the row-block size so even tiny test inputs produce
+    multi-step grids — otherwise the scratch init/accumulate/finalize
+    sequence degenerates to one block and a cross-block regression
+    passes unnoticed (same discipline as test_pallas_lloyd_matches_xla)."""
+    old = fd._FUSED_BLK
+    fd._FUSED_BLK = 64
+    yield
+    fd._FUSED_BLK = old
+
+
+# deliberately non-tile-aligned: n not a multiple of the block (partial
+# final block), m/d prime-ish and far from the (8, 128) tile quanta
+SHAPES = [(533, 37, 13), (129, 7, 3), (64, 130, 5), (257, 64, 17)]
+
+
+def _int_data(n, m, d, seed=0):
+    """Integer-valued floats: every product/sum in the kernel is exact, so
+    'bit-for-bit-where-exact' is literally testable with ==."""
+    rng = np.random.RandomState(seed)
+    X = jnp.asarray(rng.randint(-8, 8, (n, d)), jnp.float32)
+    Y = jnp.asarray(rng.randint(-8, 8, (m, d)), jnp.float32)
+    w = jnp.asarray(rng.randint(0, 5, n), jnp.float32)
+    mask = jnp.asarray(rng.rand(m) > 0.3)
+    return X, Y, w, mask
+
+
+@pytest.mark.parametrize("n,m,d", SHAPES)
+def test_bitexact_vs_reference_int_valued(n, m, d):
+    X, Y, w, mask = _int_data(n, m, d)
+    rm = fd.fused_rowwise_min(X, Y, mask, kernel="xla")
+    pm = fd.fused_rowwise_min(X, Y, mask, kernel="pallas")
+    np.testing.assert_array_equal(np.asarray(rm), np.asarray(pm))
+
+    ra, rmn = fd.fused_argmin_min(X, Y, mask, kernel="xla")
+    pa, pmn = fd.fused_argmin_min(X, Y, mask, kernel="pallas")
+    np.testing.assert_array_equal(np.asarray(ra), np.asarray(pa))
+    np.testing.assert_array_equal(np.asarray(rmn), np.asarray(pmn))
+
+    ri, rc = fd.fused_argmin_weight(X, w, Y, mask, kernel="xla")
+    pi, pc = fd.fused_argmin_weight(X, w, Y, mask, kernel="pallas")
+    np.testing.assert_array_equal(np.asarray(ri), np.asarray(pi))
+    np.testing.assert_array_equal(np.asarray(rc), np.asarray(pc))
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_real_valued_parity(dtype):
+    """Random real inputs: argmin ties still break identically; values
+    agree to accumulation-order tolerance."""
+    rng = np.random.RandomState(1)
+    n, m, d = 321, 29, 11
+    X = jnp.asarray(rng.randn(n, d), jnp.float32).astype(dtype)
+    Y = jnp.asarray(rng.randn(m, d), jnp.float32)
+    w = jnp.asarray(rng.uniform(0.5, 2.0, n), jnp.float32)
+    ra, rmn = fd.fused_argmin_min(X, Y, kernel="xla")
+    pa, pmn = fd.fused_argmin_min(X, Y, kernel="pallas")
+    np.testing.assert_array_equal(np.asarray(ra), np.asarray(pa))
+    np.testing.assert_allclose(np.asarray(rmn), np.asarray(pmn),
+                               rtol=1e-5, atol=1e-5)
+    ri, rc = fd.fused_argmin_weight(X, w, Y, kernel="xla")
+    pi, pc = fd.fused_argmin_weight(X, w, Y, kernel="pallas")
+    np.testing.assert_array_equal(np.asarray(ri), np.asarray(pi))
+    np.testing.assert_allclose(np.asarray(rc), np.asarray(pc),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_argmin_ties_break_to_lowest_index():
+    """Duplicate Y rows, X rows placed EXACTLY on the duplicates: both
+    implementations must return the FIRST duplicate's index."""
+    rng = np.random.RandomState(2)
+    m, d = 9, 5
+    Ybase = jnp.asarray(rng.randint(-4, 4, (m, d)), jnp.float32)
+    Y = jnp.concatenate([Ybase, Ybase], axis=0)  # rows j and j+m identical
+    X = jnp.concatenate([Ybase, Ybase, Ybase], axis=0)  # exact landings
+    ra, _ = fd.fused_argmin_min(X, Y, kernel="xla")
+    pa, _ = fd.fused_argmin_min(X, Y, kernel="pallas")
+    np.testing.assert_array_equal(np.asarray(ra), np.asarray(pa))
+    assert int(np.asarray(pa).max()) < m  # ties resolve to the first copy
+
+
+def test_masked_rows_never_win():
+    """Mask the UNIQUELY nearest Y row of every X row; the winner must come
+    from the valid set, in both implementations."""
+    rng = np.random.RandomState(3)
+    n, m, d = 150, 12, 4
+    Y = jnp.asarray(rng.randn(m, d) * 5, jnp.float32)
+    X = Y[jnp.asarray(rng.randint(0, 3, n))] + 0.01  # nearest ∈ {0, 1, 2}
+    mask = jnp.asarray([False, False, False] + [True] * (m - 3))
+    for kernel in ("xla", "pallas"):
+        am, mn = fd.fused_argmin_min(X, Y, mask, kernel=kernel)
+        assert int(np.asarray(am).min()) >= 3
+        _, cw = fd.fused_argmin_weight(X, jnp.ones((n,)), Y, mask,
+                                       kernel=kernel)
+        cw = np.asarray(cw)
+        assert (cw[:3] == 0).all() and cw.sum() == n
+
+
+def test_all_masked_edge_case():
+    X, Y, w, _ = _int_data(100, 8, 3)
+    mask = jnp.zeros((8,), bool)
+    for kernel in ("xla", "pallas"):
+        am, mn = fd.fused_argmin_min(X, Y, mask, kernel=kernel)
+        np.testing.assert_array_equal(np.asarray(am), 0)  # argmin-of-inf
+        assert np.isinf(np.asarray(mn)).all()
+        assert np.isinf(np.asarray(
+            fd.fused_rowwise_min(X, Y, mask, kernel=kernel))).all()
+        _, cw = fd.fused_argmin_weight(X, w, Y, mask, kernel=kernel)
+        np.testing.assert_array_equal(np.asarray(cw), 0.0)
+
+
+def test_min_value_clamped_nonnegative():
+    """f32 cancellation can push |y|²−2x·y+|x|² below zero for coincident
+    points; the clamp guards it (the sq_euclidean guard, applied after
+    the fused reduction)."""
+    rng = np.random.RandomState(4)
+    Y = jnp.asarray(rng.randn(5, 7) * 100, jnp.float32)
+    X = jnp.tile(Y, (20, 1))  # every row coincides with some Y row
+    for kernel in ("xla", "pallas"):
+        mn = fd.fused_rowwise_min(X, Y, kernel=kernel)
+        assert (np.asarray(mn) >= 0).all()
+        assert np.asarray(mn).max() < 1e-2
+
+
+def test_sharded_mesh_path_matches_reference(any_mesh):
+    """The shard_map-wrapped pallas path (row-sharded X, replicated Y,
+    psum'd weight accumulation) over 1/3/8-device meshes — 3 devices
+    exercises row padding."""
+    from dask_ml_tpu.parallel.sharding import prepare_data
+
+    rng = np.random.RandomState(5)
+    X = rng.randint(-8, 8, (700, 5)).astype(np.float32)
+    w = rng.randint(0, 4, 700).astype(np.float32)
+    Y = jnp.asarray(rng.randint(-8, 8, (23, 5)), jnp.float32)
+    mask = jnp.asarray(rng.rand(23) > 0.25)
+    data = prepare_data(X, sample_weight=w, mesh=any_mesh)
+
+    @jax.jit
+    def run(Xs, ws):
+        mn = fd.fused_rowwise_min(Xs, Y, mask, kernel="pallas",
+                                  mesh=any_mesh)
+        am, mn2 = fd.fused_argmin_min(Xs, Y, mask, kernel="pallas",
+                                      mesh=any_mesh)
+        ai, cw = fd.fused_argmin_weight(Xs, ws, Y, mask, kernel="pallas",
+                                        mesh=any_mesh)
+        return mn, am, mn2, ai, cw
+
+    mn, am, mn2, ai, cw = run(data.X, data.weights)
+    ra, rmn = fd.fused_argmin_min(data.X, Y, mask, kernel="xla")
+    _, rcw = fd.fused_argmin_weight(data.X, data.weights, Y, mask,
+                                    kernel="xla")
+    np.testing.assert_array_equal(np.asarray(am), np.asarray(ra))
+    np.testing.assert_array_equal(np.asarray(mn), np.asarray(rmn))
+    np.testing.assert_array_equal(np.asarray(mn2), np.asarray(rmn))
+    np.testing.assert_array_equal(np.asarray(cw), np.asarray(rcw))
+
+
+def test_dispatch_rules():
+    """auto never selects pallas off-TPU; explicit pallas rejects
+    unsupported shapes loudly; unknown kernels are loud too."""
+    if jax.default_backend() != "tpu":
+        assert not fd._fused_auto_wins(1 << 20, 64, 41, jnp.float32, None)
+    import unittest.mock as mock
+
+    with mock.patch("jax.default_backend", return_value="tpu"), \
+            mock.patch("jax.device_count", return_value=1):
+        # provisional roofline rule: big-n + reducible-m + narrow-d wins
+        assert fd._fused_auto_wins(1 << 20, 64, 41, jnp.float32, None)
+        assert not fd._fused_auto_wins(1 << 10, 64, 41, jnp.float32, None)
+        assert not fd._fused_auto_wins(1 << 20, 8, 41, jnp.float32, None)
+        # wide d stays XLA until the grid measures a win there
+        assert not fd._fused_auto_wins(1 << 20, 64, 256, jnp.float32, None)
+        # unsupported shapes never
+        assert not fd._fused_auto_wins(1 << 20, 2048, 41, jnp.float32, None)
+        assert not fd._fused_auto_wins(1 << 20, 64, 600, jnp.float32, None)
+    with mock.patch("jax.default_backend", return_value="tpu"), \
+            mock.patch("jax.device_count", return_value=8):
+        # sharded backend without a mesh: pallas_call has no GSPMD rule,
+        # auto must keep XLA rather than gather the shard
+        assert not fd._fused_auto_wins(1 << 20, 64, 41, jnp.float32, None)
+
+    X = jnp.zeros((16, 4))
+    with pytest.raises(ValueError, match="pallas"):
+        fd.fused_rowwise_min(X, jnp.zeros((2000, 4)), kernel="pallas")
+    with pytest.raises(ValueError, match="kernel"):
+        fd.fused_rowwise_min(X, jnp.zeros((3, 4)), kernel="nope")
+
+
+def test_pairwise_argmin_min_routes_through_family():
+    """The public pairwise op returns identical results through both
+    kernels and matches sklearn."""
+    from sklearn.metrics import pairwise_distances_argmin_min as sk_pam
+
+    from dask_ml_tpu.ops.pairwise import pairwise_distances_argmin_min
+
+    rng = np.random.RandomState(6)
+    X = rng.randn(211, 9).astype(np.float32)
+    Y = rng.randn(17, 9).astype(np.float32)
+    ax, mx = pairwise_distances_argmin_min(jnp.asarray(X), jnp.asarray(Y),
+                                           kernel="xla")
+    ap, mp = pairwise_distances_argmin_min(jnp.asarray(X), jnp.asarray(Y),
+                                           kernel="pallas")
+    np.testing.assert_array_equal(np.asarray(ax), np.asarray(ap))
+    np.testing.assert_allclose(np.asarray(mx), np.asarray(mp),
+                               rtol=1e-5, atol=1e-5)
+    ska, skm = sk_pam(X, Y)
+    np.testing.assert_array_equal(np.asarray(ax), ska)
+    np.testing.assert_allclose(np.asarray(mx), skm, rtol=1e-3, atol=1e-3)
+
+
+def test_kmeans_init_pallas_matches_xla_end_to_end(any_mesh):
+    """The whole fused k-means|| init program, pallas vs XLA reference
+    path: identical candidate trajectories → identical centers (the
+    rounds' incremental min-distance updates AND the candidate weighting
+    both route through the family)."""
+    from dask_ml_tpu.models import kmeans as core
+    from dask_ml_tpu.parallel.sharding import prepare_data
+
+    rng = np.random.RandomState(7)
+    X = rng.randint(-6, 6, (700, 5)).astype(np.float32)
+    data = prepare_data(X, mesh=any_mesh)
+    key = jax.random.key(0)
+    tol = jnp.asarray(0.0, jnp.float32)
+    out = {}
+    for kern in ("xla", "pallas"):
+        centers, aux = core._init_scalable_device(
+            data.X, data.weights, jnp.asarray(16.0, jnp.float32), tol, key,
+            n_clusters=4, max_rounds=5, max_cand=90, cap=16, n_trials=2,
+            finish_iters=10, mesh=any_mesh, kernel=kern)
+        out[kern] = (np.asarray(centers),
+                     [np.asarray(a) for a in aux])
+    np.testing.assert_array_equal(out["xla"][0], out["pallas"][0])
+    for a, b in zip(out["xla"][1], out["pallas"][1]):
+        np.testing.assert_array_equal(a, b)

@@ -137,6 +137,45 @@ def test_larger_n_grouping(mesh8):
     assert adjusted_rand_score(y, labels) == 1.0
 
 
+def test_predict_out_of_sample(blobs, mesh8):
+    """The Nyström landmark-assignment path: predict() re-extends rows
+    through the fitted landmarks (training rows reproduce labels_ exactly
+    — _nystrom_extend is the same function the fit used) and assigns new
+    rows to the blob their neighborhood belongs to, via the fused
+    distance-reduction family."""
+    X, y = blobs
+    sc = SpectralClustering(n_clusters=3, n_components=50, gamma=None,
+                            random_state=0).fit(X)
+    np.testing.assert_array_equal(sc.predict(X), sc.labels_)
+    # new rows: small perturbations of training rows keep their label
+    rng = np.random.RandomState(0)
+    Xnew = X[:200] + rng.randn(200, X.shape[1]).astype(np.float32) * 0.01
+    np.testing.assert_array_equal(sc.predict(Xnew), sc.labels_[:200])
+    assert adjusted_rand_score(y[:200], sc.predict(Xnew)) == 1.0
+
+
+def test_predict_foreign_and_callable_paths(blobs, mesh8):
+    """predict() also serves the sklearn-kmeans assigner (host assignment)
+    and callable affinities (eager kernel strip)."""
+    from dask_ml_tpu.ops.pairwise import rbf_kernel
+
+    X, y = blobs
+    sk = SpectralClustering(n_clusters=3, n_components=40, gamma=None,
+                            random_state=0,
+                            assign_labels="sklearn-kmeans").fit(X)
+    np.testing.assert_array_equal(sk.predict(X), sk.labels_)
+    cb = SpectralClustering(
+        n_clusters=3, n_components=40, random_state=0,
+        affinity=lambda a, b, **kw: rbf_kernel(a, b, gamma=0.25)).fit(X)
+    np.testing.assert_array_equal(cb.predict(X), cb.labels_)
+
+
+def test_predict_unfitted_raises(blobs, mesh8):
+    X, _ = blobs
+    with pytest.raises(AttributeError, match="fit"):
+        SpectralClustering(n_components=50).predict(X)
+
+
 def test_numpy_based_callable_affinity(blobs, mesh8):
     """Callable affinities may use numpy/sklearn code that cannot trace —
     they run eagerly (device arrays convert via __array__) while the
