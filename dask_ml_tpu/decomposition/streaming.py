@@ -72,10 +72,15 @@ def _moments_step(carry, blk, *, transform):
     return _accumulate_block(carry, X_b, w_b)
 
 
-def _streamed_moments_host(source):
+def _streamed_moments_host(source, checkpoint_path=None,
+                           checkpoint_every=None):
     """Host-driven accumulation over a ``HostBlockSource``: block b+1's
     transfer overlaps block b's Gram matmul (depth = ``source.prefetch``;
-    0 = the strict serial overlap-off baseline)."""
+    0 = the strict serial overlap-off baseline).
+
+    With ``checkpoint_path`` the single pass is preemption-safe: the carry
+    IS the moment accumulators, so a snapshot after block b resumes at
+    block b+1 with bit-identical sums (``tests/test_faults.py``)."""
     from dask_ml_tpu.parallel.stream import prefetched_scan
 
     d = source.out_struct[0].shape[1]
@@ -84,17 +89,41 @@ def _streamed_moments_host(source):
         carry = _moments_step(carry, blk, transform=source.transform)
         return carry, None
 
-    carry, _ = prefetched_scan(step, _moments_init(d), source)
+    from dask_ml_tpu.parallel.faults import scan_checkpoint_scope
+
+    carry0, start_block = _moments_init(d), 0
+    with scan_checkpoint_scope(
+            checkpoint_path,
+            every=(source.n_blocks if checkpoint_every is None
+                   else int(checkpoint_every)),
+            bind={"what": "streamed_moments", "n_blocks": source.n_blocks,
+                  "d": int(d)}) as scan_ckpt:
+        if scan_ckpt is not None:
+            snap = scan_ckpt.load()
+            if snap is not None:
+                carry, _outs, start_block, _epoch = snap
+                carry0 = tuple(jnp.asarray(t) for t in carry)
+        carry, _ = prefetched_scan(step, carry0, source,
+                                   checkpoint=scan_ckpt,
+                                   start_block=start_block)
+    if scan_ckpt is not None:
+        scan_ckpt.delete()
     return carry
 
 
-def streamed_moments(*, block_fn, n_blocks):
+def streamed_moments(*, block_fn, n_blocks, checkpoint_path=None,
+                     checkpoint_every=None):
     """One pass over all blocks → ``(sw, sums, gram)``:
     Σw, Σ w·x (d,), Σ w·xxᵀ (d, d) — f32 accumulation. ``block_fn`` is a
     traced callable (one compiled scan) or a
     :class:`~dask_ml_tpu.parallel.stream.HostBlockSource` (double-buffered
     host streaming); both run :func:`_accumulate_block` per block, so the
-    moments are identical across modes."""
+    moments are identical across modes.
+
+    ``checkpoint_path``/``checkpoint_every`` (host-source mode only) make
+    the pass preemption-safe — snapshots every k blocks, SIGTERM-driven
+    graceful drain, resume from the last complete block; see
+    ``docs/robustness.md``."""
     from dask_ml_tpu.parallel.stream import HostBlockSource
 
     if isinstance(block_fn, HostBlockSource):
@@ -102,7 +131,12 @@ def streamed_moments(*, block_fn, n_blocks):
             raise ValueError(
                 f"n_blocks={n_blocks} does not match the HostBlockSource's "
                 f"{block_fn.n_blocks} blocks")
-        return _streamed_moments_host(block_fn)
+        return _streamed_moments_host(block_fn, checkpoint_path,
+                                      checkpoint_every)
+    if checkpoint_path is not None:
+        raise ValueError(
+            "checkpoint_path= requires a HostBlockSource: a traced "
+            "block_fn runs the whole pass as one compiled scan")
     return _streamed_moments_device(block_fn=block_fn, n_blocks=int(n_blocks))
 
 
@@ -122,17 +156,22 @@ def _pca_from_moments(sw, s, G):
     return mean, jnp.maximum(evals, 0.0), comps
 
 
-def pca_fit_blocks(block_fn, n_blocks, n_components, pca=None):
+def pca_fit_blocks(block_fn, n_blocks, n_components, pca=None,
+                   checkpoint_path=None, checkpoint_every=None):
     """Fit a :class:`dask_ml_tpu.decomposition.PCA` from streamed blocks.
 
     Returns a fitted PCA estimator (components_, explained_variance_ and
     friends populated from the streamed covariance), usable for
     ``transform``/``inverse_transform`` exactly like an in-memory fit.
     ``pca`` optionally supplies a pre-configured estimator to fill in.
+    ``checkpoint_path``/``checkpoint_every`` (host-source mode) make the
+    moment pass preemption-safe — see :func:`streamed_moments`.
     """
     from dask_ml_tpu.decomposition import PCA
 
-    sw, s, G = streamed_moments(block_fn=block_fn, n_blocks=int(n_blocks))
+    sw, s, G = streamed_moments(block_fn=block_fn, n_blocks=int(n_blocks),
+                                checkpoint_path=checkpoint_path,
+                                checkpoint_every=checkpoint_every)
     mean, evals, comps = _pca_from_moments(sw, s, G)
     mean, evals, comps, sw = jax.device_get((mean, evals, comps, sw))
 

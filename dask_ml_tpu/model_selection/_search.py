@@ -381,7 +381,8 @@ class _CandidateRunner:
     """Executes one (candidate, split) cell with memoized stage fits."""
 
     def __init__(self, estimator, cv_cache: CVCache, memo: _Memo, scorers,
-                 error_score, return_train_score: bool, fit_params=None):
+                 error_score, return_train_score: bool, fit_params=None,
+                 retry_policy=None):
         self.estimator = estimator
         self.cv_cache = cv_cache
         self.memo = memo
@@ -389,6 +390,10 @@ class _CandidateRunner:
         self.error_score = error_score
         self.return_train_score = return_train_score
         self.fit_params = fit_params or {}
+        # transient-error retry for cell fits (parallel/faults.RetryPolicy):
+        # a flaky-I/O or device-transfer failure re-attempts from a fresh
+        # estimator copy before degrading to error_score semantics
+        self.retry_policy = retry_policy
         self._n_samples = (
             None if cv_cache.X is None else _n_rows(cv_cache.X)
         )
@@ -436,6 +441,7 @@ class _CandidateRunner:
                 est, X, y, params=params,
                 fit_params=self._fit_params_for(split_idx),
                 error_score=self.error_score,
+                retry_policy=self.retry_policy,
             )
 
         return self.memo.get_or_run(
@@ -493,6 +499,7 @@ class _CandidateRunner:
             return methods.fit_transform(
                 est, Xin, self._y_train(split_idx), params=params,
                 fit_params=sfit, error_score=self.error_score,
+                retry_policy=self.retry_policy,
             )
 
         (fitted, Xt), t = self.memo.get_or_run(
@@ -525,6 +532,7 @@ class _CandidateRunner:
             return methods.fit(
                 est, Xin, self._y_train(split_idx), params=params,
                 fit_params=sfit, error_score=self.error_score,
+                retry_policy=self.retry_policy,
             )
 
         fitted, t = self.memo.get_or_run(
@@ -548,10 +556,12 @@ class _CandidateRunner:
                 return methods.fit_transform(
                     est, Xin, y, params=params, fit_params=sfit,
                     error_score=self.error_score,
+                    retry_policy=self.retry_policy,
                 )
             return methods.fit(
                 est, Xin, y, params=params, fit_params=sfit,
                 error_score=self.error_score,
+                retry_policy=self.retry_policy,
             )
 
         wl = f"whole-{mode}:{type(est).__name__}"
@@ -799,17 +809,29 @@ class _CandidateRunner:
 
         def run_group():
             t0 = default_timer()
-            est_c = methods.copy_estimator(term_est)
-            if group.static:
-                est_c.set_params(**group.static)
             y_test = self.cv_cache.extract(split_idx, train=False,
                                            is_x=False)
             evals = [(X_test, y_test)]
             if self.return_train_score:
                 evals.append((Xt, self._y_train(split_idx)))
-            try:
-                out = est_c._batched_fit_score(
+
+            def attempt():
+                # fresh copy per attempt: a transient failure mid-program
+                # must not leak partially-mutated estimator state (e.g.
+                # classes_ set by _encode_y) into the retry
+                est_c = methods.copy_estimator(term_est)
+                if group.static:
+                    est_c.set_params(**group.static)
+                return est_c._batched_fit_score(
                     Xt, self._y_train(split_idx), group.members, evals)
+
+            try:
+                if self.retry_policy is None:
+                    out = attempt()
+                else:
+                    out = self.retry_policy.run(
+                        attempt, kind="search-fit",
+                        detail=f"batch:{type(term_est).__name__}")
             except Exception as e:
                 if self.error_score == "raise":
                     raise
@@ -1055,7 +1077,8 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
 
     def __init__(self, estimator, scoring=None, iid=True, refit=True, cv=None,
                  error_score="raise", return_train_score=True, scheduler=None,
-                 n_jobs=-1, cache_cv=True, checkpoint=None):
+                 n_jobs=-1, cache_cv=True, checkpoint=None,
+                 cell_retries=0, cell_timeout=None):
         self.estimator = estimator
         self.scoring = scoring
         self.iid = iid
@@ -1070,6 +1093,14 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
         # path to an append-only cell journal; fit() resumes from it
         # (SURVEY §5.4 — capability-parity-plus over the reference)
         self.checkpoint = checkpoint
+        # fault tolerance (docs/robustness.md): cell_retries re-attempts a
+        # cell fit after a TRANSIENT failure (host I/O, device transfer —
+        # parallel/faults.RetryPolicy classification) before the usual
+        # error_score degradation; cell_timeout (seconds) is a SOFT per-cell
+        # deadline — an overrunning cell scores error_score and the sweep
+        # moves on, instead of one hung candidate poisoning the run
+        self.cell_retries = cell_retries
+        self.cell_timeout = cell_timeout
 
     def _get_param_iterator(self):  # pragma: no cover - abstract
         raise NotImplementedError
@@ -1106,10 +1137,34 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
         candidate_params = list(self._get_param_iterator())
         n_candidates = len(candidate_params)
 
+        if self.cell_timeout and device_native:
+            import jax
+
+            if jax.default_backend() == "cpu":
+                import warnings
+
+                warnings.warn(
+                    "cell_timeout with jax-native estimators on the cpu "
+                    "backend: a timed-out cell's stray thread keeps "
+                    "dispatching mesh-wide programs, and XLA:CPU "
+                    "cross-module collectives can interleave/deadlock with "
+                    "subsequent cells (the same hazard "
+                    "_max_concurrent_device_jobs caps the pool for). "
+                    "Prefer cell_timeout for host-side estimators here; "
+                    "accelerator backends serialize launches per device "
+                    "stream and are safe.",
+                    RuntimeWarning,
+                )
         memo = _Memo()
+        retry_policy = None
+        if self.cell_retries:
+            from dask_ml_tpu.parallel.faults import RetryPolicy
+
+            retry_policy = RetryPolicy(max_retries=int(self.cell_retries))
         runner = _CandidateRunner(
             estimator, cv_cache, memo, scorers,
             self.error_score, self.return_train_score, fit_params=fit_params,
+            retry_policy=retry_policy,
         )
 
         cells = [
@@ -1208,6 +1263,55 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
                 return runner.run_batched(candidate_params[ci], si, group, mi)
             return runner.run(candidate_params[ci], si)
 
+        # Soft per-cell timeout: the cell runs on a dedicated daemon thread
+        # and the worker waits at most cell_timeout seconds. A cell that
+        # overruns scores error_score (never journaled, so a resume retries
+        # it) and the sweep proceeds — threads cannot be killed, so the
+        # stray fit finishes in the background, but it no longer blocks the
+        # run or poisons its results. "Soft" is the honest contract here.
+        timeout_counts = [0]
+        timeout_lock = threading.Lock()
+
+        def _timed_out_result(ci, si):
+            if self.error_score == "raise":
+                raise TimeoutError(
+                    f"search cell (candidate {ci}, split {si}) exceeded "
+                    f"cell_timeout={self.cell_timeout}s")
+            methods.warn_fit_failure(
+                self.error_score,
+                TimeoutError(f"cell exceeded cell_timeout="
+                             f"{self.cell_timeout}s"))
+            test, train, score_time = methods.score(
+                FIT_FAILURE, None, None,
+                FIT_FAILURE if self.return_train_score else None,
+                None, scorers, self.error_score)
+            return test, train, float(self.cell_timeout), score_time, True
+
+        def _compute_cell_deadline(ci, si):
+            if not self.cell_timeout:
+                return _compute_cell(ci, si)
+            box: dict = {}
+
+            def target():
+                # config is thread-local: the cell thread re-enters it
+                try:
+                    with config_lib.config_context(**caller_cfg):
+                        box["result"] = _compute_cell(ci, si)
+                except BaseException as e:  # re-raised on the worker
+                    box["error"] = e
+
+            t = threading.Thread(target=target, daemon=True,
+                                 name=f"search-cell-{ci}-{si}")
+            t.start()
+            t.join(float(self.cell_timeout))
+            if t.is_alive():
+                with timeout_lock:
+                    timeout_counts[0] += 1
+                return _timed_out_result(ci, si)
+            if "error" in box:
+                raise box["error"]
+            return box["result"]
+
         def run_cell(ci, si):
             with config_lib.config_context(**caller_cfg):
                 if journal is not None:
@@ -1219,11 +1323,11 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
                             journal.append(key, hit)
                     if hit is not None:
                         return hit
-                    result = _compute_cell(ci, si)
+                    result = _compute_cell_deadline(ci, si)
                     if not result[-1]:  # journal only non-failed cells
                         journal.append(key, result)
                     return result
-                return _compute_cell(ci, si)
+                return _compute_cell_deadline(ci, si)
 
         # Device-staging memo: jax-native candidates re-stage their CV slice
         # inside fit; within this scope identical (slice, role) pairs upload
@@ -1340,6 +1444,14 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
         # excluded, so the attribute is evidence of which path ran
         self.n_batched_cells_ = runner.n_batched_done
         self._shared_fit_graph = memo.report()
+        # fault-tolerance observability: transient retries spent on cell
+        # fits and cells cut off by the soft timeout, surfaced both as
+        # attributes and in shared_fit_report()
+        self.n_cell_retries_ = (retry_policy.retries
+                                if retry_policy is not None else 0)
+        self.n_cell_timeouts_ = timeout_counts[0]
+        self.retry_stats_ = (retry_policy.stats()
+                             if retry_policy is not None else None)
 
         # best_* availability follows sklearn: single-metric scoring gets
         # best_index_/best_score_/best_params_ even with refit=False;
@@ -1391,9 +1503,17 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
         if not hasattr(self, "_shared_fit_graph"):
             raise AttributeError("Not fitted; call fit first")
         nodes = self._shared_fit_graph
+        header = (f"{len(nodes)} distinct computations served "
+                  f"{sum(m['consumers'] for m in nodes.values())} consumers")
+        retries = getattr(self, "n_cell_retries_", 0)
+        timeouts = getattr(self, "n_cell_timeouts_", 0)
+        if retries or timeouts:
+            header += (f"; {retries} transient fit retr"
+                       f"{'y' if retries == 1 else 'ies'}, "
+                       f"{timeouts} timed-out cell"
+                       f"{'' if timeouts == 1 else 's'}")
         lines = [
-            f"{len(nodes)} distinct computations served "
-            f"{sum(m['consumers'] for m in nodes.values())} consumers",
+            header,
             "",
             f"{'consumers':>9}  {'node':<40} key",
         ]
@@ -1501,12 +1621,14 @@ class GridSearchCV(TPUBaseSearchCV):
     def __init__(self, estimator, param_grid, scoring=None, iid=True,
                  refit=True, cv=None, error_score="raise",
                  return_train_score=True, scheduler=None, n_jobs=-1,
-                 cache_cv=True, checkpoint=None):
+                 cache_cv=True, checkpoint=None, cell_retries=0,
+                 cell_timeout=None):
         super().__init__(
             estimator, scoring=scoring, iid=iid, refit=refit, cv=cv,
             error_score=error_score, return_train_score=return_train_score,
             scheduler=scheduler, n_jobs=n_jobs, cache_cv=cache_cv,
-            checkpoint=checkpoint,
+            checkpoint=checkpoint, cell_retries=cell_retries,
+            cell_timeout=cell_timeout,
         )
         self.param_grid = param_grid
 
@@ -1523,12 +1645,14 @@ class RandomizedSearchCV(TPUBaseSearchCV):
     def __init__(self, estimator, param_distributions, n_iter=10, scoring=None,
                  iid=True, refit=True, cv=None, random_state=None,
                  error_score="raise", return_train_score=True, scheduler=None,
-                 n_jobs=-1, cache_cv=True, checkpoint=None):
+                 n_jobs=-1, cache_cv=True, checkpoint=None, cell_retries=0,
+                 cell_timeout=None):
         super().__init__(
             estimator, scoring=scoring, iid=iid, refit=refit, cv=cv,
             error_score=error_score, return_train_score=return_train_score,
             scheduler=scheduler, n_jobs=n_jobs, cache_cv=cache_cv,
-            checkpoint=checkpoint,
+            checkpoint=checkpoint, cell_retries=cell_retries,
+            cell_timeout=cell_timeout,
         )
         self.param_distributions = param_distributions
         self.n_iter = n_iter

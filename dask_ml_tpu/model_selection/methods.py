@@ -66,17 +66,36 @@ def set_params(est, params):
     return est
 
 
-def fit(est, X, y, params=None, fit_params=None, error_score="raise"):
+def _run_attempts(attempt, retry_policy, label):
+    """Run one fit attempt, optionally under a transient-error retry policy
+    (each attempt starts from a FRESH estimator copy inside ``attempt``, so
+    a partially-fitted failure never leaks into the retry). Non-transient
+    errors propagate immediately and fall into ``error_score`` handling
+    exactly as before."""
+    if retry_policy is None:
+        return attempt()
+    return retry_policy.run(attempt, kind="search-fit", detail=label)
+
+
+def fit(est, X, y, params=None, fit_params=None, error_score="raise",
+        retry_policy=None):
     """Fit a (copied) estimator; returns ``(fitted_or_FIT_FAILURE, fit_time)``
-    (reference: methods.py:194-224)."""
+    (reference: methods.py:194-224). ``retry_policy`` retries transient
+    failures (host I/O, device transfer) before the ``error_score``
+    degradation applies."""
     start = default_timer()
-    try:
-        est = copy_estimator(est)
+
+    def attempt():
+        e2 = copy_estimator(est)
         if params:
-            set_params(est, params)
+            set_params(e2, params)
         if X is FIT_FAILURE:
             raise ValueError("Upstream pipeline stage failed to fit")
-        est.fit(X, y, **(fit_params or {}))
+        e2.fit(X, y, **(fit_params or {}))
+        return e2
+
+    try:
+        est = _run_attempts(attempt, retry_policy, type(est).__name__)
     except Exception as e:
         if error_score == "raise":
             raise
@@ -85,22 +104,28 @@ def fit(est, X, y, params=None, fit_params=None, error_score="raise"):
     return est, default_timer() - start
 
 
-def fit_transform(est, X, y, params=None, fit_params=None, error_score="raise"):
+def fit_transform(est, X, y, params=None, fit_params=None, error_score="raise",
+                  retry_policy=None):
     """Fit+transform for pipeline stages; returns
     ``((fitted, Xt) | (FIT_FAILURE, FIT_FAILURE), fit_time)``
     (reference: methods.py:227-249)."""
     start = default_timer()
-    try:
-        est = copy_estimator(est)
+
+    def attempt():
+        e2 = copy_estimator(est)
         if params:
-            set_params(est, params)
+            set_params(e2, params)
         if X is FIT_FAILURE:
             raise ValueError("Upstream pipeline stage failed to fit")
-        if hasattr(est, "fit_transform"):
-            Xt = est.fit_transform(X, y, **(fit_params or {}))
+        if hasattr(e2, "fit_transform"):
+            Xt = e2.fit_transform(X, y, **(fit_params or {}))
         else:
-            est.fit(X, y, **(fit_params or {}))
-            Xt = est.transform(X)
+            e2.fit(X, y, **(fit_params or {}))
+            Xt = e2.transform(X)
+        return e2, Xt
+
+    try:
+        est, Xt = _run_attempts(attempt, retry_policy, type(est).__name__)
     except Exception as e:
         if error_score == "raise":
             raise

@@ -1004,7 +1004,8 @@ def _host_consensus(z, x_new, u, mask, lamduh, rho, abstol, reltol,
 
 def _admm_streamed_host(source, z0, x0, u0, mask, lamduh, rho, abstol,
                         reltol, inner_tol, sw_total, *, check_done, family,
-                        regularizer, max_iter, inner_max_iter):
+                        regularizer, max_iter, inner_max_iter,
+                        scan_checkpoint=None):
     """Host-driven outer loop over a :class:`HostBlockSource`: block ``b+1``
     transfers (and, across the epoch boundary, block 0 of the next outer
     iteration) while block ``b``'s Newton prox-solve runs. Same math as
@@ -1014,7 +1015,16 @@ def _admm_streamed_host(source, z0, x0, u0, mask, lamduh, rho, abstol,
     ``check_done`` fetches the Boyd convergence flag once per outer
     iteration (one scalar round-trip); the caller disables it when both
     tolerances are exactly 0, keeping the zero-tolerance bench/equivalence
-    runs free of per-iteration syncs."""
+    runs free of per-iteration syncs.
+
+    ``scan_checkpoint`` (a
+    :class:`~dask_ml_tpu.parallel.faults.ScanCheckpoint`) makes the loop
+    preemption-safe: the scan carry is the epoch-start ``(z, x, u)`` and
+    the outs are the per-block primal updates, so a snapshot taken after
+    any block replays the rest of that epoch — and the remaining epochs —
+    with a bit-identical trajectory. A snapshot found at the path resumes
+    here; the file is deleted on completion (it is a resume artifact, and
+    a stale one would hijack the next fit at the same path)."""
     from dask_ml_tpu.parallel.stream import prefetched_scan
 
     n_blocks = int(x0.shape[0])
@@ -1022,6 +1032,15 @@ def _admm_streamed_host(source, z0, x0, u0, mask, lamduh, rho, abstol,
     done = jnp.asarray(False)
     n_iter = 0
     b32 = [jnp.asarray(b, jnp.int32) for b in range(n_blocks)]
+
+    start_epoch, start_block, outs0 = 0, 0, None
+    if scan_checkpoint is not None:
+        snap = scan_checkpoint.load()
+        if snap is not None:
+            carry, outs0, start_block, start_epoch = snap
+            z, x, u = (jnp.asarray(t) for t in carry)
+            outs0 = [jnp.asarray(o) for o in outs0]
+            n_iter = start_epoch
 
     def step(carry, b, blk):
         z, x, u = carry
@@ -1031,9 +1050,13 @@ def _admm_streamed_host(source, z0, x0, u0, mask, lamduh, rho, abstol,
             transform=source.transform)
         return carry, x_b
 
-    for it in range(max_iter):
-        _, xs = prefetched_scan(step, (z, x, u), source,
-                                wrap=it + 1 < max_iter)
+    for it in range(start_epoch, max_iter):
+        first = it == start_epoch
+        _, xs = prefetched_scan(
+            step, (z, x, u), source, wrap=it + 1 < max_iter,
+            checkpoint=scan_checkpoint, epoch=it,
+            start_block=start_block if first else 0,
+            outs=outs0 if first else None)
         x = jnp.stack(xs)
         z, u, done = _host_consensus(
             z, x, u, mask, lamduh, rho, abstol, reltol, sw_total,
@@ -1042,6 +1065,8 @@ def _admm_streamed_host(source, z0, x0, u0, mask, lamduh, rho, abstol,
         if check_done and bool(done):
             break
     source.discard_inflight()
+    if scan_checkpoint is not None:
+        scan_checkpoint.delete()
     return z, jnp.asarray(n_iter, jnp.int32), x, u, done
 
 
@@ -1049,7 +1074,8 @@ def admm_streamed(block_fn, n_blocks, d, sw_total, mask=None, *,
                   family="logistic", regularizer="l2", lamduh=0.0, rho=1.0,
                   max_iter=250, abstol=1e-4, reltol=1e-2, inner_max_iter=20,
                   inner_tol=1e-8, state=None, return_state=False,
-                  dtype=jnp.float32):
+                  dtype=jnp.float32, checkpoint_path=None,
+                  checkpoint_every=None):
     """Consensus ADMM over data LARGER THAN DEVICE MEMORY.
 
     The sharded :func:`admm` holds all of X in HBM; here each outer
@@ -1084,6 +1110,19 @@ def admm_streamed(block_fn, n_blocks, d, sw_total, mask=None, *,
     Returns ``(z, n_iter)``; with ``return_state=True``:
     ``(z, n_iter, (z, x, u), done)`` — the same checkpointable carry
     contract as :func:`admm`, with x/u stacked ``(n_blocks, d)``.
+
+    Preemption safety (host-source mode only): ``checkpoint_path`` makes
+    the fit resumable — every ``checkpoint_every`` completed blocks
+    (default: once per outer iteration) the scan state snapshots through
+    ``checkpoint.save_pytree``, SIGTERM/SIGINT trigger a graceful drain
+    (finish the in-flight block, snapshot, raise
+    :class:`~dask_ml_tpu.parallel.faults.Preempted`), and a re-run with
+    the same path resumes from the last complete block with a
+    bit-identical trajectory (``tests/test_faults.py`` pins this). The
+    snapshot is deleted on completion. Traced ``block_fn`` mode rejects
+    ``checkpoint_path`` — its whole epoch is one compiled program, so
+    chunk it through ``state=``/``return_state`` instead (the
+    ``solve_checkpointed`` pattern).
     """
     from dask_ml_tpu.parallel.stream import HostBlockSource
     if state is None:
@@ -1108,13 +1147,37 @@ def admm_streamed(block_fn, n_blocks, d, sw_total, mask=None, *,
                 f"n_blocks={n_blocks} does not match the HostBlockSource's "
                 f"{block_fn.n_blocks} blocks")
         lam_d, rho_d, abstol_d, reltol_d, tol_d, sw_d = scalars
-        z, n_iter, x, u, done = _admm_streamed_host(
-            block_fn, z0, x0, u0, jnp.asarray(mask, dtype), lam_d, rho_d,
-            abstol_d, reltol_d, tol_d, sw_d,
-            check_done=(float(abstol) != 0.0 or float(reltol) != 0.0),
-            family=family, regularizer=regularizer, max_iter=int(max_iter),
-            inner_max_iter=int(inner_max_iter))
+        from dask_ml_tpu.parallel.faults import scan_checkpoint_scope
+
+        # the bind dict ties the snapshot to its problem (same policy as
+        # solve_checkpointed's fingerprint); max_iter is excluded so a
+        # resume may extend the iteration budget
+        with scan_checkpoint_scope(
+                checkpoint_path,
+                every=(int(n_blocks) if checkpoint_every is None
+                       else int(checkpoint_every)),
+                bind={"what": "admm_streamed", "n_blocks": int(n_blocks),
+                      "d": int(d), "family": family,
+                      "regularizer": regularizer,
+                      "params": repr((float(lamduh), float(rho),
+                                      float(abstol), float(reltol),
+                                      float(inner_tol), float(sw_total),
+                                      int(inner_max_iter)))}) as scan_ckpt:
+            z, n_iter, x, u, done = _admm_streamed_host(
+                block_fn, z0, x0, u0, jnp.asarray(mask, dtype), lam_d,
+                rho_d, abstol_d, reltol_d, tol_d, sw_d,
+                check_done=(float(abstol) != 0.0 or float(reltol) != 0.0),
+                family=family, regularizer=regularizer,
+                max_iter=int(max_iter),
+                inner_max_iter=int(inner_max_iter),
+                scan_checkpoint=scan_ckpt)
     else:
+        if checkpoint_path is not None:
+            raise ValueError(
+                "checkpoint_path= requires a HostBlockSource: a traced "
+                "block_fn runs each epoch as one compiled program, so "
+                "preemption-safe chunking goes through state=/return_state "
+                "instead (see checkpoint.solve_checkpointed)")
         z, n_iter, x, u, done = _admm_streamed_impl(
             z0, x0, u0, jnp.asarray(mask, dtype), *scalars,
             block_fn=block_fn, n_blocks=int(n_blocks), family=family,

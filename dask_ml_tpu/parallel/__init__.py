@@ -29,6 +29,14 @@ from dask_ml_tpu.parallel.sharding import (  # noqa: F401
     shard_rows,
     unpad_rows,
 )
+from dask_ml_tpu.parallel.faults import (  # noqa: F401
+    BlockFetchError,
+    FaultInjector,
+    GracefulDrain,
+    Preempted,
+    RetryPolicy,
+    ScanCheckpoint,
+)
 from dask_ml_tpu.parallel.stream import (  # noqa: F401
     HostBlockSource,
     prefetched_scan,

@@ -1,0 +1,502 @@
+"""Fault tolerance for the long-running host-driven loops.
+
+The >HBM streamed tier (``parallel/stream.py`` + the streamed solvers) and
+the CV search pool (``model_selection/_search.py``) are the two places where
+a fit is a HOST loop over many device dispatches rather than one compiled
+program — which makes them the two places a single transient failure (a
+loader ``OSError``, a failed ``device_put``, a hung candidate fit, a SIGTERM
+from a preemptible slot) used to abort hours of work even though
+``checkpoint.py`` already defines resumable carries. This module turns that
+resumable state into actual fault tolerance:
+
+- :class:`RetryPolicy` — error classification for transient host-I/O and
+  device-transfer failures, exponential backoff with deterministic seeded
+  jitter, a retry budget (``max_retries`` per operation) and a backoff
+  deadline (total seconds the policy may spend sleeping), and counters that
+  surface into bench/search reports. Wired into
+  :class:`~dask_ml_tpu.parallel.stream.HostBlockSource` so loader-mode block
+  fetches survive flaky storage, and into the search pool's cell fits.
+- :class:`GracefulDrain` — SIGTERM/SIGINT trap used by the checkpointed
+  streamed solvers: on a preemption signal the in-flight block finishes, the
+  scan state snapshots through ``checkpoint.save_pytree``, and
+  :class:`Preempted` is raised so the caller can exit cleanly and resume
+  later with a bit-identical trajectory.
+- :class:`ScanCheckpoint` — the ``(carry, outs, next_block, epoch)`` snapshot
+  contract ``prefetched_scan`` saves/loads, with a binding ``meta`` so a
+  snapshot from a different problem is an error, never a silent wrong
+  trajectory (same policy as ``solve_checkpointed``'s fingerprints).
+- :class:`FaultInjector` — deterministic, plan-driven fault injection (fail
+  block b's load, fail a ``device_put``, delay a block, deliver a simulated
+  preemption at block k of epoch e). Tests and ``bench.py --faults`` drill
+  the SAME hooks the real failure paths use, so every recovery path runs in
+  CI instead of being trusted.
+
+Nothing here imports jax at module scope: the policy/injector are plain host
+objects, and snapshots go through :mod:`dask_ml_tpu.checkpoint` (which pulls
+jax lazily), so the layer stays importable in loader processes that never
+touch a device.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import signal
+import threading
+import time
+from contextlib import contextmanager
+from typing import Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "RetryPolicy", "FaultInjector", "GracefulDrain", "ScanCheckpoint",
+    "Preempted", "BlockFetchError", "InjectedFault", "InjectedLoaderError",
+    "InjectedTransferError", "scan_checkpoint_scope",
+]
+
+
+# ---------------------------------------------------------------------------
+# exceptions
+# ---------------------------------------------------------------------------
+
+
+class Preempted(RuntimeError):
+    """A graceful drain completed: the in-flight block finished, the scan
+    state was snapshotted (``path``, when checkpointing was configured), and
+    the run stopped cleanly. Re-running the same call with the same
+    checkpoint path resumes from the snapshot with a bit-identical
+    trajectory."""
+
+    def __init__(self, message: str, path: Optional[str] = None):
+        super().__init__(message)
+        self.path = path
+
+
+class BlockFetchError(RuntimeError):
+    """Terminal (post-retry) failure fetching one block, naming the block
+    index — replaces the bare ``KeyError`` a dead in-flight pipeline slot
+    used to surface."""
+
+
+class InjectedFault:
+    """Marker mixin for injector-raised exceptions (always classified
+    transient, so drills exercise the retry machinery end to end)."""
+
+
+class InjectedLoaderError(InjectedFault, OSError):
+    """Simulated host-I/O failure reading a block."""
+
+
+class InjectedTransferError(InjectedFault, RuntimeError):
+    """Simulated ``device_put`` failure transferring a block."""
+
+
+# ---------------------------------------------------------------------------
+# retry policy
+# ---------------------------------------------------------------------------
+
+
+#: exception types retried by default: host I/O (OSError covers IOError,
+#: ConnectionError, and friends on py3) and timeouts. Device-transfer
+#: failures are matched structurally (see _is_device_runtime_error) because
+#: jaxlib's exception classes move between versions.
+_DEFAULT_TRANSIENT = (OSError, TimeoutError, InjectedFault)
+
+
+def _is_device_runtime_error(exc: BaseException) -> bool:
+    """True for jax/jaxlib runtime errors (failed transfers, device OOM
+    races, backend resets) without importing jaxlib internals: matched by
+    class name/module so the classification survives jaxlib renames."""
+    t = type(exc)
+    return t.__name__ == "XlaRuntimeError" or t.__module__.startswith(
+        ("jaxlib", "jax._src.lib"))
+
+
+class RetryPolicy:
+    """Retry transient failures with exponential backoff + seeded jitter.
+
+    ``max_retries`` is the per-operation retry budget; ``deadline`` caps the
+    TOTAL seconds the policy may spend in backoff across its lifetime (a
+    whole streamed fit shares one policy, so a persistently-down loader
+    exhausts the deadline instead of multiplying per-block budgets).
+    Backoff for attempt ``a`` is ``min(base_delay·multiplier^a, max_delay)``
+    plus uniform jitter in ``[0, jitter·delay]`` drawn from a seeded RNG —
+    deterministic for a fixed seed and call order, so fault-injection drills
+    reproduce exactly.
+
+    Classification: an exception is transient when ``classify`` (if given)
+    says so, or when it is an instance of ``transient_types`` (default:
+    ``OSError``/``TimeoutError``/injected faults), or when it is a
+    jax/jaxlib runtime error and ``retry_device_errors`` is True (the
+    ``device_put`` failure mode this policy exists for). Everything else
+    propagates immediately.
+
+    Counters (``retries``, ``giveups``, ``by_kind``, ``delay_spent``) are
+    thread-safe and surface through :meth:`stats`; ``reset_stats()``
+    between timed runs keeps bench accounting honest.
+    """
+
+    def __init__(self, max_retries: int = 3, *, base_delay: float = 0.05,
+                 max_delay: float = 2.0, multiplier: float = 2.0,
+                 jitter: float = 0.5, deadline: Optional[float] = None,
+                 seed: int = 0, transient_types: Optional[tuple] = None,
+                 classify: Optional[Callable[[BaseException], bool]] = None,
+                 retry_device_errors: bool = True,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.max_retries = int(max_retries)
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.multiplier = float(multiplier)
+        self.jitter = float(jitter)
+        self.deadline = deadline
+        self.transient_types = (_DEFAULT_TRANSIENT if transient_types is None
+                                else tuple(transient_types))
+        self.classify = classify
+        self.retry_device_errors = bool(retry_device_errors)
+        self._sleep = sleep
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.retries = 0
+        self.giveups = 0
+        self.delay_spent = 0.0
+        self.by_kind: dict = {}
+
+    def is_transient(self, exc: BaseException) -> bool:
+        if self.classify is not None and self.classify(exc):
+            return True
+        if isinstance(exc, self.transient_types):
+            return True
+        return self.retry_device_errors and _is_device_runtime_error(exc)
+
+    def backoff_delay(self, attempt: int) -> float:
+        d = min(self.base_delay * self.multiplier ** attempt, self.max_delay)
+        with self._lock:
+            j = self._rng.uniform(0.0, self.jitter * d)
+        return d + j
+
+    def run(self, fn: Callable, *, kind: str = "op", detail: str = ""):
+        """Call ``fn()``; on a transient failure back off and retry, up to
+        ``max_retries`` times and within the deadline. The terminal attempt
+        re-raises the last error (the caller wraps it with context — e.g.
+        the block index)."""
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except Exception as e:
+                if not self.is_transient(e):
+                    raise
+                with self._lock:
+                    exhausted = (
+                        attempt >= self.max_retries
+                        or (self.deadline is not None
+                            and self.delay_spent >= self.deadline))
+                    if exhausted:
+                        self.giveups += 1
+                if exhausted:
+                    raise
+                d = self.backoff_delay(attempt)
+                with self._lock:
+                    self.retries += 1
+                    self.by_kind[kind] = self.by_kind.get(kind, 0) + 1
+                    self.delay_spent += d
+                logger.warning(
+                    "transient %s failure%s — retry %d/%d in %.3fs: %r",
+                    kind, f" ({detail})" if detail else "", attempt + 1,
+                    self.max_retries, d, e)
+                self._sleep(d)
+                attempt += 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"retries": self.retries, "giveups": self.giveups,
+                    "delay_spent_seconds": round(self.delay_spent, 4),
+                    "by_kind": dict(self.by_kind)}
+
+    def reset_stats(self) -> None:
+        with self._lock:
+            self.retries = 0
+            self.giveups = 0
+            self.delay_spent = 0.0
+            self.by_kind = {}
+
+
+# ---------------------------------------------------------------------------
+# graceful drain (preemption signals)
+# ---------------------------------------------------------------------------
+
+
+class GracefulDrain:
+    """SIGTERM/SIGINT → "finish the in-flight block, snapshot, exit cleanly".
+
+    Used as a context manager around a checkpointed streamed fit: on entry
+    it installs handlers that set a flag (previous handlers are restored on
+    exit); ``prefetched_scan`` polls the flag after every completed block
+    and, when set, snapshots and raises :class:`Preempted`. ``request()``
+    sets the flag programmatically — the deterministic path the
+    :class:`FaultInjector` and tests use, identical to a real signal from
+    the scan's point of view.
+
+    Handler installation is skipped off the main thread (``signal.signal``
+    only works there); the drain still works via ``request()``.
+    """
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self._signals = tuple(signals)
+        self._event = threading.Event()
+        self._prev: dict = {}
+        self.installed = False
+
+    def request(self, *_args) -> None:
+        self._event.set()
+
+    @property
+    def requested(self) -> bool:
+        return self._event.is_set()
+
+    def clear(self) -> None:
+        self._event.clear()
+
+    def __enter__(self) -> "GracefulDrain":
+        try:
+            for s in self._signals:
+                self._prev[s] = signal.signal(s, self.request)
+            self.installed = True
+        except ValueError:  # not the main thread: request()-only mode
+            self._prev.clear()
+            self.installed = False
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for s, prev in self._prev.items():
+            signal.signal(s, prev)
+        self._prev.clear()
+        self.installed = False
+        return None
+
+
+# ---------------------------------------------------------------------------
+# scan checkpoint
+# ---------------------------------------------------------------------------
+
+
+class ScanCheckpoint:
+    """Snapshot/restore contract for ``prefetched_scan``.
+
+    A snapshot is ``(carry, outs_so_far)`` plus metadata
+    ``(next_block, epoch)`` — everything needed to replay the host-driven
+    scan from the first incomplete block: the per-block programs are
+    deterministic, so the resumed trajectory is bit-identical to an
+    uninterrupted run (pinned by ``tests/test_faults.py``).
+
+    ``every`` is the snapshot interval in completed blocks (interval saves
+    force one device sync each — size it like ``solve_checkpointed``'s
+    ``chunk_iters``: small enough to bound lost work, large enough that the
+    sync cost stays in the noise). ``bind`` is a dict of problem-identity
+    fields stored in the snapshot metadata; a loaded snapshot whose binding
+    differs is an error, never a silent wrong trajectory. ``drain`` is the
+    :class:`GracefulDrain` the scan polls.
+
+    Writes go through :func:`dask_ml_tpu.checkpoint.save_pytree` (atomic
+    temp-file + ``os.replace``), so a kill mid-save leaves the previous
+    snapshot intact.
+    """
+
+    KIND = "prefetched_scan"
+
+    def __init__(self, path: str, *, every: int = 1,
+                 drain: Optional[GracefulDrain] = None,
+                 bind: Optional[dict] = None):
+        self.path = path
+        self.every = max(int(every), 1)
+        self.drain = drain
+        self.bind = dict(bind or {})
+        self._since = 0
+        self.saves = 0
+
+    def load(self):
+        """→ ``(carry, outs, next_block, epoch)`` or ``None`` when no
+        snapshot exists. Raises on a snapshot from a different problem."""
+        from dask_ml_tpu.checkpoint import load_pytree
+
+        snap = load_pytree(self.path)
+        if snap is None:
+            return None
+        tree, meta = snap
+        if meta.get("kind") != self.KIND:
+            raise ValueError(
+                f"checkpoint {self.path} is not a prefetched_scan snapshot "
+                f"(kind={meta.get('kind')!r})")
+        stored = meta.get("bind", {})
+        for k, v in self.bind.items():
+            if stored.get(k) != v:
+                raise ValueError(
+                    f"checkpoint {self.path} was written for a different "
+                    f"problem ({k}={stored.get(k)!r}, this run has {v!r}); "
+                    "delete it or use a distinct path per fit")
+        return (tree["carry"], list(tree["outs"]),
+                int(meta["next_block"]), int(meta["epoch"]))
+
+    def save(self, carry, outs, next_block: int, epoch: int,
+             reason: str = "interval") -> None:
+        from dask_ml_tpu.checkpoint import save_pytree
+
+        save_pytree(
+            self.path, {"carry": carry, "outs": list(outs)},
+            meta={"kind": self.KIND, "next_block": int(next_block),
+                  "epoch": int(epoch), "bind": self.bind, "reason": reason})
+        self._since = 0
+        self.saves += 1
+
+    def tick(self, carry, outs, next_block: int, epoch: int) -> bool:
+        """Interval bookkeeping: called once per completed block; saves when
+        ``every`` blocks have completed since the last save."""
+        self._since += 1
+        if self._since >= self.every:
+            self.save(carry, outs, next_block, epoch, reason="interval")
+            return True
+        return False
+
+    def delete(self) -> None:
+        """Remove the snapshot (called on successful completion: the file is
+        a resume artifact of an interrupted run, and leaving it behind would
+        let a later run at the same path resume into stale state)."""
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+@contextmanager
+def scan_checkpoint_scope(path: Optional[str], *, every: int, bind: dict):
+    """The checkpointed-scan setup every streamed consumer shares: build a
+    :class:`GracefulDrain` + :class:`ScanCheckpoint`, install the signal
+    handlers for the duration, and yield the checkpoint (``None`` when
+    ``path`` is ``None`` — the caller's code path stays identical either
+    way). The caller loads the snapshot (if it cares) and deletes it on
+    successful completion."""
+    if path is None:
+        yield None
+        return
+    drain = GracefulDrain()
+    ckpt = ScanCheckpoint(path, every=every, drain=drain, bind=bind)
+    with drain:
+        yield ckpt
+
+
+# ---------------------------------------------------------------------------
+# deterministic fault injection
+# ---------------------------------------------------------------------------
+
+
+class FaultInjector:
+    """Deterministic, plan-driven fault injection for streamed pipelines.
+
+    Attach to a :class:`~dask_ml_tpu.parallel.stream.HostBlockSource`
+    (``fault_injector=``); the source calls :meth:`on_load` before reading a
+    block and :meth:`on_transfer` inside each ``device_put`` attempt, and
+    ``prefetched_scan`` calls :meth:`should_preempt` after each completed
+    block. Plans are explicit and exact — *fail block 3's load twice*,
+    *preempt at epoch 2 block 1* — so tests assert recovery, not luck;
+    :meth:`random_load_failures` adds seeded random failures whose sequence
+    is reproducible for a fixed seed and call order (the host loop is
+    single-threaded, so call order is deterministic).
+
+    ``injected`` counts delivered faults by kind; injected exceptions carry
+    the :class:`InjectedFault` marker, which the default
+    :class:`RetryPolicy` classifies transient.
+    """
+
+    def __init__(self, seed: int = 0):
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._load_fail: dict = {}       # block -> [times_left, exc_type]
+        self._transfer_fail: dict = {}   # block -> times_left
+        self._load_delay: dict = {}      # block -> [times_left, seconds]
+        self._preempt: set = set()       # {(epoch, block)}
+        self._p_load = 0.0
+        self._p_exc = InjectedLoaderError
+        self.injected = {"load": 0, "transfer": 0, "delay": 0, "preempt": 0}
+
+    # -- planning ----------------------------------------------------------
+
+    def fail_load(self, block: int, *, times: int = 1,
+                  exc_type=InjectedLoaderError) -> "FaultInjector":
+        """Fail the next ``times`` reads of ``block`` (re-reads across
+        retries/epochs count down the same budget)."""
+        self._load_fail[int(block)] = [int(times), exc_type]
+        return self
+
+    def fail_transfer(self, block: int, *, times: int = 1) -> "FaultInjector":
+        """Fail the next ``times`` ``device_put`` attempts of ``block``."""
+        self._transfer_fail[int(block)] = int(times)
+        return self
+
+    def delay_load(self, block: int, seconds: float, *,
+                   times: int = 1) -> "FaultInjector":
+        """Sleep ``seconds`` before the next ``times`` reads of ``block``
+        (models a slow storage stall; exercises overlap under skew)."""
+        self._load_delay[int(block)] = [int(times), float(seconds)]
+        return self
+
+    def preempt_at(self, block: int, *, epoch: int = 0) -> "FaultInjector":
+        """Deliver a simulated preemption after block ``block`` of epoch
+        ``epoch`` completes — identical to a SIGTERM landing there, minus
+        the race: the drill is exact."""
+        self._preempt.add((int(epoch), int(block)))
+        return self
+
+    def random_load_failures(self, p: float,
+                             exc_type=InjectedLoaderError) -> "FaultInjector":
+        """Every block read fails with probability ``p`` (seeded RNG)."""
+        self._p_load = float(p)
+        self._p_exc = exc_type
+        return self
+
+    # -- hooks (called by the pipeline) ------------------------------------
+
+    def on_load(self, block: int) -> None:
+        with self._lock:
+            plan = self._load_delay.get(block)
+            delay = None
+            if plan and plan[0] > 0:
+                plan[0] -= 1
+                delay = plan[1]
+                self.injected["delay"] += 1
+        if delay:
+            time.sleep(delay)
+        with self._lock:
+            plan = self._load_fail.get(block)
+            if plan and plan[0] > 0:
+                plan[0] -= 1
+                self.injected["load"] += 1
+                exc = plan[1](f"injected load failure for block {block}")
+            elif self._p_load and self._rng.random() < self._p_load:
+                self.injected["load"] += 1
+                exc = self._p_exc(f"injected load failure for block {block}")
+            else:
+                return
+        raise exc
+
+    def on_transfer(self, block: int) -> None:
+        with self._lock:
+            left = self._transfer_fail.get(block, 0)
+            if left > 0:
+                self._transfer_fail[block] = left - 1
+                self.injected["transfer"] += 1
+                exc = InjectedTransferError(
+                    f"injected device_put failure for block {block}")
+            else:
+                return
+        raise exc
+
+    def should_preempt(self, block: int, epoch: int) -> bool:
+        with self._lock:
+            key = (int(epoch), int(block))
+            if key in self._preempt:
+                self._preempt.discard(key)  # one-shot: resume runs clean
+                self.injected["preempt"] += 1
+                return True
+        return False

@@ -54,6 +54,8 @@ from typing import Callable, Optional, Sequence
 import jax
 import numpy as np
 
+from dask_ml_tpu.parallel.faults import BlockFetchError, Preempted
+
 __all__ = ["HostBlockSource", "prefetched_scan"]
 
 
@@ -113,15 +115,28 @@ class HostBlockSource:
     to: 2 = double buffering (one block computing, one in flight); 0 =
     strict serial transfer→compute alternation (the overlap-off baseline).
 
+    ``retry_policy`` (a :class:`~dask_ml_tpu.parallel.faults.RetryPolicy`)
+    makes block reads and ``device_put`` transfers survive transient
+    failures — flaky object storage in loader mode, backend transfer
+    hiccups — with exponential backoff; without one, the first failure
+    propagates as before. ``fault_injector`` (a
+    :class:`~dask_ml_tpu.parallel.faults.FaultInjector`) deterministically
+    injects those failures for tests and the ``bench.py --faults`` drill.
+
     The source tracks ``bytes_streamed``/``blocks_started`` for effective-
-    bandwidth accounting (``reset_stats()`` between timed runs).
+    bandwidth accounting (``reset_stats()`` between timed runs). The
+    counters increment only when a transfer is successfully issued — a
+    failed-then-retried ``device_put`` counts once — and
+    ``discard_inflight()`` rolls issued-but-never-consumed transfers back
+    out, so the stats always equal the blocks compute actually consumed.
     """
 
     def __init__(self, arrays: Optional[Sequence[np.ndarray]] = None,
                  n_blocks: Optional[int] = None, *,
                  loader: Optional[Callable[[int], tuple]] = None,
                  transform: Optional[Callable] = None,
-                 prefetch: int = 2, device=None):
+                 prefetch: int = 2, device=None,
+                 retry_policy=None, fault_injector=None):
         if (arrays is None) == (loader is None):
             raise ValueError(
                 "pass exactly one of `arrays` (host array tuple) or "
@@ -151,7 +166,10 @@ class HostBlockSource:
                     "compiled once")
             self._arrays = arrays
             self._rows = n // self.n_blocks
+        self.retry_policy = retry_policy
+        self.fault_injector = fault_injector
         self._inflight: dict = {}
+        self._inflight_bytes: dict = {}
         self.bytes_streamed = 0
         self.blocks_started = 0
 
@@ -159,13 +177,24 @@ class HostBlockSource:
 
     def host_block(self, b: int) -> tuple:
         """Block ``b`` as host arrays (views into the owned arrays, or the
-        loader's output coerced to ndarrays)."""
+        loader's output coerced to ndarrays). Under a ``retry_policy``,
+        transient read failures (loader ``OSError``/timeouts) back off and
+        retry before propagating."""
         if not 0 <= b < self.n_blocks:
             raise IndexError(f"block {b} out of range [0, {self.n_blocks})")
-        if self._arrays is not None:
-            s = b * self._rows
-            return tuple(a[s:s + self._rows] for a in self._arrays)
-        return tuple(np.asarray(a) for a in self._loader(b))
+
+        def read():
+            if self.fault_injector is not None:
+                self.fault_injector.on_load(b)
+            if self._arrays is not None:
+                s = b * self._rows
+                return tuple(a[s:s + self._rows] for a in self._arrays)
+            return tuple(np.asarray(a) for a in self._loader(b))
+
+        if self.retry_policy is None:
+            return read()
+        return self.retry_policy.run(read, kind="block-load",
+                                     detail=f"block {b}")
 
     @property
     def out_struct(self) -> tuple:
@@ -187,32 +216,85 @@ class HostBlockSource:
 
     def start(self, b: int) -> None:
         """Issue the (asynchronous) host→device transfer of block ``b``.
-        Idempotent while the block is in flight."""
+        Idempotent while the block is in flight. Under a ``retry_policy``,
+        a transient ``device_put`` failure backs off and re-issues; the
+        stats increment only once the transfer is successfully issued, so
+        retried transfers never double-count bytes (the effective-GB/s
+        numbers in ``bench.py`` stay honest across retries)."""
         if b in self._inflight:
             return
         blk = self.host_block(b)
-        self.bytes_streamed += sum(int(a.nbytes) for a in blk)
+
+        def put():
+            if self.fault_injector is not None:
+                self.fault_injector.on_transfer(b)
+            return tuple(jax.device_put(a, self._device) for a in blk)
+
+        if self.retry_policy is None:
+            dev = put()
+        else:
+            dev = self.retry_policy.run(put, kind="device-put",
+                                        detail=f"block {b}")
+        nbytes = sum(int(a.nbytes) for a in blk)
+        self._inflight[b] = dev
+        self._inflight_bytes[b] = nbytes
+        self.bytes_streamed += nbytes
         self.blocks_started += 1
-        self._inflight[b] = tuple(
-            jax.device_put(a, self._device) for a in blk)
 
     def take(self, b: int) -> tuple:
         """Device arrays for block ``b`` — already in flight when the
         pipeline prefetched it, started on demand otherwise. The slot is
-        released so the block can be re-streamed on a later epoch."""
+        released so the block can be re-streamed on a later epoch.
+
+        If a prior ``start(b)`` died mid-pipeline (its transfer failed and
+        left no in-flight slot), the fetch is re-issued here under the
+        retry policy; a terminal failure raises
+        :class:`~dask_ml_tpu.parallel.faults.BlockFetchError` naming the
+        block index instead of a bare ``KeyError``."""
         dev = self._inflight.pop(b, None)
         if dev is None:
-            self.start(b)
-            dev = self._inflight.pop(b)
+            try:
+                self.start(b)
+            except (IndexError, BlockFetchError):
+                raise
+            except Exception as e:
+                raise BlockFetchError(
+                    f"block {b}/{self.n_blocks}: fetch failed terminally "
+                    f"after retries ({type(e).__name__}: {e})") from e
+            dev = self._inflight.pop(b, None)
+            if dev is None:  # pragma: no cover - start() postcondition
+                raise BlockFetchError(
+                    f"block {b}/{self.n_blocks}: start() completed without "
+                    "an in-flight transfer")
+        self._inflight_bytes.pop(b, None)
         return dev
 
     def discard_inflight(self) -> None:
-        """Drop queued transfers (end of run / early convergence exit)."""
-        self._inflight.clear()
+        """Drop queued transfers (end of run / early convergence exit) and
+        roll them back out of the stats: a discarded transfer was issued
+        but never consumed by compute, and counting it would inflate this
+        run's effective GB/s — and leak wrapped-around lookahead into the
+        next timed run's accounting. Transfers issued before a
+        ``reset_stats()`` boundary (rollback entry ``None``) were never
+        part of the current counters and are dropped without subtracting."""
+        for b in list(self._inflight):
+            nbytes = self._inflight_bytes.pop(b, None)
+            if nbytes is not None:
+                self.bytes_streamed -= nbytes
+                self.blocks_started -= 1
+            del self._inflight[b]
 
     def reset_stats(self) -> None:
+        """Zero the transfer counters (between timed runs). Transfers
+        still in flight were issued against the OLD counters, so their
+        rollback entries are neutralized — a later ``discard_inflight()``
+        must not subtract pre-reset bytes from the fresh zeros. The retry
+        policy's counters are its own (``retry_policy.reset_stats()``) —
+        they double as the deadline budget, which a new timed run does not
+        automatically refill."""
         self.bytes_streamed = 0
         self.blocks_started = 0
+        self._inflight_bytes = {b: None for b in self._inflight}
 
     def with_transform(self, fn: Callable) -> "HostBlockSource":
         """A copy of this source whose blocks pass through ``fn`` (applied
@@ -223,13 +305,18 @@ class HostBlockSource:
         src.transform = fn if self.transform is None else _Compose(
             fn, self.transform)
         src._inflight = {}
+        src._inflight_bytes = {}
         src._out_struct = None  # the copy's transform changes the shapes
         src.reset_stats()
+        # retry_policy/fault_injector are shared by reference: counters and
+        # injection plans stay visible on the caller's objects
         return src
 
 
 def prefetched_scan(step, carry, source: HostBlockSource, *,
-                    prefetch: Optional[int] = None, wrap: bool = False):
+                    prefetch: Optional[int] = None, wrap: bool = False,
+                    checkpoint=None, epoch: int = 0, start_block: int = 0,
+                    outs: Optional[list] = None):
     """Host-driven ``lax.scan`` over a :class:`HostBlockSource`.
 
     ``step(carry, b, block) -> (carry, out)`` must dispatch jitted work and
@@ -249,21 +336,60 @@ def prefetched_scan(step, carry, source: HostBlockSource, *,
     compute is dispatched, and the compute is forced to complete before the
     next transfer is issued, i.e. the exact schedule the traced-scan mode
     imposes on block production.
+
+    Preemption safety (``checkpoint``: a
+    :class:`~dask_ml_tpu.parallel.faults.ScanCheckpoint`): after every
+    completed block the scan (a) snapshots ``(carry, outs, next_block,
+    epoch)`` when the interval says so, and (b) polls the checkpoint's
+    :class:`~dask_ml_tpu.parallel.faults.GracefulDrain` flag and the
+    source's fault injector — a requested drain (SIGTERM/SIGINT, or a
+    simulated preemption) finishes the in-flight block, discards queued
+    transfers, snapshots, and raises
+    :class:`~dask_ml_tpu.parallel.faults.Preempted`. ``start_block`` /
+    ``outs`` / ``epoch`` are the resume coordinates a loaded snapshot
+    provides: the scan replays from the first incomplete block with a
+    bit-identical trajectory (the per-block programs are deterministic
+    functions of the carry and block contents).
     """
     n = source.n_blocks
     depth = source.prefetch if prefetch is None else int(prefetch)
-    outs = []
+    outs = [] if outs is None else list(outs)
+    start_block = int(start_block)
+    injector = getattr(source, "fault_injector", None)
+
+    def after_block(b, carry):
+        """Post-block bookkeeping: may snapshot; raises Preempted on a
+        drain request or an injected preemption."""
+        preempt = injector is not None and injector.should_preempt(b, epoch)
+        if checkpoint is None:
+            if preempt:
+                source.discard_inflight()
+                raise Preempted(
+                    f"preempted after block {b} of epoch {epoch} with no "
+                    "checkpoint configured; progress was lost")
+            return
+        drain = checkpoint.drain
+        if preempt or (drain is not None and drain.requested):
+            source.discard_inflight()
+            checkpoint.save(carry, outs, b + 1, epoch, reason="preempt")
+            raise Preempted(
+                f"graceful drain: snapshot at block {b + 1}/{n} of epoch "
+                f"{epoch} saved to {checkpoint.path}; re-run with the same "
+                "checkpoint path to resume", path=checkpoint.path)
+        checkpoint.tick(carry, outs, b + 1, epoch)
+
     if depth <= 0:
-        for b in range(n):
+        for b in range(start_block, n):
             blk = source.take(b)
             _sync(blk)
             carry, out = step(carry, b, blk)
             _sync(out if out is not None else carry)
             outs.append(out)
+            after_block(b, carry)
         return carry, outs
-    for j in range(min(depth, n)):
-        source.start(j)
-    for b in range(n):
+    for j in range(min(depth, n - start_block)):
+        source.start(start_block + j)
+    for b in range(start_block, n):
         blk = source.take(b)
         nxt = b + depth
         if nxt < n:
@@ -272,4 +398,5 @@ def prefetched_scan(step, carry, source: HostBlockSource, *,
             source.start(nxt - n)
         carry, out = step(carry, b, blk)
         outs.append(out)
+        after_block(b, carry)
     return carry, outs

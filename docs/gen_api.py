@@ -50,6 +50,11 @@ SECTIONS = [
      "argmin / weighted-accumulation epilogues) with measured "
      "fused-vs-XLA dispatch — see docs/kernels.md for the family's "
      "design, thresholds, and measurement method."),
+    ("dask_ml_tpu.parallel.faults", "Fault tolerance",
+     "Retry/backoff for transient host-I/O and device-transfer failures, "
+     "preemption-safe checkpoint/drain/resume for the streamed tier, and "
+     "the deterministic fault-injection harness — see docs/robustness.md "
+     "for the contract and the CI drill."),
     ("dask_ml_tpu.datasets", "Datasets",
      "Device-generated, mesh-sharded synthetic datasets."),
     ("dask_ml_tpu", "Top level",

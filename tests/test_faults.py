@@ -1,0 +1,765 @@
+"""Fault tolerance: retry/backoff, preemption-safe resume, and the
+deterministic fault-injection harness (dask_ml_tpu/parallel/faults.py).
+
+Every recovery path is driven by the FaultInjector through the SAME hooks
+real failures take, so CI exercises recovery instead of trusting it. The
+two acceptance pins:
+
+- a streamed ADMM fit interrupted by an injected preemption at an
+  arbitrary block, resumed from its snapshot, produces a BIT-IDENTICAL
+  final (z, x, u) trajectory to an uninterrupted run;
+- an injected transient loader failure is retried and converges with
+  identical results while the retry counters record the event.
+"""
+
+import os
+import signal
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dask_ml_tpu.models import glm as glm_core
+from dask_ml_tpu.parallel.faults import (BlockFetchError, FaultInjector,
+                                         GracefulDrain, InjectedLoaderError,
+                                         InjectedTransferError, Preempted,
+                                         RetryPolicy, ScanCheckpoint)
+from dask_ml_tpu.parallel.stream import HostBlockSource, prefetched_scan
+
+
+def _no_sleep(_):
+    pass
+
+
+def _policy(**kw):
+    kw.setdefault("sleep", _no_sleep)
+    return RetryPolicy(**kw)
+
+
+def _problem(n=320, d=4, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    beta = rng.randn(d).astype(np.float32)
+    y = (X @ beta + 0.3 * rng.randn(n) > 0).astype(np.float32)
+    return X, y, np.ones(n, np.float32)
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+
+def test_retry_policy_classification():
+    p = _policy()
+    assert p.is_transient(OSError("disk"))
+    assert p.is_transient(TimeoutError("slow"))
+    assert p.is_transient(InjectedLoaderError("x"))
+    assert p.is_transient(InjectedTransferError("x"))
+    assert not p.is_transient(ValueError("shape mismatch"))
+    assert not p.is_transient(KeyError("k"))
+    # structural match for jaxlib runtime errors, by name (the class moves
+    # between jaxlib versions)
+    XlaRuntimeError = type("XlaRuntimeError", (RuntimeError,), {})
+    assert p.is_transient(XlaRuntimeError("transfer failed"))
+    strict = _policy(retry_device_errors=False)
+    assert not strict.is_transient(XlaRuntimeError("transfer failed"))
+    # custom classifier wins
+    custom = _policy(classify=lambda e: isinstance(e, ValueError))
+    assert custom.is_transient(ValueError("now transient"))
+
+
+def test_retry_policy_succeeds_after_transients_and_counts():
+    p = _policy(max_retries=3)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("hiccup")
+        return "ok"
+
+    assert p.run(flaky, kind="block-load") == "ok"
+    s = p.stats()
+    assert s["retries"] == 2 and s["giveups"] == 0
+    assert s["by_kind"] == {"block-load": 2}
+    p.reset_stats()
+    assert p.stats()["retries"] == 0
+
+
+def test_retry_policy_exhaustion_reraises_and_counts_giveup():
+    p = _policy(max_retries=2)
+    with pytest.raises(OSError, match="down"):
+        p.run(lambda: (_ for _ in ()).throw(OSError("down")))
+    assert p.stats() == {"retries": 2, "giveups": 1,
+                         "delay_spent_seconds": p.stats()[
+                             "delay_spent_seconds"],
+                         "by_kind": {"op": 2}}
+
+
+def test_retry_policy_nontransient_propagates_immediately():
+    p = _policy(max_retries=5)
+    calls = []
+
+    def bad():
+        calls.append(1)
+        raise ValueError("not transient")
+
+    with pytest.raises(ValueError):
+        p.run(bad)
+    assert len(calls) == 1 and p.stats()["retries"] == 0
+
+
+def test_retry_policy_backoff_deterministic_and_bounded():
+    a = RetryPolicy(seed=7, base_delay=0.1, max_delay=0.5, jitter=0.5)
+    b = RetryPolicy(seed=7, base_delay=0.1, max_delay=0.5, jitter=0.5)
+    da = [a.backoff_delay(i) for i in range(6)]
+    db = [b.backoff_delay(i) for i in range(6)]
+    assert da == db  # seeded jitter: drills reproduce exactly
+    for i, d in enumerate(da):
+        base = min(0.1 * 2.0 ** i, 0.5)
+        assert base <= d <= base * 1.5
+    c = RetryPolicy(seed=8, base_delay=0.1, max_delay=0.5, jitter=0.5)
+    assert [c.backoff_delay(i) for i in range(6)] != da
+
+
+def test_retry_policy_deadline_caps_total_backoff():
+    p = _policy(max_retries=100, base_delay=0.2, multiplier=1.0,
+                jitter=0.0, deadline=0.5)
+    with pytest.raises(OSError):
+        p.run(lambda: (_ for _ in ()).throw(OSError("down")))
+    s = p.stats()
+    # 0.2s per retry against a 0.5s deadline: the third check trips it
+    assert s["retries"] == 3 and s["giveups"] == 1
+
+
+# ---------------------------------------------------------------------------
+# HostBlockSource + injection: loads, transfers, stats honesty
+# ---------------------------------------------------------------------------
+
+
+def test_loader_mode_survives_flaky_storage_with_exact_stats():
+    X, y, w = _problem(n=64)
+    reads = []
+
+    def loader(b):
+        reads.append(b)
+        s = b * 16
+        return X[s:s + 16], y[s:s + 16], w[s:s + 16]
+
+    inj = FaultInjector().fail_load(2, times=2)
+    pol = _policy(max_retries=3)
+    src = HostBlockSource(loader=loader, n_blocks=4, retry_policy=pol,
+                          fault_injector=inj)
+
+    def step(carry, b, blk):
+        Xb, yb, wb = blk
+        return carry + jnp.sum(Xb), b
+
+    carry, outs = prefetched_scan(step, jnp.asarray(0.0, jnp.float32), src)
+    np.testing.assert_allclose(float(carry), float(np.sum(X)), rtol=1e-5)
+    assert outs == [0, 1, 2, 3]
+    # the injector failed block 2's read twice BEFORE the loader ran, so
+    # the loader saw exactly one successful read per block...
+    assert reads == [0, 1, 2, 3]
+    assert inj.injected["load"] == 2
+    assert pol.stats()["by_kind"] == {"block-load": 2}
+    # ...and the stats count each block once — no double-counting across
+    # retries (the effective-GB/s satellite)
+    assert src.blocks_started == 4
+    assert src.bytes_streamed == X.nbytes + y.nbytes + w.nbytes
+
+
+def test_transfer_retry_does_not_double_count_bytes():
+    X, y, w = _problem(n=64)
+    inj = FaultInjector().fail_transfer(1, times=2)
+    pol = _policy(max_retries=3)
+    src = HostBlockSource((X, y, w), 4, retry_policy=pol, fault_injector=inj)
+    clean = HostBlockSource((X, y, w), 4)
+    for b in range(4):
+        src.take(b)
+        clean.take(b)
+    assert inj.injected["transfer"] == 2
+    assert src.blocks_started == clean.blocks_started == 4
+    assert src.bytes_streamed == clean.bytes_streamed
+    assert pol.stats()["by_kind"] == {"device-put": 2}
+
+
+def test_failed_start_without_retry_counts_nothing():
+    """A terminally-failed transfer leaves the counters untouched: stats
+    increment only after the transfer is issued (the satellite bug was
+    counting bytes BEFORE device_put could fail)."""
+    X, y, w = _problem(n=64)
+    inj = FaultInjector().fail_transfer(0, times=1)
+    src = HostBlockSource((X, y, w), 4, fault_injector=inj)  # no retry
+    with pytest.raises(InjectedTransferError):
+        src.start(0)
+    assert src.blocks_started == 0 and src.bytes_streamed == 0
+    assert src._inflight == {}
+
+
+def test_take_recovers_from_dead_start_and_names_block_on_terminal():
+    X, y, w = _problem(n=64)
+    # one-shot transfer failure: the prefetch-time start() dies, the
+    # take()-time re-issue succeeds — no bare KeyError anywhere
+    inj = FaultInjector().fail_transfer(1, times=1)
+    src = HostBlockSource((X, y, w), 4, fault_injector=inj)
+    with pytest.raises(InjectedTransferError):
+        src.start(1)
+    blk = src.take(1)  # re-issues the fetch
+    assert len(blk) == 3
+    assert src.blocks_started == 1
+
+    # terminal failure: a clear error naming the block index
+    inj2 = FaultInjector().fail_transfer(2, times=100)
+    pol = _policy(max_retries=1)
+    src2 = HostBlockSource((X, y, w), 4, retry_policy=pol,
+                           fault_injector=inj2)
+    with pytest.raises(BlockFetchError, match=r"block 2/4"):
+        src2.take(2)
+    assert pol.stats()["giveups"] == 1
+
+
+def test_injector_delay_and_random_failures_are_deterministic():
+    X, y, w = _problem(n=64)
+    inj = FaultInjector(seed=3).delay_load(0, 0.05)
+    src = HostBlockSource((X, y, w), 4, fault_injector=inj)
+    t0 = time.perf_counter()
+    src.take(0)
+    assert time.perf_counter() - t0 >= 0.05
+    assert inj.injected["delay"] == 1
+
+    def failures(seed):
+        inj = FaultInjector(seed=seed).random_load_failures(0.5)
+        src = HostBlockSource((X, y, w), 4, fault_injector=inj,
+                              retry_policy=_policy(max_retries=10))
+        for b in range(4):
+            src.take(b)
+        return inj.injected["load"]
+
+    assert failures(11) == failures(11)  # same seed → same fault sequence
+
+
+# ---------------------------------------------------------------------------
+# graceful drain + scan checkpoint
+# ---------------------------------------------------------------------------
+
+
+def test_graceful_drain_traps_and_restores_signal_handlers():
+    drain = GracefulDrain(signals=(signal.SIGTERM,))
+    prev = signal.getsignal(signal.SIGTERM)
+    with drain:
+        if not drain.installed:  # non-main-thread runner: request() path
+            drain.request()
+        else:
+            signal.raise_signal(signal.SIGTERM)
+        assert drain.requested
+    assert signal.getsignal(signal.SIGTERM) is prev
+    drain.clear()
+    assert not drain.requested
+
+
+def test_prefetched_scan_drain_flag_snapshots_and_raises(tmp_path):
+    X, y, w = _problem(n=64)
+    src = HostBlockSource((X, y, w), 4)
+    drain = GracefulDrain()
+    ckpt = ScanCheckpoint(str(tmp_path / "scan.ckpt"), every=100,
+                          drain=drain, bind={"what": "test"})
+    seen = []
+
+    def step(carry, b, blk):
+        seen.append(b)
+        if b == 1:
+            drain.request()  # a SIGTERM landing mid-block
+        return carry + 1, b
+
+    with pytest.raises(Preempted) as ei:
+        prefetched_scan(step, 0, src, checkpoint=ckpt)
+    # the in-flight block FINISHED (graceful), later blocks never ran
+    assert seen == [0, 1]
+    assert ei.value.path == ckpt.path
+    assert src._inflight == {}  # queued lookahead discarded
+
+    carry, outs, next_block, epoch = ckpt.load()
+    assert (carry, next_block, epoch) == (2, 2, 0)
+    assert outs == [0, 1]
+    # resume replays the remainder only
+    seen.clear()
+    carry, outs = prefetched_scan(step, carry, src, start_block=next_block,
+                                  outs=outs)
+    assert seen == [2, 3] and carry == 4 and outs == [0, 1, 2, 3]
+
+
+def test_scan_checkpoint_interval_and_bind_mismatch(tmp_path):
+    X, y, w = _problem(n=64)
+    src = HostBlockSource((X, y, w), 4)
+    path = str(tmp_path / "scan.ckpt")
+    ckpt = ScanCheckpoint(path, every=2, bind={"n_blocks": 4})
+
+    def step(carry, b, blk):
+        return carry + 1, None
+
+    prefetched_scan(step, 0, src, checkpoint=ckpt)
+    assert ckpt.saves == 2  # blocks 2 and 4 (every=2)
+    carry, outs, next_block, epoch = ckpt.load()
+    assert carry == 4 and next_block == 4
+
+    with pytest.raises(ValueError, match="different problem"):
+        ScanCheckpoint(path, bind={"n_blocks": 8}).load()
+
+
+def test_injected_preemption_without_checkpoint_is_loud():
+    X, y, w = _problem(n=64)
+    inj = FaultInjector().preempt_at(block=1, epoch=0)
+    src = HostBlockSource((X, y, w), 4, fault_injector=inj)
+    with pytest.raises(Preempted, match="progress was lost"):
+        prefetched_scan(lambda c, b, blk: (c, None), None, src)
+
+
+# ---------------------------------------------------------------------------
+# acceptance: streamed ADMM preemption → resume, bit-identical
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("preempt_at", [(0, 0), (2, 1), (5, 3)],
+                         ids=["first-block", "mid-epoch", "last-block"])
+def test_streamed_admm_preempt_resume_bit_identical(tmp_path, preempt_at):
+    """The acceptance pin: interrupt at an arbitrary (epoch, block), resume
+    from the snapshot, and the final (z, x, u) trajectory is BIT-identical
+    to an uninterrupted run."""
+    epoch, block = preempt_at
+    X, y, w = _problem()
+    n, d = X.shape
+    kw = dict(family="logistic", regularizer="l2", lamduh=0.5,
+              abstol=0.0, reltol=0.0)
+
+    z_full, _, (zf, xf, uf), _ = glm_core.admm_streamed(
+        HostBlockSource((X, y, w), 4), 4, d, float(n), max_iter=6,
+        return_state=True, **kw)
+
+    path = str(tmp_path / "admm.ckpt")
+    inj = FaultInjector().preempt_at(block=block, epoch=epoch)
+    with pytest.raises(Preempted) as ei:
+        glm_core.admm_streamed(
+            HostBlockSource((X, y, w), 4, fault_injector=inj), 4, d,
+            float(n), max_iter=6, checkpoint_path=path, **kw)
+    assert ei.value.path == path and os.path.exists(path)
+    assert inj.injected["preempt"] == 1
+
+    z, n_iter, (zr, xr, ur), _ = glm_core.admm_streamed(
+        HostBlockSource((X, y, w), 4), 4, d, float(n), max_iter=6,
+        checkpoint_path=path, return_state=True, **kw)
+    assert int(n_iter) == 6
+    np.testing.assert_array_equal(np.asarray(zr), np.asarray(zf))
+    np.testing.assert_array_equal(np.asarray(xr), np.asarray(xf))
+    np.testing.assert_array_equal(np.asarray(ur), np.asarray(uf))
+    # completed: the snapshot is deleted so it cannot hijack the next fit
+    assert not os.path.exists(path)
+
+
+def test_streamed_admm_transient_faults_identical_results(tmp_path):
+    """The second acceptance pin: injected transient loader AND transfer
+    failures are retried; the fit converges with identical results and the
+    counters record the events."""
+    X, y, w = _problem()
+    n, d = X.shape
+    kw = dict(family="logistic", regularizer="l1", lamduh=0.3,
+              abstol=0.0, reltol=0.0)
+    z_clean, _ = glm_core.admm_streamed(
+        HostBlockSource((X, y, w), 4), 4, d, float(n), max_iter=5, **kw)
+
+    pol = _policy(max_retries=3)
+    inj = FaultInjector().fail_load(1, times=2).fail_transfer(3, times=1)
+    src = HostBlockSource((X, y, w), 4, retry_policy=pol, fault_injector=inj)
+    z_faulty, _ = glm_core.admm_streamed(src, 4, d, float(n), max_iter=5,
+                                         **kw)
+    np.testing.assert_array_equal(np.asarray(z_faulty), np.asarray(z_clean))
+    s = pol.stats()
+    assert s["retries"] == 3 and s["giveups"] == 0
+    assert s["by_kind"] == {"block-load": 2, "device-put": 1}
+    assert inj.injected["load"] == 2 and inj.injected["transfer"] == 1
+    # 5 epochs × 4 blocks, each counted once despite the retries
+    assert src.blocks_started == 20
+
+
+def test_streamed_admm_checkpoint_rejects_traced_mode():
+    X, y, w = _problem(n=64)
+    Xd, yd = jnp.asarray(X), jnp.asarray(y)
+
+    def block_fn(b):
+        import jax
+
+        Xb = jax.lax.dynamic_slice_in_dim(Xd, b * 16, 16, axis=0)
+        yb = jax.lax.dynamic_slice_in_dim(yd, b * 16, 16, axis=0)
+        return Xb, yb, jnp.ones((16,), jnp.float32)
+
+    with pytest.raises(ValueError, match="HostBlockSource"):
+        glm_core.admm_streamed(block_fn, 4, 4, 64.0, max_iter=2,
+                               checkpoint_path="/tmp/nope")
+
+
+def test_streamed_admm_checkpoint_rejects_different_problem(tmp_path):
+    X, y, w = _problem()
+    n, d = X.shape
+    path = str(tmp_path / "admm.ckpt")
+    inj = FaultInjector().preempt_at(block=1, epoch=1)
+    with pytest.raises(Preempted):
+        glm_core.admm_streamed(
+            HostBlockSource((X, y, w), 4, fault_injector=inj), 4, d,
+            float(n), max_iter=4, checkpoint_path=path, lamduh=0.5,
+            abstol=0.0, reltol=0.0)
+    with pytest.raises(ValueError, match="different problem"):
+        glm_core.admm_streamed(
+            HostBlockSource((X, y, w), 4), 4, d, float(n), max_iter=4,
+            checkpoint_path=path, lamduh=0.9,  # changed hyperparameter
+            abstol=0.0, reltol=0.0)
+
+
+# ---------------------------------------------------------------------------
+# streamed moments / PCA: preempt + resume, bit-identical
+# ---------------------------------------------------------------------------
+
+
+def test_streamed_moments_preempt_resume_bit_identical(tmp_path):
+    from dask_ml_tpu.decomposition.streaming import streamed_moments
+
+    rng = np.random.RandomState(0)
+    X = (rng.randn(2000, 6) @ rng.randn(6, 6)).astype(np.float32) + 1.0
+    w = np.ones(2000, np.float32)
+    clean = streamed_moments(block_fn=HostBlockSource((X, w), 8), n_blocks=8)
+
+    path = str(tmp_path / "moments.ckpt")
+    inj = FaultInjector().preempt_at(block=4, epoch=0)
+    with pytest.raises(Preempted):
+        streamed_moments(
+            block_fn=HostBlockSource((X, w), 8, fault_injector=inj),
+            n_blocks=8, checkpoint_path=path, checkpoint_every=2)
+    assert os.path.exists(path)
+    resumed = streamed_moments(
+        block_fn=HostBlockSource((X, w), 8), n_blocks=8,
+        checkpoint_path=path)
+    for a, b in zip(clean, resumed):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not os.path.exists(path)
+
+    with pytest.raises(ValueError, match="HostBlockSource"):
+        streamed_moments(block_fn=lambda b: None, n_blocks=8,
+                         checkpoint_path=path)
+
+
+def test_pca_fit_blocks_preempt_resume_matches_clean(tmp_path):
+    from dask_ml_tpu.decomposition.streaming import pca_fit_blocks
+
+    rng = np.random.RandomState(1)
+    X = (rng.randn(1600, 5) @ rng.randn(5, 8)).astype(np.float32)
+    w = np.ones(1600, np.float32)
+    clean = pca_fit_blocks(HostBlockSource((X, w), 8), 8, 3)
+
+    path = str(tmp_path / "pca.ckpt")
+    inj = FaultInjector().preempt_at(block=5, epoch=0)
+    with pytest.raises(Preempted):
+        pca_fit_blocks(HostBlockSource((X, w), 8, fault_injector=inj), 8, 3,
+                       checkpoint_path=path)
+    est = pca_fit_blocks(HostBlockSource((X, w), 8), 8, 3,
+                         checkpoint_path=path)
+    np.testing.assert_array_equal(est.components_, clean.components_)
+    np.testing.assert_array_equal(est.mean_, clean.mean_)
+    np.testing.assert_array_equal(est.explained_variance_,
+                                  clean.explained_variance_)
+
+
+# ---------------------------------------------------------------------------
+# facade: fit_blocks(checkpoint=...)
+# ---------------------------------------------------------------------------
+
+
+def test_facade_fit_blocks_checkpoint_preempt_resume(tmp_path):
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    X, y, w = _problem(n=640, d=5, seed=3)
+    n, d = X.shape
+    path = str(tmp_path / "fit")
+
+    clean = LogisticRegression(solver="admm", C=1.0, max_iter=20)
+    clean.fit_blocks(HostBlockSource((X, y, w), 8), 8, n, d, classes=[0, 1])
+
+    inj = FaultInjector().preempt_at(block=3, epoch=7)
+    flaky = LogisticRegression(solver="admm", C=1.0, max_iter=20,
+                               checkpoint=path, checkpoint_every=4)
+    with pytest.raises(Preempted):
+        flaky.fit_blocks(HostBlockSource((X, y, w), 8, fault_injector=inj),
+                         8, n, d, classes=[0, 1])
+    resumed = LogisticRegression(solver="admm", C=1.0, max_iter=20,
+                                 checkpoint=path, checkpoint_every=4)
+    resumed.fit_blocks(HostBlockSource((X, y, w), 8), 8, n, d,
+                       classes=[0, 1])
+    np.testing.assert_array_equal(resumed.coef_, clean.coef_)
+    np.testing.assert_array_equal(resumed.intercept_, clean.intercept_)
+
+
+# ---------------------------------------------------------------------------
+# wrap + discard_inflight: early-convergence exits keep stats exact
+# ---------------------------------------------------------------------------
+
+
+def test_early_convergence_exit_does_not_leak_wrapped_lookahead():
+    """The wrap lookahead primes the next epoch; an early-convergence break
+    leaves those transfers unconsumed. discard_inflight() must roll them
+    back out so stats equal consumed blocks EXACTLY, and a reset source
+    re-times cleanly (the satellite interaction)."""
+    X, y, w = _problem(n=640, d=5, seed=1)
+    n, d = X.shape
+    src = HostBlockSource((X, y, w), 8)
+    # loose tolerances: converges well before max_iter, with wrap active
+    z, n_iter = glm_core.admm_streamed(
+        src, 8, d, float(n), family="logistic", regularizer="l2",
+        lamduh=1.0, max_iter=100, abstol=1e-2, reltol=1e-1)
+    assert 0 < int(n_iter) < 100  # really an early exit
+    assert src._inflight == {}
+    per_block = (X.nbytes + y.nbytes + w.nbytes) // 8
+    assert src.blocks_started == int(n_iter) * 8
+    assert src.bytes_streamed == int(n_iter) * 8 * per_block
+
+    # the next timed run over the same source starts from an exact zero
+    src.reset_stats()
+    glm_core.admm_streamed(src, 8, d, float(n), family="logistic",
+                           regularizer="l2", lamduh=1.0, max_iter=3,
+                           abstol=0.0, reltol=0.0)
+    assert src.blocks_started == 24
+    assert src.bytes_streamed == 24 * per_block
+
+
+def test_discard_inflight_rolls_back_unconsumed_stats():
+    X, y, w = _problem(n=64)
+    src = HostBlockSource((X, y, w), 4)
+    src.take(0)                      # consumed: stays counted
+    src.start(1)
+    src.start(2)                     # issued, never consumed
+    assert src.blocks_started == 3
+    src.discard_inflight()
+    per_block = (X.nbytes + y.nbytes + w.nbytes) // 4
+    assert src.blocks_started == 1
+    assert src.bytes_streamed == per_block
+    assert src._inflight == {}
+
+
+# ---------------------------------------------------------------------------
+# search pool: transient retry + soft timeout degrade to error_score
+# ---------------------------------------------------------------------------
+
+
+_FLAKY_CALLS: dict = {}
+
+
+class _FlakyEstimator:
+    """Fails its FIRST fit per (p,) config with a transient OSError —
+    deepcopy-safe because the attempt counter is module-global."""
+
+    def __init__(self, p=1, fail_first_for=()):
+        self.p = p
+        self.fail_first_for = fail_first_for
+
+    def get_params(self, deep=True):
+        return {"p": self.p, "fail_first_for": self.fail_first_for}
+
+    def set_params(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+        return self
+
+    def fit(self, X, y=None):
+        n = _FLAKY_CALLS.get(self.p, 0)
+        _FLAKY_CALLS[self.p] = n + 1
+        if self.p in self.fail_first_for and n == 0:
+            raise OSError("transient storage hiccup")
+        self.m_ = float(self.p)
+        return self
+
+    def score(self, X, y=None):
+        return self.m_
+
+
+class _SlowEstimator:
+    def __init__(self, p=1, slow=(), seconds=2.0):
+        self.p = p
+        self.slow = slow
+        self.seconds = seconds
+
+    def get_params(self, deep=True):
+        return {"p": self.p, "slow": self.slow, "seconds": self.seconds}
+
+    def set_params(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+        return self
+
+    def fit(self, X, y=None):
+        if self.p in self.slow:
+            time.sleep(self.seconds)
+        self.m_ = float(self.p)
+        return self
+
+    def score(self, X, y=None):
+        return self.m_
+
+
+def test_search_cell_retries_recover_transient_failures():
+    from dask_ml_tpu.model_selection import GridSearchCV
+
+    _FLAKY_CALLS.clear()
+    X = np.arange(80, dtype=np.float32).reshape(40, 2)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        gs = GridSearchCV(_FlakyEstimator(fail_first_for=(2,)),
+                          {"p": [1, 2, 3]}, cv=2, refit=False,
+                          error_score=0.0, cell_retries=2, n_jobs=1,
+                          return_train_score=False)
+        gs.fit(X)
+    # the transient failure was retried, NOT degraded to error_score
+    np.testing.assert_array_equal(gs.cv_results_["mean_test_score"],
+                                  [1.0, 2.0, 3.0])
+    assert gs.n_cell_retries_ == 1
+    assert gs.retry_stats_["by_kind"] == {"search-fit": 1}
+    assert "1 transient fit retry" in gs.shared_fit_report()
+
+
+def test_search_cell_retries_exhaust_to_error_score():
+    from dask_ml_tpu.model_selection import GridSearchCV
+
+    class AlwaysDown(_FlakyEstimator):
+        def fit(self, X, y=None):
+            if self.p == 2:
+                raise OSError("storage is gone")
+            self.m_ = float(self.p)
+            return self
+
+    X = np.arange(80, dtype=np.float32).reshape(40, 2)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        gs = GridSearchCV(AlwaysDown(), {"p": [1, 2]}, cv=2, refit=False,
+                          error_score=-7.0, cell_retries=1, n_jobs=1,
+                          return_train_score=False)
+        gs.fit(X)
+    # degraded to error_score instead of poisoning the run
+    np.testing.assert_array_equal(gs.cv_results_["mean_test_score"],
+                                  [1.0, -7.0])
+    assert gs.retry_stats_["giveups"] >= 1
+
+
+_BATCH_CALLS = [0]
+
+
+class _BatchedProto:
+    """Minimal _batched_fit_score protocol estimator whose FIRST group
+    program raises a transient error — exercises the batched-group retry
+    path (the pre-pass dispatch), not just the per-cell one."""
+
+    _batchable_params = ("p",)
+
+    def __init__(self, p=1.0):
+        self.p = p
+
+    def get_params(self, deep=True):
+        return {"p": self.p}
+
+    def set_params(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+        return self
+
+    def _supports_batched(self, merged):
+        return True
+
+    def _batched_fit_score(self, X, y, members, evals):
+        _BATCH_CALLS[0] += 1
+        if _BATCH_CALLS[0] == 1:
+            raise OSError("transient device hiccup")
+        scores = np.asarray([float(m["p"]) for m in members])
+        return {"scores": [scores for _ in evals]}
+
+    def fit(self, X, y=None):
+        self.m_ = float(self.p)
+        return self
+
+    def score(self, X, y=None):
+        return self.m_
+
+
+def test_search_batched_group_retry_recovers():
+    from dask_ml_tpu.model_selection import GridSearchCV
+
+    _BATCH_CALLS[0] = 0
+    X = np.arange(80, dtype=np.float32).reshape(40, 2)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        gs = GridSearchCV(_BatchedProto(), {"p": [1.0, 2.0, 3.0]}, cv=2,
+                          refit=False, error_score=-5.0, cell_retries=2,
+                          n_jobs=1, return_train_score=False)
+        gs.fit(X)
+    # all three candidates took the batched path and the transient group
+    # failure was retried, not degraded
+    assert gs.n_batched_cells_ == 6
+    np.testing.assert_array_equal(gs.cv_results_["mean_test_score"],
+                                  [1.0, 2.0, 3.0])
+    assert gs.n_cell_retries_ == 1
+    assert _BATCH_CALLS[0] == 3  # split 0 twice (1 fail + 1 ok), split 1 once
+
+
+def test_search_cell_timeout_degrades_to_error_score():
+    from dask_ml_tpu.model_selection import GridSearchCV
+
+    X = np.arange(80, dtype=np.float32).reshape(40, 2)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        gs = GridSearchCV(_SlowEstimator(slow=(3,), seconds=5.0),
+                          {"p": [1, 3]}, cv=2, refit=False,
+                          error_score=-1.0, cell_timeout=0.3, n_jobs=1,
+                          return_train_score=False)
+        t0 = time.perf_counter()
+        gs.fit(X)
+        elapsed = time.perf_counter() - t0
+    np.testing.assert_array_equal(gs.cv_results_["mean_test_score"],
+                                  [1.0, -1.0])
+    assert gs.n_cell_timeouts_ == 2  # both splits of the hung candidate
+    assert "2 timed-out cells" in gs.shared_fit_report()
+    assert elapsed < 5.0  # the run moved on; the zombie fit did not block it
+
+
+def test_search_cell_timeout_raise_semantics():
+    from dask_ml_tpu.model_selection import GridSearchCV
+
+    X = np.arange(80, dtype=np.float32).reshape(40, 2)
+    gs = GridSearchCV(_SlowEstimator(slow=(1,), seconds=5.0), {"p": [1]},
+                      cv=2, refit=False, error_score="raise",
+                      cell_timeout=0.2, n_jobs=1, return_train_score=False)
+    with pytest.raises(TimeoutError, match="cell_timeout"):
+        gs.fit(X)
+
+
+def test_search_timed_out_cells_are_not_journaled(tmp_path):
+    """A timed-out cell follows the failed-cell journal rule: never
+    restored from the checkpoint, so a resume (with a longer budget, or
+    after the hang's cause is gone) recomputes it."""
+    from dask_ml_tpu.model_selection import GridSearchCV
+
+    path = str(tmp_path / "cells.journal")
+    X = np.arange(80, dtype=np.float32).reshape(40, 2)
+    est = _SlowEstimator(slow=(3,), seconds=0.8)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        gs = GridSearchCV(est, {"p": [1, 3]}, cv=2, refit=False,
+                          error_score=-1.0, cell_timeout=0.2, n_jobs=1,
+                          return_train_score=False, checkpoint=path)
+        gs.fit(X)
+    assert gs.n_cell_timeouts_ == 2
+    # resume without the timeout (same estimator config, so the journal
+    # keys match): the previously hung cells recompute, completed ones load
+    gs2 = GridSearchCV(est, {"p": [1, 3]}, cv=2, refit=False,
+                       error_score=-1.0, n_jobs=1,
+                       return_train_score=False, checkpoint=path)
+    gs2.fit(X)
+    assert gs2.n_resumed_cells_ == 2  # only candidate p=1's cells restored
+    np.testing.assert_array_equal(gs2.cv_results_["mean_test_score"],
+                                  [1.0, 3.0])
