@@ -55,16 +55,14 @@ def _enable_compilation_cache():
     """Persistent XLA compilation cache (verified working on this backend):
     a re-run of the bench — or the driver's run after a warm-up — loads
     compiled programs from disk instead of paying 30-60 s compiles per
-    distinct shape. Cache misses behave exactly as before."""
-    import jax
+    distinct shape. Cache misses behave exactly as before. Routed through
+    the ``compilation_cache`` config knob (docs/compile.md), which also
+    drops the min-compile-time threshold to 0 — this backend pays ~0.7s
+    fixed overhead per tiny program, and a search touches dozens."""
+    from dask_ml_tpu.config import set_config
 
-    path = os.path.expanduser("~/.cache/dask_ml_tpu_xla")
-    os.makedirs(path, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", path)
-    # cache EVERYTHING: this backend pays ~0.7s fixed overhead per tiny
-    # program, and a search touches dozens — a second process loading them
-    # from cache is what makes its cold start near-warm
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    set_config(
+        compilation_cache=os.path.expanduser("~/.cache/dask_ml_tpu_xla"))
 
 _RESULTS = []
 
@@ -1019,6 +1017,208 @@ def bench_fused(rtt):
 # ---------------------------------------------------------------------------
 
 
+def _compile_workload():
+    """The workload the compile-report drill measures — CI-sized instances
+    of the two shapes the ISSUE gates: a 6-candidate x 3-fold KMeans grid
+    search whose fold train sizes differ (266 vs 267 rows: the case that
+    used to compile the batched program once per fold), and a ragged-tail
+    host-streamed ADMM fit (the case that used to be rejected outright).
+    Returns observability numbers for the caller to emit."""
+    import numpy as np
+
+    from dask_ml_tpu.cluster import KMeans
+    from dask_ml_tpu.model_selection import GridSearchCV
+    from dask_ml_tpu.models import glm as glm_core
+    from dask_ml_tpu.models import kmeans as km_core
+    from dask_ml_tpu.parallel.stream import HostBlockSource
+
+    rng = np.random.RandomState(0)
+    X = (rng.randn(400, 12) @ np.diag(np.linspace(2, 0.5, 12))).astype(
+        np.float32)
+    impl_before = km_core._batched_cells_impl._cache_size()
+    t0 = time.perf_counter()
+    gs = GridSearchCV(
+        KMeans(init="random", max_iter=8, random_state=0),
+        {"n_clusters": [2, 3], "tol": [1e-4, 1e-2, 1e-1]},
+        cv=3, refit=False, n_jobs=1).fit(X)
+    t_search = time.perf_counter() - t0
+
+    n, d, n_blocks = 1003, 6, 8  # ragged: 7 blocks of 126 + a 121-row tail
+    Xs = rng.standard_normal((n, d)).astype(np.float32)
+    ys = (Xs @ np.random.RandomState(3).randn(d) > 0).astype(np.float32)
+    ws = np.ones(n, np.float32)
+    t0 = time.perf_counter()
+    z, _ = glm_core.admm_streamed(
+        HostBlockSource((Xs, ys, ws), n_blocks), n_blocks, d, float(n),
+        family="logistic", regularizer="l2", lamduh=1.0, max_iter=4,
+        abstol=0.0, reltol=0.0)
+    fetch(z)
+    return {
+        "search_seconds": round(t_search, 3),
+        "stream_seconds": round(time.perf_counter() - t0, 3),
+        "n_batched_cells": gs.n_batched_cells_,
+        "search_shape_buckets": gs.shape_buckets_,
+        "batched_program_compiles": (
+            km_core._batched_cells_impl._cache_size() - impl_before),
+    }
+
+
+def _compile_child():
+    """Fresh-process probe for the cold/warm persistent-cache numbers: the
+    same workload with ``compilation_cache`` pointed at argv's dir ('-' =
+    no persistent cache), compile stats printed as the LAST line."""
+    import sys
+
+    from dask_ml_tpu import config
+    from dask_ml_tpu.parallel import shapes
+
+    cache_dir = sys.argv[sys.argv.index("--compile-child") + 1]
+    if cache_dir != "-":
+        config.set_config(compilation_cache=cache_dir)
+    shapes.reset_compile_stats()
+    t0 = time.perf_counter()
+    out = _compile_workload()
+    stats = shapes.compile_stats()
+    print(json.dumps({
+        "wall_seconds": round(time.perf_counter() - t0, 3),
+        "n_compiles": stats["n_compiles"],
+        "compile_seconds": round(stats["compile_seconds"], 3),
+        "n_traces": stats["n_traces"],
+        "shape_buckets": {str(k): v
+                          for k, v in stats["shape_buckets"].items()},
+        **out,
+    }), flush=True)
+
+
+def _padding_pinned_results() -> dict:
+    """Padded-vs-exact pins for the drill: bucket padding must not change
+    any result. KMeans on integer-valued data pins labels bitwise against
+    a pad_policy=None run; the ragged streamed fit pins (z, x, u) bitwise
+    against a manually pre-padded source. Returns flags the caller turns
+    into a nonzero exit on divergence."""
+    import numpy as np
+
+    from dask_ml_tpu import config
+    from dask_ml_tpu.cluster import KMeans
+    from dask_ml_tpu.models import glm as glm_core
+    from dask_ml_tpu.parallel.stream import HostBlockSource
+
+    X = np.random.RandomState(0).randint(0, 8, size=(266, 6)).astype(
+        np.float32)
+    a = KMeans(init="random", n_clusters=3, max_iter=20,
+               random_state=0).fit(X)
+    with config.config_context(pad_policy=None):
+        b = KMeans(init="random", n_clusters=3, max_iter=20,
+                   random_state=0).fit(X)
+    kmeans_ok = bool(
+        np.array_equal(a.labels_, b.labels_)
+        and np.allclose(a.inertia_, b.inertia_, rtol=1e-6)
+        and a.n_iter_ == b.n_iter_)
+
+    n, d, n_blocks = 1003, 6, 8
+    rng = np.random.RandomState(1)
+    Xs = rng.standard_normal((n, d)).astype(np.float32)
+    ys = (Xs @ np.random.RandomState(3).randn(d) > 0).astype(np.float32)
+    ws = np.ones(n, np.float32)
+    rows = -(-n // n_blocks)
+    pad = rows * n_blocks - n
+    Xp = np.concatenate([Xs, np.zeros((pad, d), np.float32)])
+    yp = np.concatenate([ys, np.zeros(pad, np.float32)])
+    wp = np.concatenate([ws, np.zeros(pad, np.float32)])
+    kw = dict(family="logistic", regularizer="l2", lamduh=1.0, max_iter=4,
+              abstol=0.0, reltol=0.0, return_state=True)
+    _, _, (zm, xm, um), _ = glm_core.admm_streamed(
+        HostBlockSource((Xp, yp, wp), n_blocks), n_blocks, d, float(n),
+        **kw)
+    _, _, (zr, xr, ur), _ = glm_core.admm_streamed(
+        HostBlockSource((Xs, ys, ws), n_blocks), n_blocks, d, float(n),
+        **kw)
+    stream_ok = all(
+        np.array_equal(np.asarray(m), np.asarray(r))
+        for m, r in ((zm, zr), (xm, xr), (um, ur)))
+    return {"kmeans_padded_pinned": kmeans_ok,
+            "stream_ragged_pinned": stream_ok}
+
+
+def bench_compile_report(_rtt):
+    """Compile-count observability drill (CI `compile` job; ISSUE 4):
+
+    1. in-process COLD compile census of the gated workload — total
+       ``n_compiles``/``compile_seconds`` (jax.monitoring), the shape
+       buckets the fold slices shared, and the batched-program compile
+       count, which must be bounded by the batch plan's bucket count
+       (ONE here: all 3 folds share a train bucket), not candidates x
+       folds;
+    2. padded-vs-exact pins — exits nonzero if bucket padding changes any
+       pinned result (KMeans labels/inertia/n_iter, streamed (z, x, u));
+    3. cold-vs-warm persistent-cache drill in fresh subprocesses: the same
+       workload against an empty cache dir, then again against the now-
+       populated dir — ``compile_seconds`` with and without the
+       ``compilation_cache`` knob.
+    """
+    import subprocess
+    import sys
+
+    from dask_ml_tpu.parallel import shapes
+
+    shapes.reset_compile_stats()
+    census = _compile_workload()
+    stats = shapes.compile_stats()
+    pins = _padding_pinned_results()
+
+    cache_dir = tempfile.mkdtemp(prefix="dask_ml_tpu_compile_cache_")
+    here = os.path.abspath(__file__)
+
+    def child(arg):
+        out = subprocess.run(
+            [sys.executable, here, "--compile-child", arg],
+            capture_output=True, text=True, timeout=1200)
+        if out.returncode != 0:
+            raise SystemExit(
+                f"compile-report child failed:\n{out.stdout}\n{out.stderr}")
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    cold = child(cache_dir)   # empty cache: every compile is real + stored
+    warm = child(cache_dir)   # second process: loads executables from disk
+
+    n_buckets = len(census["search_shape_buckets"])
+    bounded = census["batched_program_compiles"] <= n_buckets
+    emit({
+        "metric": "compile_report",
+        "value": stats["n_compiles"],
+        "unit": "XLA compiles for the gated workload (cold, this process)",
+        "vs_baseline": None,
+        "n_compiles": stats["n_compiles"],
+        "compile_seconds": round(stats["compile_seconds"], 3),
+        "n_traces": stats["n_traces"],
+        "shape_buckets": {str(k): v
+                          for k, v in stats["shape_buckets"].items()},
+        "search_shape_buckets": census["search_shape_buckets"],
+        "batched_program_compiles": census["batched_program_compiles"],
+        "batched_compiles_bounded_by_buckets": bounded,
+        "n_batched_cells": census["n_batched_cells"],
+        **pins,
+        "cold": {k: cold[k] for k in ("wall_seconds", "n_compiles",
+                                      "compile_seconds")},
+        "warm": {k: warm[k] for k in ("wall_seconds", "n_compiles",
+                                      "compile_seconds")},
+        "warm_compile_speedup": round(
+            cold["compile_seconds"] / max(warm["compile_seconds"], 1e-9),
+            2),
+        "note": "cold/warm are fresh subprocesses sharing one persistent "
+                "compilation cache dir (the compilation_cache config "
+                "knob); warm's residual compile_seconds is cache "
+                "deserialization",
+    })
+    if not (pins["kmeans_padded_pinned"] and pins["stream_ragged_pinned"]):
+        raise SystemExit("compile report: padding changed a pinned result")
+    if not bounded:
+        raise SystemExit(
+            "compile report: batched-program compiles "
+            f"({census['batched_program_compiles']}) exceeded the bucket "
+            f"count ({n_buckets}) — the compile-once invariant regressed")
+
+
 def bench_faults(rtt):
     """Deterministic fault-injection drill over a small host-streamed ADMM
     config (CI-sized; the recovery MECHANISMS are scale-independent):
@@ -1404,6 +1604,16 @@ if __name__ == "__main__":
         # print the clean-vs-injected recovery-overhead deltas
         _enable_compilation_cache()
         bench_faults(measure_rtt())
+        emit_summary()
+    elif "--compile-child" in sys.argv:
+        _compile_child()
+    elif "--compile-report" in sys.argv:
+        # compile-count observability drill (ISSUE 4); CI's compile job
+        # runs this: compile census + padded-vs-exact pins (nonzero exit on
+        # divergence) + the cold-vs-warm persistent-cache numbers. The
+        # process-global persistent cache stays OFF here so the census
+        # counts real compiles; the child runs own the cache knob.
+        bench_compile_report(measure_rtt())
         emit_summary()
     elif "--grid-child" in sys.argv:
         _grid_child()

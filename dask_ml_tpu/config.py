@@ -26,6 +26,19 @@ Knobs (all also overridable per-call at the API they configure):
   host link every needless fetch is ~RTT + bytes/bandwidth, and a CV sweep
   does thousands of them. ``np.asarray`` on a returned device array still
   works everywhere. Thread-local under :func:`config_context`.
+- ``pad_policy`` — sample-axis shape bucketing for the staging layer
+  (:mod:`dask_ml_tpu.parallel.shapes`): ``"auto"`` (default) buckets every
+  staged sample count into a small set of padded sizes so nearby ``n``
+  share one compiled program per algorithm (rows past the true count carry
+  weight 0 and are inert); ``None`` disables bucketing (exact mesh-multiple
+  padding); a :class:`~dask_ml_tpu.parallel.shapes.PadPolicy` customizes
+  the waste cap / smallest bucket. Thread-local under
+  :func:`config_context`.
+- ``compilation_cache`` — directory for XLA's PERSISTENT compilation cache
+  (``set_config(compilation_cache="~/.cache/...")``): repeat invocations
+  load compiled programs from disk and start warm. Process-wide only
+  (it configures jax globally), so :func:`config_context` rejects it —
+  see ``docs/compile.md`` for the cold-vs-warm numbers.
 
 (Feature-axis sharding is NOT a config knob: staging layout changes the
 shape of fitted state, so only estimators written for it — the GLMs —
@@ -42,6 +55,8 @@ _DEFAULTS: dict[str, Any] = {
     "dtype": None,
     "mesh": None,
     "device_outputs": False,
+    "pad_policy": "auto",
+    "compilation_cache": None,
 }
 
 
@@ -107,15 +122,34 @@ def get_option(name: str):
 
 
 def set_config(**options) -> None:
-    """Set process-wide defaults (``set_config(dtype=jnp.bfloat16)``)."""
+    """Set process-wide defaults (``set_config(dtype=jnp.bfloat16)``).
+
+    ``compilation_cache=dir`` additionally points XLA's persistent
+    compilation cache at ``dir`` (``None`` turns it back off) — the knob is
+    applied immediately, not just recorded."""
     _validate_options(options)
+    if "compilation_cache" in options:
+        # apply BEFORE recording: if the dir is unwritable the exception
+        # propagates with the config still reporting the previous state,
+        # never claiming a cache jax does not have
+        from dask_ml_tpu.parallel.shapes import enable_persistent_cache
+
+        enable_persistent_cache(options["compilation_cache"])
     _global_config.update(options)
 
 
 def reset_config() -> None:
-    """Restore the built-in defaults (mainly for tests)."""
+    """Restore the built-in defaults (mainly for tests). Like
+    :func:`set_config`, the ``compilation_cache`` knob is APPLIED, not just
+    recorded: a configured persistent cache is switched back off, so the
+    config dict never claims None while jax still writes to a cache dir."""
+    had_cache = _global_config.get("compilation_cache") is not None
     _global_config.clear()
     _global_config.update(_DEFAULTS)
+    if had_cache:
+        from dask_ml_tpu.parallel.shapes import enable_persistent_cache
+
+        enable_persistent_cache(None)
 
 
 @contextlib.contextmanager
@@ -134,6 +168,12 @@ def config_context(**options):
     ``set_config(mesh=None)`` instead.
     """
     _validate_options(options)
+    if "compilation_cache" in options:
+        raise ValueError(
+            "compilation_cache is process-wide (it configures jax "
+            "globally); use set_config(compilation_cache=...) instead of "
+            "config_context"
+        )
     if "mesh" in options and options["mesh"] is None:
         raise ValueError(
             "config_context(mesh=None) cannot clear an enclosing mesh "

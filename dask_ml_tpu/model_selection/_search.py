@@ -109,10 +109,20 @@ class CVCache:
     device-side gathers — over a slow host link, uploading every CV slice
     separately costs ~2× the bytes of X per split pair, all on the wire.
     y and pairwise-kernel slicing stay host-side (small / special-cased).
+
+    ``pad_policy`` (a :class:`~dask_ml_tpu.parallel.shapes.PadPolicy`, or
+    None) is the shape-bucketing policy the slices will be staged under by
+    their consumers: extract() itself returns EXACT slices (the padding —
+    weight-0 rows up to the bucket — happens inside each estimator's
+    ``prepare_data``, which is also what keeps the padded rows inert), but
+    the cache knows the plan, and :meth:`planned_buckets` reports which
+    padded sizes the search's fold slices share — the bound the compile-
+    count CI gate asserts against and ``bench.py --compile-report``
+    records as ``shape_buckets``.
     """
 
     def __init__(self, splits, X, y, cache: bool = True,
-                 device_slices: bool = False):
+                 device_slices: bool = False, pad_policy=None):
         self.splits = list(splits)
         self.X = X
         self.y = y
@@ -120,6 +130,7 @@ class CVCache:
         self._x_dev = None
         self._dev_lock = threading.Lock()
         self.device_slices = bool(device_slices) and self._device_sliceable(X)
+        self.pad_policy = pad_policy
 
     @staticmethod
     def _device_sliceable(X) -> bool:
@@ -159,6 +170,26 @@ class CVCache:
 
     def n_test(self, split_idx: int) -> int:
         return len(self.splits[split_idx][1])
+
+    def planned_buckets(self) -> list:
+        """Sorted padded sample counts the fold slices land in when staged
+        under ``pad_policy`` on the current mesh. K folds whose train sizes
+        differ by a row share a bucket, so a P-candidate × K-fold search
+        compiles O(len(planned_buckets())) data-shaped programs, not O(K)
+        per batched group — the invariant the CI ``compile`` job gates."""
+        from dask_ml_tpu.parallel import mesh as mesh_lib
+        from dask_ml_tpu.parallel import shapes
+
+        align = mesh_lib.n_data_shards(mesh_lib.default_mesh())
+        sizes = set()
+        for train_idx, test_idx in self.splits:
+            for idx in (train_idx, test_idx):
+                # record=False: this is a PLAN query — only actual staging
+                # may write compile_stats()['shape_buckets']
+                sizes.add(shapes.bucket_rows(len(idx), align=align,
+                                             policy=self.pad_policy,
+                                             record=False))
+        return sorted(sizes)
 
     def extract(self, split_idx: int, train: bool, is_x: bool = True,
                 pairwise: bool = False):
@@ -1131,8 +1162,11 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
         splits = list(cv.split(X, y, groups))
         n_splits = len(splits)
         device_native = _all_stages_device_native(estimator)
+        from dask_ml_tpu.parallel import shapes as shapes_lib
+
         cv_cache = CVCache(splits, X, y, cache=self.cache_cv,
-                           device_slices=device_native)
+                           device_slices=device_native,
+                           pad_policy=shapes_lib.active_policy())
 
         candidate_params = list(self._get_param_iterator())
         n_candidates = len(candidate_params)
@@ -1246,8 +1280,12 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
         # (and re-pushing it per worker would race on the mesh stack).
         from dask_ml_tpu import config as config_lib
 
+        # mesh is excluded because mesh scoping is process-visible already;
+        # compilation_cache because it is a process-wide jax setting that
+        # config_context rejects by design
         caller_cfg = {
-            k: v for k, v in config_lib.get_config().items() if k != "mesh"
+            k: v for k, v in config_lib.get_config().items()
+            if k not in ("mesh", "compilation_cache")
         }
         if device_native:
             # all-jax-native candidate pipelines: stage outputs flow
@@ -1439,6 +1477,10 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
         self.multimetric_ = multimetric
         self.scorer_ = scorers if multimetric else scorers["score"]
         self.n_shared_fits_ = memo.n_entries  # CSE observability
+        # shape-bucket observability: the padded sample counts this
+        # search's fold slices shared (compile counts scale with THIS, not
+        # with candidates × folds — see CVCache.planned_buckets)
+        self.shape_buckets_ = cv_cache.planned_buckets()
         # cells that ACTUALLY read a batched group's result this fit —
         # runtime declines (NotImplemented) and journal-resumed cells are
         # excluded, so the attribute is evidence of which path ran

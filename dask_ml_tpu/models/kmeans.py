@@ -438,9 +438,9 @@ def scaled_tolerance(X, w, tol):
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("max_k", "max_iter", "n_valid"))
-def _batched_cells_impl(X, w, uk_arr, member_uk, tol_arr, d_true, key,
-                        eval_Xs, eval_ws, *, max_k, max_iter, n_valid):
+@partial(jax.jit, static_argnames=("max_k", "max_iter"))
+def _batched_cells_impl(X, w, uk_arr, member_uk, tol_arr, d_true, idx0,
+                        eval_Xs, eval_ws, *, max_k, max_iter):
     """All (n_clusters, tol) KMeans candidates over ONE dataset as ONE XLA
     program: trajectories per unique k, per-tol stopping selection, bulk
     scoring — the driver's batched-candidate fast path (SURVEY §2.9
@@ -472,12 +472,15 @@ def _batched_cells_impl(X, w, uk_arr, member_uk, tol_arr, d_true, key,
     U = uk_arr.shape[0]
     kiota = jnp.arange(max_k, dtype=jnp.int32)
 
-    # shared random init mirroring the single-fit path's _random_rows draw
-    # (same permutation of the same key): member k uses the first k sampled
-    # rows, so its trajectory matches a standalone fit(random_state=...) up
-    # to a row permutation of the center buffer — which leaves assignments,
-    # shifts, n_iter, and inertia unchanged
-    idx0 = jax.random.permutation(key, n_valid)[:max_k]
+    # shared random init: ``idx0`` is the first max_k entries of the
+    # single-fit path's _random_rows permutation, drawn EAGERLY by the host
+    # entry so the true sample count never enters this program's static
+    # signature — under shape bucketing a K-fold search's folds share one
+    # padded X shape, and a static n_valid would have recompiled this (the
+    # sweep's most expensive program) once per fold anyway. Member k uses
+    # the first k sampled rows, so its trajectory matches a standalone
+    # fit(random_state=...) up to a row permutation of the center buffer —
+    # which leaves assignments, shifts, n_iter, and inertia unchanged.
     centers0 = jnp.take(X, idx0, axis=0).astype(jnp.float32)  # (max_k, d)
 
     x2 = jnp.sum(X.astype(jnp.float32) ** 2, axis=1)  # (n_pad,) invariant
@@ -622,12 +625,17 @@ def batched_lloyd_cells(data, members, eval_sets, *, max_iter, key):
     member_uk = jnp.asarray([uk_index[k] for k in ks], jnp.int32)
     d = int(data.X.shape[1])
     d_pad = -(-d // _BATCH_D_BUCKET) * _BATCH_D_BUCKET
+    # the init draw runs eagerly (same bits as _random_rows: the first
+    # max_k entries of permutation(key, n)) so the program's signature
+    # depends only on SHAPES — one compile serves every fold/sample count
+    # that lands in the same padding bucket
+    idx0 = jax.random.permutation(key, data.n)[:max_k]
     n_iters, train_inertia, evals = _batched_cells_impl(
         _pad_features(data.X, d_pad), data.weights, uk_arr, member_uk,
-        tol_arr, jnp.asarray(float(d), jnp.float32), key,
+        tol_arr, jnp.asarray(float(d), jnp.float32), idx0,
         tuple(_pad_features(e.X, d_pad) for e in eval_sets),
         tuple(e.weights for e in eval_sets),
-        max_k=max_k, max_iter=int(max_iter), n_valid=data.n)
+        max_k=max_k, max_iter=int(max_iter))
     return n_iters, train_inertia, list(evals)
 
 

@@ -37,6 +37,13 @@ from dask_ml_tpu.parallel.faults import (  # noqa: F401
     RetryPolicy,
     ScanCheckpoint,
 )
+from dask_ml_tpu.parallel.shapes import (  # noqa: F401
+    PadPolicy,
+    compile_stats,
+    pad_tail,
+    reset_compile_stats,
+    track_compiles,
+)
 from dask_ml_tpu.parallel.stream import (  # noqa: F401
     HostBlockSource,
     prefetched_scan,

@@ -1,0 +1,335 @@
+"""Shape canonicalization: bucketed sample-axis padding + compile observability.
+
+Every pillar-1 algorithm here is a jitted program over sample-axis blocks, so
+each DISTINCT sample count traces and compiles its own XLA executable: a
+K-fold search compiles per fold shape (K-1 vs K-fold train sizes differ by a
+row whenever n % K != 0), every dataset size is a cold compile, and — before
+this layer — the streamed tier refused ragged tail blocks entirely. That
+fixed per-program overhead scales with the number of distinct shapes rather
+than with the data, the same redundant-work class the communication-avoiding
+formulations eliminate per iteration (PAPERS.md: arxiv 2601.17136).
+
+The answer is the standard batch-bucketing take from inference serving,
+adapted to the weight-masked layout this package already carries everywhere:
+
+- :class:`PadPolicy` maps any sample count ``n`` to a small set of padded
+  bucket sizes — "powers-of-two-ish" growth bounded by a configurable
+  ``waste_cap``. The quantum is the largest power of two ``q`` with
+  ``q <= waste_cap * n``; the bucket is ``n`` rounded up to a multiple of
+  ``q`` (then to the mesh alignment), so relative padding waste stays under
+  ``waste_cap`` while the number of distinct buckets per octave is
+  ``~1/waste_cap``. Counts below ``min_rows`` all land in the single
+  smallest bucket: their absolute waste is bounded by ``min_rows`` rows and
+  every tiny fit shares ONE compiled program.
+- Rows past ``n_valid`` carry **weight 0** (``sharding.row_weights``), which
+  the algorithm cores are already written for: KMeans assignment/M-step and
+  inertia (``fused_argmin_weight`` takes validity masks), PCA centering and
+  streamed moments (weight-0 rows contribute nothing to mean or Gram), the
+  GLM/ADMM sample-weighted objectives. Padded and exact runs therefore
+  produce the same results (bit-identical against a manually-padded run of
+  the same shape; within reduction-order float tolerance against an
+  unpadded run of a different shape).
+
+The policy is threaded through the consumers via the config knob
+``pad_policy`` (:mod:`dask_ml_tpu.config`): ``shard_rows``/``shard_2d``/
+``prepare_data`` bucket the sample axis at staging (so every estimator fit,
+CV-fold slice from ``CVCache.extract``, and batched candidate group lands in
+a shared bucket), and :class:`~dask_ml_tpu.parallel.stream.HostBlockSource`
+zero-pads ragged tail blocks instead of raising (one per-block program per
+epoch).
+
+Compile observability makes the win provable: :func:`compile_stats` counts
+trace/compile events through ``jax.monitoring`` (``n_compiles``,
+``compile_seconds``, ``n_traces``, ``trace_seconds``) and records which
+buckets staging actually chose (``shape_buckets``). ``bench.py
+--compile-report`` writes those keys next to the phase metrics, and the CI
+``compile`` job gates a K-fold grid search's compile count on the batch
+plan's bucket count instead of candidates x folds. A persistent-compilation-
+cache knob (``set_config(compilation_cache=dir)``) makes repeat invocations
+start warm; see ``docs/compile.md``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import math
+import threading
+from typing import Optional, Sequence, Union
+
+import numpy as np
+
+__all__ = [
+    "PadPolicy",
+    "DEFAULT_POLICY",
+    "active_policy",
+    "bucket_rows",
+    "pad_tail",
+    "compile_stats",
+    "reset_compile_stats",
+    "track_compiles",
+    "enable_persistent_cache",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class PadPolicy:
+    """Maps sample counts to a small set of padded bucket sizes.
+
+    ``waste_cap`` bounds the RELATIVE padding waste: the bucket quantum is
+    the largest power of two ``q <= waste_cap * n``, so
+    ``(bucket(n) - n) / n < waste_cap`` (plus at most one mesh-alignment
+    round-up) and consecutive buckets grow by a factor ``<= 1 + waste_cap``
+    — powers-of-two-ish growth with ``~1/waste_cap`` buckets per octave,
+    ``O(log(n_max) / waste_cap)`` buckets total.
+
+    ``min_rows`` is the smallest bucket: every ``n <= min_rows`` pads to it,
+    trading at most ``min_rows`` rows of (absolute) waste for ONE shared
+    compiled program across all tiny inputs — the relative cap deliberately
+    does not apply below it.
+    """
+
+    waste_cap: float = 0.125
+    min_rows: int = 64
+
+    def __post_init__(self):
+        if not 0.0 < self.waste_cap <= 1.0:
+            raise ValueError(
+                f"waste_cap must be in (0, 1], got {self.waste_cap}")
+        if self.min_rows < 1:
+            raise ValueError(f"min_rows must be >= 1, got {self.min_rows}")
+
+    def bucket(self, n: int, align: int = 1) -> int:
+        """The padded sample count for ``n`` true rows: the smallest bucket
+        ``>= max(n, min_rows)``, rounded up to a multiple of ``align`` (the
+        mesh's data-shard count — every bucket must split evenly)."""
+        n = int(n)
+        if n < 0:
+            raise ValueError(f"n must be >= 0, got {n}")
+        target = max(n, self.min_rows, 1)
+        q = 1 << max(int(math.floor(
+            math.log2(max(target * self.waste_cap, 1.0)))), 0)
+        b = -(-target // q) * q
+        align = max(int(align), 1)
+        return -(-b // align) * align
+
+    def signature(self) -> tuple:
+        """Hashable identity for staging-memo keys."""
+        return ("PadPolicy", self.waste_cap, self.min_rows)
+
+
+DEFAULT_POLICY = PadPolicy()
+
+
+def active_policy() -> Optional[PadPolicy]:
+    """The policy the staging layer should apply, resolved from the config
+    knob ``pad_policy``: ``"auto"`` (default) → :data:`DEFAULT_POLICY`,
+    ``None`` → bucketing disabled (exact mesh-multiple padding, the
+    pre-bucketing behavior), a :class:`PadPolicy` → itself."""
+    from dask_ml_tpu.config import get_config
+
+    knob = get_config()["pad_policy"]
+    if knob is None:
+        return None
+    if knob == "auto":
+        return DEFAULT_POLICY
+    if isinstance(knob, PadPolicy):
+        return knob
+    raise ValueError(
+        f"pad_policy must be 'auto', None, or a PadPolicy; got {knob!r}")
+
+
+def bucket_rows(n: int, align: int = 1,
+                policy: Union[PadPolicy, None, str] = "active",
+                record: bool = True) -> int:
+    """Padded row count for ``n`` under ``policy`` (default: the active
+    config policy). With no policy this is plain align-rounding — exactly
+    the mesh-multiple padding the staging layer always did.
+
+    ``record=True`` notes the (bucket, n) pair into
+    ``compile_stats()['shape_buckets']`` — the STAGING paths keep that
+    default; pure size queries (bucket planning, reporting) must pass
+    ``record=False`` so the stats only reflect data actually staged."""
+    if policy == "active":
+        policy = active_policy()
+    if policy is None:
+        align = max(int(align), 1)
+        return -(-int(n) // align) * align
+    padded = policy.bucket(n, align=align)
+    if record:
+        note_bucket(int(n), padded)
+    return padded
+
+
+def pad_tail(arrays: Sequence[np.ndarray], rows: int) -> tuple:
+    """Zero-pad every array of a block tuple along axis 0 up to ``rows``.
+
+    The contract that makes zero the right fill: the consuming solvers all
+    carry an explicit per-row weight array in the block tuple ((X, w) for
+    the moment accumulators, (X, y, w) for the GLMs), and a zero-padded
+    weight row is weight 0 — the padding is inert in every weighted
+    reduction. A consumer without a weight array must not use this.
+    """
+    out = []
+    for a in arrays:
+        a = np.asarray(a)
+        if a.shape[0] > rows:
+            raise ValueError(
+                f"block has {a.shape[0]} rows, more than the target {rows}")
+        if a.shape[0] < rows:
+            pad = np.zeros((rows - a.shape[0],) + a.shape[1:], a.dtype)
+            a = np.concatenate([a, pad], axis=0)
+        out.append(a)
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# compile observability (jax.monitoring listeners)
+# ---------------------------------------------------------------------------
+
+# One actual XLA compile emits exactly one backend_compile duration event;
+# every trace (including cache hits re-tracing under new avals) emits a
+# jaxpr_trace event. Event names verified against the pinned jax (0.4.x).
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+
+_stats_lock = threading.Lock()
+_stats = {
+    "n_compiles": 0,
+    "compile_seconds": 0.0,
+    "n_traces": 0,
+    "trace_seconds": 0.0,
+}
+# padded bucket size -> set of distinct true row counts staged into it
+_buckets: dict = {}
+_listeners_installed = False
+
+
+def _on_duration(event: str, duration: float, **_kw) -> None:
+    if event == _COMPILE_EVENT:
+        with _stats_lock:
+            _stats["n_compiles"] += 1
+            _stats["compile_seconds"] += float(duration)
+    elif event == _TRACE_EVENT:
+        with _stats_lock:
+            _stats["n_traces"] += 1
+            _stats["trace_seconds"] += float(duration)
+
+
+def _install_listeners() -> None:
+    """Idempotent registration of the jax.monitoring duration listener.
+    Installed lazily on first stats use (registration is global and
+    permanent in jax; the callback is a couple of guarded counter
+    increments, negligible next to any compile)."""
+    global _listeners_installed
+    with _stats_lock:
+        if _listeners_installed:
+            return
+        _listeners_installed = True
+    import jax.monitoring
+
+    jax.monitoring.register_event_duration_secs_listener(_on_duration)
+
+
+def note_bucket(n_valid: int, padded: int) -> None:
+    """Record that ``n_valid`` true rows were staged into the ``padded``
+    bucket — the data behind ``compile_stats()['shape_buckets']``."""
+    with _stats_lock:
+        _buckets.setdefault(int(padded), set()).add(int(n_valid))
+
+
+def compile_stats() -> dict:
+    """Snapshot of the process-wide compile counters since the last
+    :func:`reset_compile_stats`:
+
+    - ``n_compiles`` / ``compile_seconds`` — actual XLA backend compiles
+      (cache hits do not count);
+    - ``n_traces`` / ``trace_seconds`` — jaxpr traces (a re-trace that hits
+      the executable cache still counts here);
+    - ``shape_buckets`` — ``{padded_size: sorted true row counts}`` staged
+      by the bucketing layer, i.e. which distinct sample counts shared a
+      program shape.
+
+    Counters only start accumulating once the listener is installed, which
+    happens on the first call to any function in this section — call
+    :func:`reset_compile_stats` (or this) BEFORE the workload you want to
+    measure.
+    """
+    _install_listeners()
+    with _stats_lock:
+        out = dict(_stats)
+        out["shape_buckets"] = {k: sorted(v) for k, v in _buckets.items()}
+    return out
+
+
+def reset_compile_stats() -> dict:
+    """Zero the counters (and install the listener if needed); returns the
+    pre-reset snapshot."""
+    _install_listeners()
+    with _stats_lock:
+        out = dict(_stats)
+        out["shape_buckets"] = {k: sorted(v) for k, v in _buckets.items()}
+        _stats.update(n_compiles=0, compile_seconds=0.0,
+                      n_traces=0, trace_seconds=0.0)
+        _buckets.clear()
+    return out
+
+
+@contextlib.contextmanager
+def track_compiles():
+    """Scoped delta capture: ``with track_compiles() as t: ...`` leaves
+    ``t['n_compiles']`` etc. holding the counts accumulated INSIDE the
+    scope (process-wide — concurrent compiles from other threads land in
+    the same delta; use from the driving thread of the workload under
+    measurement). The global counters are not reset."""
+    _install_listeners()
+    with _stats_lock:
+        before = dict(_stats)
+    delta: dict = {}
+    try:
+        yield delta
+    finally:
+        with _stats_lock:
+            for k, v in _stats.items():
+                delta[k] = v - before[k]
+
+
+# ---------------------------------------------------------------------------
+# persistent compilation cache
+# ---------------------------------------------------------------------------
+
+
+def enable_persistent_cache(path: Optional[str]) -> None:
+    """Point XLA's persistent compilation cache at ``path`` (process-wide),
+    so a second process re-running the same shapes loads executables from
+    disk instead of recompiling — the warm start ``bench.py
+    --compile-report`` measures. ``None`` disables it again.
+
+    The minimum-compile-time threshold is dropped to 0: this stack runs
+    MANY tiny programs (per-shape staging pads, gathers, reductions) whose
+    fixed per-program overhead is exactly what a warm start should erase.
+    """
+    import os
+
+    import jax
+
+    if path is not None:
+        path = os.path.expanduser(str(path))
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        try:
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        except AttributeError:  # older jaxlib: knob absent, default is fine
+            pass
+    else:
+        jax.config.update("jax_compilation_cache_dir", None)
+    # jax initializes its cache object lazily ONCE; flipping the dir after
+    # any compile has happened would otherwise be silently ignored for the
+    # rest of the process
+    try:
+        from jax._src.compilation_cache import reset_cache
+
+        reset_cache()
+    except Exception:  # pragma: no cover - private API moved; dir still
+        pass  # applies to processes that set the knob before first compile

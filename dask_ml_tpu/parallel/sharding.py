@@ -7,6 +7,14 @@ wants equal shards, so we pad the sample axis up to a multiple of the mesh's
 0 for padding) through every reduction. Algorithm cores in
 :mod:`dask_ml_tpu.models` are written to be weight-aware, which also gives us
 ``sample_weight`` support mostly for free.
+
+Under the default ``pad_policy`` config knob the sample axis additionally
+pads up to a SHAPE BUCKET (:mod:`dask_ml_tpu.parallel.shapes`): nearby
+sample counts stage to the same padded size, so every consumer of a staged
+array — estimator fits, CV fold slices, batched candidate groups — shares
+one compiled program per bucket instead of one per distinct ``n``. The
+bucket is always a multiple of the mesh's data-axis size, and the extra
+rows are ordinary weight-0 padding, so nothing downstream changes.
 """
 
 from __future__ import annotations
@@ -127,13 +135,33 @@ def pad_rows(n: int, n_shards: int) -> int:
     return (-n) % n_shards
 
 
+def _policy_sig():
+    """Identity of the active pad policy for staging-memo keys: the same
+    source array staged under different policies must not collide."""
+    from dask_ml_tpu.parallel import shapes
+
+    policy = shapes.active_policy()
+    return None if policy is None else policy.signature()
+
+
+def _padded_rows(n: int, mesh) -> int:
+    """Padded sample count for ``n`` on ``mesh``: the active policy's shape
+    bucket (a multiple of the data-axis size), or the exact mesh multiple
+    when bucketing is disabled."""
+    from dask_ml_tpu.parallel import shapes
+
+    return shapes.bucket_rows(n, align=mesh_lib.n_data_shards(mesh))
+
+
 def shard_rows(
     x: ArrayLike,
     mesh: Optional[Mesh] = None,
     dtype=None,
 ) -> tuple[jax.Array, int]:
-    """Pad ``x`` along axis 0 to an even multiple of the data-axis size and
-    place it sharded ``P('data', None, ...)``. Returns ``(sharded, n_valid)``.
+    """Pad ``x`` along axis 0 to its shape bucket (always an even multiple
+    of the data-axis size; the exact mesh multiple when the ``pad_policy``
+    knob is off) and place it sharded ``P('data', None, ...)``. Returns
+    ``(sharded, n_valid)``.
 
     Padding rows are zeros; callers must mask them via weights from
     :func:`row_weights` (or :func:`prepare_data`, which does both).
@@ -142,7 +170,7 @@ def shard_rows(
     memo = _current_memo()
     if memo is not None:
         return memo.get_or_stage(
-            ("rows", id(x), id(mesh), str(dtype)),
+            ("rows", id(x), id(mesh), str(dtype), _policy_sig()),
             (x, mesh),
             lambda: _shard_rows_impl(x, mesh, dtype),
         )
@@ -152,7 +180,7 @@ def shard_rows(
 def _shard_rows_impl(x, mesh, dtype):
     x = jnp.asarray(x, dtype=dtype)
     n = int(x.shape[0])
-    pad = pad_rows(n, mesh_lib.n_data_shards(mesh))
+    pad = _padded_rows(n, mesh) - n
     if pad:
         widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
         x = jnp.pad(x, widths)
@@ -204,7 +232,11 @@ def shard_2d(
     mesh = mesh or mesh_lib.default_mesh()
     x = jnp.asarray(x, dtype=dtype)
     n, d = int(x.shape[0]), int(x.shape[1])
-    pad_n = pad_rows(n, mesh_lib.n_data_shards(mesh))
+    # sample axis takes the shape bucket (same rule as shard_rows: weight-0
+    # rows are inert); the feature axis keeps exact model-multiple padding —
+    # fitted-state shapes follow d, and only cores written for padded
+    # features enable this path at all (see prepare_data)
+    pad_n = _padded_rows(n, mesh) - n
     pad_d = pad_rows(d, mesh_lib.n_model_shards(mesh))
     if pad_n or pad_d:
         x = jnp.pad(x, [(0, pad_n), (0, pad_d)])
@@ -299,7 +331,7 @@ def prepare_data(
         return memo.get_or_stage(
             ("data", id(X), _content_key(y), _content_key(sample_weight),
              id(mesh), str(dtype), str(y_dtype), shard_features,
-             bool(append_ones)),
+             bool(append_ones), _policy_sig()),
             (X, y, sample_weight, mesh),
             lambda: _prepare_data_impl(X, y, sample_weight, mesh, dtype,
                                        y_dtype, shard_features, append_ones),

@@ -115,6 +115,25 @@ class HostBlockSource:
     to: 2 = double buffering (one block computing, one in flight); 0 =
     strict serial transfer→compute alternation (the overlap-off baseline).
 
+    Ragged tails pad automatically: when the row count does not split into
+    ``n_blocks`` equal blocks (arrays mode), or the loader's LAST block
+    comes back short (the out-of-core tail), the block is zero-padded up
+    to the common block shape (``shapes.pad_tail``) — equal shapes are
+    what keep the per-block program compiled ONCE per epoch. Zero rows are
+    weight-0 rows for every consumer here (the block tuple's per-row
+    weight array is zero on them), so a padded tail produces bit-identical
+    results to a manually pre-padded source. ``pad_tail=None`` (default)
+    auto-pads only when the block tuple's last array is 1-D — the weight
+    vector every streamed consumer here carries ((X, w), (X, y, w)). That
+    is a HEURISTIC for the weight contract, not proof: a weightless
+    ``(X, y)`` tuple with 1-D labels matches it too (no in-repo consumer
+    takes that shape, but a custom step might) — pass ``pad_tail=False``
+    whenever the trailing 1-D array is not a per-row weight, because zero
+    rows would enter an unweighted consumer as real data. A tuple whose
+    last array is NOT 1-D keeps the old loud unequal-blocks
+    ``ValueError``. ``pad_tail=True`` forces padding (caller vouches for
+    the weight contract); ``pad_tail=False`` forbids it.
+
     ``retry_policy`` (a :class:`~dask_ml_tpu.parallel.faults.RetryPolicy`)
     makes block reads and ``device_put`` transfers survive transient
     failures — flaky object storage in loader mode, backend transfer
@@ -136,7 +155,8 @@ class HostBlockSource:
                  loader: Optional[Callable[[int], tuple]] = None,
                  transform: Optional[Callable] = None,
                  prefetch: int = 2, device=None,
-                 retry_policy=None, fault_injector=None):
+                 retry_policy=None, fault_injector=None,
+                 pad_tail: Optional[bool] = None):
         if (arrays is None) == (loader is None):
             raise ValueError(
                 "pass exactly one of `arrays` (host array tuple) or "
@@ -146,9 +166,11 @@ class HostBlockSource:
         self.n_blocks = int(n_blocks)
         self.prefetch = int(prefetch)
         self.transform = transform
+        self.pad_tail = pad_tail if pad_tail is None else bool(pad_tail)
         self._device = device
         self._loader = loader
         self._arrays: Optional[tuple] = None
+        # common per-block row count; loader mode learns it from block 0
         self._rows = None
         if arrays is not None:
             arrays = tuple(np.ascontiguousarray(a) for a in arrays)
@@ -158,20 +180,32 @@ class HostBlockSource:
                     raise ValueError(
                         f"all arrays must share axis 0: got lengths "
                         f"{[a.shape[0] for a in arrays]}")
-            if n % self.n_blocks:
+            if n % self.n_blocks and not self._may_pad(arrays):
                 raise ValueError(
                     f"{n} rows do not split into {self.n_blocks} equal "
-                    "blocks; pad the tail rows (weight 0) first — equal "
-                    "block shapes are what keep the per-block program "
-                    "compiled once")
+                    "blocks; auto-padding needs a trailing 1-D per-row "
+                    "weight array in the block tuple (zero rows are inert "
+                    "only under weights) or an explicit pad_tail=True — "
+                    "otherwise pad the tail rows (weight 0) yourself: "
+                    "equal block shapes are what keep the per-block "
+                    "program compiled once")
             self._arrays = arrays
-            self._rows = n // self.n_blocks
+            self._rows = -(-n // self.n_blocks)  # ceil: tail block pads
         self.retry_policy = retry_policy
         self.fault_injector = fault_injector
         self._inflight: dict = {}
         self._inflight_bytes: dict = {}
         self.bytes_streamed = 0
         self.blocks_started = 0
+
+    def _may_pad(self, blk) -> bool:
+        """Whether the ragged tail may be auto-padded: explicit pad_tail
+        wins; the default (None) requires the block tuple's LAST array to
+        be 1-D — the per-row weight vector every streamed consumer here
+        carries, which is what makes zero padding inert."""
+        if self.pad_tail is not None:
+            return self.pad_tail
+        return len(blk) >= 2 and np.asarray(blk[-1]).ndim == 1
 
     # -- host side ---------------------------------------------------------
 
@@ -188,13 +222,57 @@ class HostBlockSource:
                 self.fault_injector.on_load(b)
             if self._arrays is not None:
                 s = b * self._rows
-                return tuple(a[s:s + self._rows] for a in self._arrays)
-            return tuple(np.asarray(a) for a in self._loader(b))
+                blk = tuple(a[s:s + self._rows] for a in self._arrays)
+            else:
+                blk = tuple(np.asarray(a) for a in self._loader(b))
+            return self._pad_block(b, blk)
 
         if self.retry_policy is None:
             return read()
         return self.retry_policy.run(read, kind="block-load",
                                      detail=f"block {b}")
+
+    def _pad_block(self, b: int, blk: tuple) -> tuple:
+        """Zero-pad a short ragged TAIL block up to the common per-block
+        row count, so every block presents the SAME shape to the consuming
+        jitted step — one compiled per-block program per epoch. Zero rows
+        carry zero weight (the block tuple's weight array pads to 0), so
+        the padding is inert in the weighted solvers; see the class
+        docstring for the ``pad_tail`` modes. A short NON-tail block is an
+        error either way (a truncated shard read must surface, not be
+        masked as weight-0 rows)."""
+        if self.pad_tail is False or not self._may_pad(blk):
+            return blk
+        rows = int(blk[0].shape[0])
+        if self._rows is None:
+            # loader mode learns the common shape lazily: any block but the
+            # last is full-shaped by the ragged-tail contract. If the FIRST
+            # read is the tail (a resume landing there), peek block 0.
+            if b < self.n_blocks - 1 or self.n_blocks == 1:
+                self._rows = rows
+                return blk
+            if self.fault_injector is not None:
+                # the peek is a real block-0 load: keep the deterministic
+                # drill's load schedule honest
+                self.fault_injector.on_load(0)
+            self._rows = int(np.asarray(self._loader(0)[0]).shape[0])
+        if rows == self._rows:
+            return blk
+        if rows > self._rows:
+            raise ValueError(
+                f"block {b} has {rows} rows, more than the common block "
+                f"shape of {self._rows}; only the ragged TAIL may be "
+                "short")
+        if b != self.n_blocks - 1:
+            raise ValueError(
+                f"block {b} has {rows} rows but the common block shape is "
+                f"{self._rows}; only the ragged TAIL (block "
+                f"{self.n_blocks - 1}) may be short — a short interior "
+                "block means truncated input, which padding would "
+                "silently mask")
+        from dask_ml_tpu.parallel.shapes import pad_tail
+
+        return pad_tail(blk, self._rows)
 
     @property
     def out_struct(self) -> tuple:

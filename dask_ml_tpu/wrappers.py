@@ -30,6 +30,7 @@ wrappers.py:144-146 via _utils.copy_learned_attributes) and compose with
 from __future__ import annotations
 
 import logging
+import threading
 import weakref
 from concurrent.futures import ThreadPoolExecutor
 from timeit import default_timer as tic
@@ -334,6 +335,17 @@ def incremental_scan(step_fn, init_state, X, y=None, sample_weight=None,
 # closures don't pin their captures (and compiled executables) forever the
 # way a static-arg jit cache would.
 _scan_cache = weakref.WeakKeyDictionary()
+# Bounded strong-ref fallback for UNWEAKREFABLE step_fns (instances of
+# __slots__ classes without __weakref__, various C-implemented callables):
+# they used to silently skip caching and recompile the scan EVERY fit.
+# Keyed by identity (two equal-looking callables are distinct programs
+# anyway, since jit tracing closes over each one separately); the held
+# reference is what keeps the id stable. LRU-evicted at a small bound so
+# throwaway callables (and their captures + compiled executables) cannot
+# accumulate forever — the failure mode the weak dict exists to avoid.
+_scan_cache_strong: dict = {}  # id(step_fn) -> (step_fn, run); dicts are ordered
+_SCAN_CACHE_STRONG_MAX = 32
+_scan_cache_lock = threading.Lock()
 
 
 def _get_scan_run(step_fn):
@@ -341,6 +353,13 @@ def _get_scan_run(step_fn):
         return _scan_cache[step_fn]
     except (KeyError, TypeError):
         pass
+    with _scan_cache_lock:
+        entry = _scan_cache_strong.get(id(step_fn))
+        if entry is not None and entry[0] is step_fn:
+            # refresh LRU position
+            _scan_cache_strong[id(step_fn)] = _scan_cache_strong.pop(
+                id(step_fn))
+            return entry[1]
 
     @jax.jit
     def run(state, Xb, yb, wb):
@@ -352,6 +371,9 @@ def _get_scan_run(step_fn):
 
     try:
         _scan_cache[step_fn] = run
-    except TypeError:  # unweakrefable callables just skip the cache
-        pass
+    except TypeError:  # unweakrefable: bounded strong-ref fallback
+        with _scan_cache_lock:
+            _scan_cache_strong[id(step_fn)] = (step_fn, run)
+            while len(_scan_cache_strong) > _SCAN_CACHE_STRONG_MAX:
+                _scan_cache_strong.pop(next(iter(_scan_cache_strong)))
     return run

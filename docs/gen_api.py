@@ -50,6 +50,12 @@ SECTIONS = [
      "argmin / weighted-accumulation epilogues) with measured "
      "fused-vs-XLA dispatch — see docs/kernels.md for the family's "
      "design, thresholds, and measurement method."),
+    ("dask_ml_tpu.parallel.shapes", "Shape bucketing & compile observability",
+     "Bucketed sample-axis padding — any sample count lands in a small set "
+     "of padded sizes with weight-0 (inert) pad rows, so compile counts "
+     "scale with buckets instead of distinct shapes — plus jax.monitoring "
+     "compile counters and the persistent-compilation-cache hook; see "
+     "docs/compile.md for the policy and the CI gate."),
     ("dask_ml_tpu.parallel.faults", "Fault tolerance",
      "Retry/backoff for transient host-I/O and device-transfer failures, "
      "preemption-safe checkpoint/drain/resume for the streamed tier, and "
@@ -77,6 +83,11 @@ EXTRA = {
     ],
     "dask_ml_tpu.ops.fused_distance": [
         "fused_rowwise_min", "fused_argmin_min", "fused_argmin_weight",
+    ],
+    "dask_ml_tpu.parallel.shapes": [
+        "PadPolicy", "active_policy", "bucket_rows", "pad_tail",
+        "compile_stats", "reset_compile_stats", "track_compiles",
+        "enable_persistent_cache",
     ],
     "dask_ml_tpu.datasets": ["make_blobs", "make_regression",
                              "make_classification", "make_counts"],

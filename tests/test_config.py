@@ -22,7 +22,8 @@ def _clean_config():
 
 def test_defaults():
     cfg = get_config()
-    assert cfg == {"dtype": None, "mesh": None, "device_outputs": False}
+    assert cfg == {"dtype": None, "mesh": None, "device_outputs": False,
+                   "pad_policy": "auto", "compilation_cache": None}
 
 
 def test_device_outputs_scopes_transform_results():

@@ -1,0 +1,451 @@
+"""Shape-bucketed padded execution (parallel/shapes.py): bucket math,
+padded-vs-exact equivalence across the weight-aware fit paths, ragged-tail
+stream padding, and the compile-count regression gate.
+
+The contract under test (docs/compile.md): any sample count stages into a
+small set of padded buckets; rows past the true count carry weight 0 and
+are inert, so padded and exact runs agree — bit-identically against a
+manually padded run of the SAME shape, within reduction-order float
+tolerance against an unpadded run of a different shape — and compile
+counts scale with the number of buckets, not with the number of distinct
+sample counts (folds, dataset sizes)."""
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu import config
+from dask_ml_tpu.parallel import shapes
+from dask_ml_tpu.parallel.shapes import PadPolicy
+
+
+# ---------------------------------------------------------------------------
+# bucket-assignment unit tests
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_monotone_and_padded():
+    p = PadPolicy()
+    prev = 0
+    for n in range(1, 4000, 7):
+        b = p.bucket(n)
+        assert b >= n
+        assert b >= prev  # monotone in n
+        prev = b
+
+
+def test_bucket_waste_cap():
+    """Relative waste stays under the cap for every n at or above the
+    smallest bucket (the cap's domain)."""
+    for cap in (0.25, 0.125, 0.0625):
+        p = PadPolicy(waste_cap=cap)
+        rng = np.random.RandomState(0)
+        for n in rng.randint(p.min_rows, 10**7, size=500):
+            b = p.bucket(int(n))
+            assert (b - n) / n <= cap + 1e-12, (cap, int(n), b)
+
+
+def test_bucket_small_set():
+    """Powers-of-two-ish growth: ~1/waste_cap buckets per octave, so the
+    whole range up to a million rows uses a small set."""
+    p = PadPolicy(waste_cap=0.125)
+    octave = sorted({p.bucket(n) for n in range(4096, 8193)})
+    assert len(octave) <= 9, octave
+    total = {p.bucket(n) for n in range(1, 1_000_000, 97)}
+    assert len(total) <= 8 * 21  # ~1/waste_cap per octave, ~14 octaves
+
+
+def test_bucket_min_rows_and_align():
+    p = PadPolicy(min_rows=64)
+    # everything at or below the smallest bucket shares it
+    assert {p.bucket(n) for n in range(1, 65)} == {64}
+    # alignment: every bucket splits evenly over the mesh axis
+    for align in (1, 3, 8):
+        for n in (1, 13, 100, 266, 4097):
+            assert p.bucket(n, align=align) % align == 0
+
+
+def test_bucket_validation():
+    with pytest.raises(ValueError, match="waste_cap"):
+        PadPolicy(waste_cap=0.0)
+    with pytest.raises(ValueError, match="min_rows"):
+        PadPolicy(min_rows=0)
+    with pytest.raises(ValueError, match="n must be"):
+        PadPolicy().bucket(-1)
+
+
+def test_active_policy_knob():
+    assert shapes.active_policy() is shapes.DEFAULT_POLICY
+    with config.config_context(pad_policy=None):
+        assert shapes.active_policy() is None
+    custom = PadPolicy(waste_cap=0.25, min_rows=8)
+    with config.config_context(pad_policy=custom):
+        assert shapes.active_policy() is custom
+    with config.config_context(pad_policy="bogus"):
+        with pytest.raises(ValueError, match="pad_policy"):
+            shapes.active_policy()
+
+
+def test_bucket_rows_policy_off_is_mesh_multiple():
+    with config.config_context(pad_policy=None):
+        assert shapes.bucket_rows(13, align=8) == 16
+        assert shapes.bucket_rows(24, align=8) == 24
+
+
+def test_compilation_cache_rejected_in_context():
+    with pytest.raises(ValueError, match="process-wide"):
+        with config.config_context(compilation_cache="/tmp/x"):
+            pass
+
+
+# ---------------------------------------------------------------------------
+# padded-vs-exact equivalence (the weight-aware fit paths)
+# ---------------------------------------------------------------------------
+
+# Sample counts chosen to be NON-aligned to any mesh multiple or bucket
+# boundary, including n smaller than the smallest bucket (13 < min_rows=64).
+EQUIV_NS = [13, 97, 266]
+
+
+def _data(n, d=6, seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(n, d) @ np.diag(np.linspace(2.0, 0.5, d))).astype(
+        np.float32)
+
+
+@pytest.mark.parametrize("n", EQUIV_NS)
+def test_kmeans_padded_vs_exact(n):
+    """Bucket padding must not change KMeans labels or inertia: padding
+    rows have weight 0 everywhere (assignment, M-step, inertia).
+
+    Integer-valued inputs keep the FIRST assignment exact (all distances
+    integral), pinning labels bitwise; later iterations divide by counts,
+    so centers/inertia are compared at last-ulp tolerance — the padded
+    reduction tree differs and XLA's sum order with it."""
+    from dask_ml_tpu.cluster import KMeans
+
+    X = np.random.RandomState(0).randint(0, 8, size=(n, 6)).astype(
+        np.float32)
+    k = min(3, n)
+    a = KMeans(init="random", n_clusters=k, max_iter=20,
+               random_state=0).fit(X)
+    with config.config_context(pad_policy=None):
+        b = KMeans(init="random", n_clusters=k, max_iter=20,
+                   random_state=0).fit(X)
+    np.testing.assert_array_equal(a.labels_, b.labels_)
+    assert a.labels_.shape == (n,)
+    np.testing.assert_allclose(a.inertia_, b.inertia_, rtol=1e-6)
+    np.testing.assert_allclose(a.cluster_centers_, b.cluster_centers_,
+                               rtol=1e-6, atol=1e-6)
+    assert a.n_iter_ == b.n_iter_
+
+
+@pytest.mark.parametrize("n", EQUIV_NS)
+def test_pca_padded_vs_exact(n):
+    """Weight-0 rows contribute nothing to the mean or the Gram/tsqr R, so
+    components and explained variance match the unbucketed run."""
+    from dask_ml_tpu.decomposition import PCA
+
+    X = _data(n, d=5, seed=1)
+    k = min(3, n, 5)
+    a = PCA(n_components=k, svd_solver="tsqr").fit(X)
+    with config.config_context(pad_policy=None):
+        b = PCA(n_components=k, svd_solver="tsqr").fit(X)
+    np.testing.assert_allclose(a.components_, b.components_,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(a.explained_variance_, b.explained_variance_,
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(a.mean_, b.mean_, rtol=1e-5, atol=1e-6)
+    Xt_a = a.transform(X)
+    with config.config_context(pad_policy=None):
+        Xt_b = b.transform(X)
+    assert np.asarray(Xt_a).shape == (n, k)
+    np.testing.assert_allclose(np.asarray(Xt_a), np.asarray(Xt_b),
+                               rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("n", EQUIV_NS)
+def test_glm_padded_vs_exact(n):
+    """The GLM objective is sample-weighted (padding rows weigh 0 in loss,
+    gradient, and Hessian), so coefficients match."""
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    X = _data(n, d=4, seed=2)
+    rng = np.random.RandomState(3)
+    y = (X @ rng.randn(4) > 0).astype(np.int32)
+    if len(np.unique(y)) < 2:  # pragma: no cover - seed-dependent guard
+        y[0] = 1 - y[0]
+    a = LogisticRegression(max_iter=50).fit(X, y)
+    with config.config_context(pad_policy=None):
+        b = LogisticRegression(max_iter=50).fit(X, y)
+    np.testing.assert_allclose(a.coef_, b.coef_, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(a.intercept_, b.intercept_,
+                               rtol=1e-3, atol=1e-4)
+    np.testing.assert_array_equal(a.predict(X), b.predict(X))
+
+
+def test_sample_weight_composes_with_bucketing():
+    """User sample_weight occupies the true rows; bucket padding appends
+    zeros after it — the weighted mean is unchanged."""
+    import jax.numpy as jnp
+
+    from dask_ml_tpu.parallel.sharding import prepare_data
+
+    n = 97
+    X = _data(n)
+    sw = np.random.RandomState(5).uniform(0.5, 2.0, n).astype(np.float32)
+    d = prepare_data(X, sample_weight=sw)
+    assert d.n == n
+    w = np.asarray(d.weights)
+    np.testing.assert_allclose(w[:n], sw, rtol=1e-6)
+    assert w[n:].sum() == 0.0
+    assert float(jnp.sum(d.weights)) == pytest.approx(float(sw.sum()),
+                                                      rel=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# ragged-tail stream padding (stream.py satellite)
+# ---------------------------------------------------------------------------
+
+
+def _stream_problem(n=1003, d=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    y = (X @ np.random.RandomState(3).randn(d)
+         + 0.1 * rng.standard_normal(n) > 0).astype(np.float32)
+    w = np.ones(n, np.float32)
+    return X, y, w
+
+
+def test_ragged_tail_stream_bit_identical_no_extra_program(mesh8):
+    """A ragged final block auto-pads (weight 0) and yields BIT-identical
+    (z, x, u) to a manually padded source — and compiles no extra program,
+    because the padded tail presents the same block shape."""
+    from dask_ml_tpu.models import glm as glm_core
+    from dask_ml_tpu.parallel.stream import HostBlockSource
+
+    n, d, n_blocks = 1003, 6, 8  # 1003 = 7*126 + 121: ragged tail
+    X, y, w = _stream_problem(n, d)
+    kw = dict(family="logistic", regularizer="l2", lamduh=1.0, max_iter=4,
+              abstol=0.0, reltol=0.0, return_state=True)
+
+    rows = -(-n // n_blocks)
+    pad = rows * n_blocks - n
+    Xp = np.concatenate([X, np.zeros((pad, d), np.float32)])
+    yp = np.concatenate([y, np.zeros(pad, np.float32)])
+    wp = np.concatenate([w, np.zeros(pad, np.float32)])
+
+    zm, _, (zm2, xm, um), _ = glm_core.admm_streamed(
+        HostBlockSource((Xp, yp, wp), n_blocks), n_blocks, d, float(n), **kw)
+    with shapes.track_compiles() as t:
+        zr, _, (zr2, xr, ur), _ = glm_core.admm_streamed(
+            HostBlockSource((X, y, w), n_blocks), n_blocks, d, float(n),
+            **kw)
+    np.testing.assert_array_equal(np.asarray(zm), np.asarray(zr))
+    np.testing.assert_array_equal(np.asarray(xm), np.asarray(xr))
+    np.testing.assert_array_equal(np.asarray(um), np.asarray(ur))
+    assert t["n_compiles"] == 0, (
+        "auto-padded ragged run must reuse the manually-padded run's "
+        f"programs, compiled {t['n_compiles']} new ones")
+
+
+def test_ragged_tail_streamed_moments_matches_exact(mesh8):
+    """streamed_moments over a ragged source equals the exact moments of
+    the true rows (weight-0 padding contributes nothing to Sw/sums/Gram)."""
+    from dask_ml_tpu.decomposition.streaming import streamed_moments
+    from dask_ml_tpu.parallel.stream import HostBlockSource
+
+    n, d, n_blocks = 509, 5, 4
+    X, _, w = _stream_problem(n, d, seed=4)
+    sw, s, G = streamed_moments(
+        block_fn=HostBlockSource((X, w), n_blocks), n_blocks=n_blocks)
+    assert float(np.asarray(sw)) == pytest.approx(n)
+    np.testing.assert_allclose(np.asarray(s), X.sum(0), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(G), X.T @ X, rtol=1e-4,
+                               atol=1e-3)
+
+
+def test_ragged_loader_mode_pads_short_tail(mesh8):
+    """Loader mode: a short tail block from an out-of-core reader pads to
+    the common block shape learned from block 0."""
+    from dask_ml_tpu.parallel.stream import HostBlockSource
+
+    n, d, n_blocks = 100, 3, 4  # blocks of 30/30/30/10
+    X, _, w = _stream_problem(n, d, seed=6)
+
+    def loader(b):
+        s = b * 30
+        return X[s:s + 30], w[s:s + 30]
+
+    src = HostBlockSource(loader=loader, n_blocks=n_blocks)
+    blocks = [src.host_block(b) for b in range(n_blocks)]
+    assert all(blk[0].shape == (30, d) for blk in blocks)
+    # tail rows beyond the true data are zero-weight zeros
+    np.testing.assert_array_equal(blocks[3][0][10:], 0.0)
+    np.testing.assert_array_equal(blocks[3][1][10:], 0.0)
+    np.testing.assert_array_equal(blocks[3][0][:10], X[90:])
+
+
+def test_pad_tail_false_keeps_strict_contract():
+    from dask_ml_tpu.parallel.stream import HostBlockSource
+
+    X, _, w = _stream_problem(10, 3)
+    with pytest.raises(ValueError, match="equal block"):
+        HostBlockSource((X, w), 3, pad_tail=False)
+    # divisible counts are untouched
+    HostBlockSource((X, w), 5, pad_tail=False)
+
+
+def test_pad_tail_requires_weight_array_by_default():
+    """Auto-padding is gated on the weight contract: a ragged block tuple
+    WITHOUT a trailing 1-D weight array keeps the loud ValueError (zero
+    rows would enter an unweighted consumer as real data); pad_tail=True
+    lets a caller who carries weights elsewhere opt in explicitly."""
+    from dask_ml_tpu.parallel.stream import HostBlockSource
+
+    X, _, _w = _stream_problem(10, 3)
+    with pytest.raises(ValueError, match="weight array"):
+        HostBlockSource((X,), 3)  # no weights -> no silent padding
+    src = HostBlockSource((X,), 3, pad_tail=True)  # explicit opt-in
+    assert src.host_block(2)[0].shape[0] == 4
+
+
+def test_loader_short_interior_block_raises():
+    """A short NON-tail loader block is truncated input, not a ragged
+    tail — padding must not mask it."""
+    from dask_ml_tpu.parallel.stream import HostBlockSource
+
+    X, _, w = _stream_problem(90, 3)
+
+    def loader(b):
+        s = b * 30
+        e = s + (20 if b == 1 else 30)  # interior block 1 comes up short
+        return X[s:e], w[s:e]
+
+    src = HostBlockSource(loader=loader, n_blocks=3)
+    src.host_block(0)
+    with pytest.raises(ValueError, match="only the ragged TAIL"):
+        src.host_block(1)
+
+
+def test_pad_tail_rejects_oversize_block():
+    with pytest.raises(ValueError, match="more than the target"):
+        shapes.pad_tail((np.ones((5, 2)),), 3)
+
+
+# ---------------------------------------------------------------------------
+# compile observability + the compile-count regression gate
+# ---------------------------------------------------------------------------
+
+
+def test_compile_stats_counts_fresh_compiles():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        return x * 3.0 + 1.0
+
+    # inputs built OUTSIDE the tracked windows: eager jnp.ones compiles
+    # its own tiny per-shape program
+    x7, x11 = jnp.ones((7, 3)), jnp.ones((11, 3))
+    f(x7)  # compile outside the window (or hit an earlier cache)
+    with shapes.track_compiles() as t0:
+        f(x7)  # cache hit
+    assert t0["n_compiles"] == 0
+    with shapes.track_compiles() as t1:
+        f(x11)  # fresh shape -> one real compile
+    assert t1["n_compiles"] == 1
+    assert t1["compile_seconds"] > 0.0
+    stats = shapes.compile_stats()
+    assert {"n_compiles", "compile_seconds", "n_traces", "trace_seconds",
+            "shape_buckets"} <= set(stats)
+
+
+def test_compile_count_gate_kfold_grid_search(mesh8):
+    """THE regression gate (CI `compile` job): a 6-candidate x 3-fold
+    KMeans grid search whose fold train sizes differ (266 vs 267) must
+    compile its batched-cells program O(shape buckets) times — here
+    exactly ONCE — not once per fold; and a second search on a different
+    dataset size landing in the same buckets must add ZERO heavy compiles
+    and only a handful of trivial per-shape ops (gathers, pads)."""
+    from dask_ml_tpu.cluster import KMeans
+    from dask_ml_tpu.model_selection import GridSearchCV
+    from dask_ml_tpu.models import kmeans as km_core
+
+    grid = {"n_clusters": [2, 3], "tol": [1e-4, 1e-2, 1e-1]}  # 6 candidates
+
+    def search(n, seed):
+        X = _data(n, d=12, seed=seed)
+        return GridSearchCV(
+            KMeans(init="random", max_iter=8, random_state=0), grid,
+            cv=3, refit=False, n_jobs=1).fit(X)
+
+    before = km_core._batched_cells_impl._cache_size()
+    gs = search(400, seed=0)  # folds: train 266/267/267, test 134/133/133
+    assert gs.n_batched_cells_ == 18
+    # the batch plan's bucket count bounds the heavy compiles: train sizes
+    # 266 and 267 share one bucket, so ONE program serves all 3 folds
+    # (pre-bucketing, the static n_valid alone forced one per distinct
+    # fold size); <= tolerates an earlier test having compiled the shape
+    assert km_core._batched_cells_impl._cache_size() - before <= 1
+    assert len(gs.shape_buckets_) == 2  # one train bucket + one test bucket
+
+    # same buckets, shifted n: no heavy compiles, no candidate-scaling
+    before2 = km_core._batched_cells_impl._cache_size()
+    with shapes.track_compiles() as t:
+        gs2 = search(398, seed=7)  # folds: train 265/266, test 132/133
+    assert gs2.shape_buckets_ == gs.shape_buckets_
+    assert km_core._batched_cells_impl._cache_size() - before2 == 0
+    # remaining compiles are per-shape trivia (fold gathers, staging pads,
+    # the upload finite-scan) — a small constant, nowhere near the 18
+    # candidate x fold cells, and zero of them are data-pass programs
+    assert t["n_compiles"] <= 12, t
+    # scores still correct: against the per-cell oracle
+    def sc(est, X, y=None):
+        return est.score(X)
+
+    X2 = _data(398, d=12, seed=7)
+    oracle = GridSearchCV(
+        KMeans(init="random", max_iter=8, random_state=0), grid,
+        cv=3, refit=False, n_jobs=1, scoring=sc).fit(X2)
+    assert oracle.n_batched_cells_ == 0
+    np.testing.assert_allclose(
+        np.asarray(gs2.cv_results_["mean_test_score"]),
+        np.asarray(oracle.cv_results_["mean_test_score"]),
+        rtol=1e-3, atol=1e-3)
+    np.testing.assert_array_equal(gs2.cv_results_["rank_test_score"],
+                                  oracle.cv_results_["rank_test_score"])
+
+
+def test_planned_buckets_reports_fold_buckets(mesh8):
+    from dask_ml_tpu.model_selection._search import CVCache
+
+    splits = [(np.arange(266), np.arange(266, 400)),
+              (np.arange(267), np.arange(267, 400)),
+              (np.arange(133), np.arange(133, 400))]
+    cache = CVCache(splits, np.zeros((400, 2), np.float32), None,
+                    pad_policy=shapes.DEFAULT_POLICY)
+    got = cache.planned_buckets()
+    # 266/267 -> one bucket; 133/134/267 tests -> their buckets
+    assert got == sorted({shapes.DEFAULT_POLICY.bucket(m, align=8)
+                          for m in (266, 267, 133, 134)})
+    # policy off: exact mesh multiples
+    cache_off = CVCache(splits, np.zeros((400, 2), np.float32), None,
+                        pad_policy=None)
+    assert cache_off.planned_buckets() == sorted(
+        {-(-m // 8) * 8 for m in (266, 267, 133, 134)})
+
+
+def test_persistent_cache_knob_roundtrip(tmp_path):
+    import jax
+
+    from dask_ml_tpu.config import set_config
+
+    cache_dir = str(tmp_path / "xla-cache")
+    try:
+        set_config(compilation_cache=cache_dir)
+        assert jax.config.jax_compilation_cache_dir == cache_dir
+        assert jax.config.jax_persistent_cache_min_compile_time_secs == 0.0
+    finally:
+        set_config(compilation_cache=None)
+        assert jax.config.jax_compilation_cache_dir is None

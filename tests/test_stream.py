@@ -27,7 +27,16 @@ def test_constructor_validation():
     with pytest.raises(ValueError, match="n_blocks"):
         HostBlockSource((X, w), 0)
     with pytest.raises(ValueError, match="equal"):
-        HostBlockSource((X, w), 5)  # 64 % 5 != 0
+        # 64 % 5 != 0: the strict contract survives under pad_tail=False
+        HostBlockSource((X, w), 5, pad_tail=False)
+    # default: the ragged tail auto-pads with weight-0 zeros instead
+    src = HostBlockSource((X, w), 5)
+    assert src._rows == 13  # ceil(64 / 5)
+    Xt, wt = src.host_block(4)
+    assert Xt.shape[0] == 13
+    np.testing.assert_array_equal(Xt[:12], X[52:])
+    np.testing.assert_array_equal(Xt[12:], 0)
+    np.testing.assert_array_equal(wt[12:], 0)
     with pytest.raises(ValueError, match="axis 0"):
         HostBlockSource((X, w[:-1]), 4)
 

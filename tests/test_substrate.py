@@ -44,12 +44,23 @@ def test_shard_rows_divisible(any_mesh):
 
 
 def test_shard_rows_padding():
+    from dask_ml_tpu import config
+    from dask_ml_tpu.parallel import shapes
+
     m = make_mesh(n_devices=8)
     with use_mesh(m):
         x = np.ones((13, 3), dtype=np.float32)
+        # default: the shape-bucket contract — 13 rows land in the
+        # smallest bucket (one shared program for every tiny input)
         xs, n = shard_rows(x)
         assert n == 13
-        assert xs.shape == (16, 3)
+        assert xs.shape == (shapes.DEFAULT_POLICY.bucket(13, align=8), 3)
+        np.testing.assert_array_equal(np.asarray(xs)[13:], 0)
+        # bucketing off: exact mesh-multiple padding, the old contract
+        with config.config_context(pad_policy=None):
+            xs, n = shard_rows(x)
+            assert n == 13
+            assert xs.shape == (16, 3)
 
 
 def test_prepare_data_weights_mask_padding(any_mesh):

@@ -436,3 +436,78 @@ def test_incremental_fused_scan_multinomial_three_classes():
     assert inc.predict(X).shape == (600,)
     acc = np.mean(inc.predict(X) == y)
     assert acc > 0.9, acc
+
+
+class _SlotsStep:
+    """A configured step callable with __slots__ and no __weakref__ — the
+    realistic unweakrefable shape (weakref.ref raises TypeError on it, so
+    the WeakKeyDictionary cannot hold it)."""
+
+    __slots__ = ("scale",)
+
+    def __init__(self, scale):
+        self.scale = scale
+
+    def __call__(self, state, blk):
+        import jax.numpy as jnp
+
+        x, _y, w = blk
+        return state + self.scale * jnp.sum(x * w[:, None])
+
+
+def test_scan_cache_strong_fallback_caches_unweakrefable():
+    """Unweakrefable step_fns used to silently skip the compiled-scan
+    cache and recompile every fit; the bounded strong-ref fallback must
+    hand back the SAME compiled runner for the same object."""
+    import weakref
+
+    from dask_ml_tpu import wrappers
+
+    with pytest.raises(TypeError):
+        weakref.ref(_SlotsStep(1.0))  # the premise: unweakrefable
+
+    p = _SlotsStep(2.0)
+    run1 = wrappers._get_scan_run(p)
+    run2 = wrappers._get_scan_run(p)
+    assert run1 is run2  # cache hit, no recompile
+    assert wrappers._get_scan_run(_SlotsStep(2.0)) is not run1
+    # weakrefable callables still take the weak path, not the bounded dict
+    def weak_step(state, blk):
+        return state
+
+    n_strong = len(wrappers._scan_cache_strong)
+    wrappers._get_scan_run(weak_step)
+    assert len(wrappers._scan_cache_strong) == n_strong
+    assert weak_step in wrappers._scan_cache
+
+
+def test_scan_cache_strong_fallback_evicts_lru():
+    """The strong-ref fallback is BOUNDED: filling it past the cap evicts
+    the least-recently-used entry (a throwaway-callable workload cannot
+    pin captures and executables forever), while a recently-touched entry
+    survives."""
+    from dask_ml_tpu import wrappers
+
+    wrappers._scan_cache_strong.clear()
+    keep = _SlotsStep("keep")
+    run_keep = wrappers._get_scan_run(keep)
+    fillers = [_SlotsStep(i)
+               for i in range(wrappers._SCAN_CACHE_STRONG_MAX - 1)]
+    for f in fillers:
+        wrappers._get_scan_run(f)
+    assert len(wrappers._scan_cache_strong) == \
+        wrappers._SCAN_CACHE_STRONG_MAX
+    # touch `keep` so it is most-recently-used, then overflow by one
+    assert wrappers._get_scan_run(keep) is run_keep
+    overflow = _SlotsStep("overflow")
+    wrappers._get_scan_run(overflow)
+    assert len(wrappers._scan_cache_strong) == \
+        wrappers._SCAN_CACHE_STRONG_MAX
+    # the LRU filler was evicted; keep and overflow are present
+    assert id(keep) in wrappers._scan_cache_strong
+    assert id(overflow) in wrappers._scan_cache_strong
+    assert id(fillers[0]) not in wrappers._scan_cache_strong
+    # an evicted callable re-registers (and recompiles) cleanly
+    evicted = fillers[0]
+    wrappers._get_scan_run(evicted)
+    assert id(evicted) in wrappers._scan_cache_strong
