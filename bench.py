@@ -453,13 +453,17 @@ def _host_stream_rows(rate, epochs, bytes_per_row, cap_bytes, full_n,
 
 
 def _overlap_runs(run):
-    """(t_prefetch, bytes_streamed, t_serial) for a host-streamed bench:
-    one warm pass (compiles the per-block programs), then the depth-2 and
-    depth-0 schedules. ``run(prefetch) -> (seconds, bytes)``."""
+    """(t_prefetch, wire_bytes, logical_bytes, t_serial) for a
+    host-streamed bench: one warm pass (compiles the per-block programs),
+    then the depth-2 and depth-0 schedules.
+    ``run(prefetch) -> (seconds, wire_bytes, logical_bytes)`` — wire is
+    what actually crossed the link (post precision-policy cast), logical
+    what the uncast blocks would have weighed; they differ only under a
+    low-precision wire policy (docs/precision.md)."""
     run(2)
-    t_pref, bytes_streamed = run(2)
-    t_serial, _ = run(0)
-    return t_pref, bytes_streamed, t_serial
+    t_pref, wire, logical = run(2)
+    t_serial, _, _ = run(0)
+    return t_pref, wire, logical, t_serial
 
 
 def bench_pca_blueprint_host(rtt):
@@ -494,9 +498,10 @@ def bench_pca_blueprint_host(rtt):
         sw, s, G = streamed_moments(block_fn=src, n_blocks=n_blocks)
         out = _pca_from_moments(sw, s, G)
         fetch(out[1])
-        return time.perf_counter() - t0, src.bytes_streamed
+        return (time.perf_counter() - t0, src.bytes_streamed,
+                src.logical_bytes_streamed)
 
-    t_pref, bytes_streamed, t_serial = _overlap_runs(run)
+    t_pref, bytes_streamed, logical_bytes, t_serial = _overlap_runs(run)
 
     sk_scaled, bl_note = _baseline_seconds_at("pca_blueprint", n_h)
     if sk_scaled is None:
@@ -512,7 +517,9 @@ def bench_pca_blueprint_host(rtt):
         "blocks": n_blocks,
         "block_source": "host-streamed (HostBlockSource, prefetch=2)",
         "effective_gbps": round(bytes_streamed / t_pref / 1e9, 3),
+        "effective_gbps_logical": round(logical_bytes / t_pref / 1e9, 3),
         "bytes_streamed": int(bytes_streamed),
+        "logical_bytes_streamed": int(logical_bytes),
         "prefetch_disabled_seconds": round(t_serial, 3),
         "prefetch_disabled_gbps": round(bytes_streamed / t_serial / 1e9, 3),
         "overlap_speedup": round(t_serial / t_pref, 2),
@@ -687,9 +694,10 @@ def bench_admm_blueprint_host(rtt):
             regularizer="l2", lamduh=1.0, max_iter=outer,
             abstol=0.0, reltol=0.0)
         fetch(z)
-        return time.perf_counter() - t0, src.bytes_streamed
+        return (time.perf_counter() - t0, src.bytes_streamed,
+                src.logical_bytes_streamed)
 
-    t_pref, bytes_streamed, t_serial = _overlap_runs(run)
+    t_pref, bytes_streamed, logical_bytes, t_serial = _overlap_runs(run)
 
     sk_scaled, bl_note = _baseline_seconds_at("admm_blueprint", n_h)
     if sk_scaled is None:
@@ -705,7 +713,9 @@ def bench_admm_blueprint_host(rtt):
         "blocks": n_blocks,
         "block_source": "host-streamed (HostBlockSource, prefetch=2)",
         "effective_gbps": round(bytes_streamed / t_pref / 1e9, 3),
+        "effective_gbps_logical": round(logical_bytes / t_pref / 1e9, 3),
         "bytes_streamed": int(bytes_streamed),
+        "logical_bytes_streamed": int(logical_bytes),
         "prefetch_disabled_seconds": round(t_serial, 3),
         "prefetch_disabled_gbps": round(bytes_streamed / t_serial / 1e9, 3),
         "overlap_speedup": round(t_serial / t_pref, 2),
@@ -1315,6 +1325,211 @@ def bench_faults(rtt):
 
 
 # ---------------------------------------------------------------------------
+# mixed-precision f32-vs-bf16 grid (ISSUE 5): wire bytes, effective GB/s,
+# end-to-end fit time, and accuracy deltas for the streamed tier + every
+# solver family — the numbers committed as PRECISION_r01.json and printed
+# by the CI `precision` job (nonzero exit if any accuracy gate fails)
+# ---------------------------------------------------------------------------
+
+
+def bench_precision(rtt):
+    """The f32-vs-bf16 precision grid (docs/precision.md):
+
+    1. streamed ADMM + streamed-PCA moments at the tier's REAL bottleneck
+       (host-resident blocks through ``HostBlockSource``), run under the
+       f32 null policy and the bf16 wire policy — wire bytes vs logical
+       bytes (their ratio is the policy's transfer win; the acceptance
+       gate is >= 1.8x), effective GB/s on BOTH accountings, end-to-end
+       seconds, and the result's relative delta vs the f32 run;
+    2. in-memory solver accuracy gates — L-BFGS/Newton coefficients,
+       KMeans inertia, randomized-SVD singular values — each pinned
+       against its f32 baseline with the tolerances tabulated in
+       docs/precision.md.
+
+    Exits nonzero if any wire-reduction or accuracy gate fails. On this
+    CPU CI mesh the bf16 matmuls are emulated (slower than f32 — the
+    speed column only means something on TPU, where bf16 is the MXU's
+    native path); the wire-byte and accuracy columns are
+    backend-independent, which is why the gate runs everywhere."""
+    import jax
+    import jax.numpy as jnp
+
+    from dask_ml_tpu import config
+    from dask_ml_tpu.decomposition.streaming import (_pca_from_moments,
+                                                     streamed_moments)
+    from dask_ml_tpu.models import glm as glm_core
+    from dask_ml_tpu.parallel import precision as px
+    from dask_ml_tpu.parallel.stream import HostBlockSource
+
+    COEF_RTOL, VAR_RTOL, INERTIA_RTOL = 5e-2, 2e-2, 1e-2
+    rng = np.random.RandomState(0)
+    gates = {}
+
+    def rel(a, b):
+        a, b = np.asarray(a, np.float64), np.asarray(b, np.float64)
+        return float(np.linalg.norm(a - b) / max(np.linalg.norm(b), 1e-12))
+
+    # -- streamed ADMM at the wire -----------------------------------------
+    n, d, n_blocks, outer = 65_536, 100, 8, 3
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    w_true = np.random.RandomState(3).randn(d).astype(np.float32)
+    y = (X @ w_true + rng.standard_normal(n).astype(np.float32)
+         > 0).astype(np.float32)
+    w = np.ones(n, np.float32)
+    admm_kw = dict(family="logistic", regularizer="l2", lamduh=1.0,
+                   max_iter=outer, abstol=0.0, reltol=0.0)
+
+    def run_admm(policy):
+        with config.config_context(precision=policy):
+            src = HostBlockSource((X, y, w), n_blocks)
+        t0 = time.perf_counter()
+        z, _ = glm_core.admm_streamed(src, n_blocks, d, float(n), **admm_kw)
+        fetch(z)
+        return (np.asarray(z), time.perf_counter() - t0,
+                src.bytes_streamed, src.logical_bytes_streamed)
+
+    run_admm(None)  # warm-up compiles
+    z32, t32, wire32, logical32 = run_admm(None)
+    run_admm(px.BF16)
+    z16, t16, wire16, logical16 = run_admm(px.BF16)
+    admm_wire_reduction = logical16 / wire16
+    admm_delta = rel(z16, z32)
+    gates["admm_wire_reduction_ge_1.8"] = bool(admm_wire_reduction >= 1.8)
+    gates["admm_coef_delta_le_tol"] = bool(admm_delta <= COEF_RTOL)
+
+    # -- streamed PCA moments at the wire ----------------------------------
+    np_, dp, pblocks, kp = 131_072, 256, 8, 16
+    scale = np.linspace(3.0, 0.3, dp).astype(np.float32)
+    Xp = rng.standard_normal((np_, dp)).astype(np.float32) * scale + 1.0
+    wp = np.ones(np_, np.float32)
+
+    def run_pca(policy):
+        with config.config_context(precision=policy):
+            src = HostBlockSource((Xp, wp), pblocks)
+        t0 = time.perf_counter()
+        sw, s, G = streamed_moments(block_fn=src, n_blocks=pblocks)
+        _mean, evals, _comps = _pca_from_moments(sw, s, G)
+        fetch(evals)
+        return (np.asarray(evals[:kp]), time.perf_counter() - t0,
+                src.bytes_streamed, src.logical_bytes_streamed)
+
+    run_pca(None)  # warm-up compiles
+    ev32, pt32, pwire32, plogical32 = run_pca(None)
+    run_pca(px.BF16)
+    ev16, pt16, pwire16, plogical16 = run_pca(px.BF16)
+    pca_wire_reduction = plogical16 / pwire16
+    pca_delta = rel(ev16, ev32)
+    gates["pca_wire_reduction_ge_1.8"] = bool(pca_wire_reduction >= 1.8)
+    gates["pca_variance_delta_le_tol"] = bool(pca_delta <= VAR_RTOL)
+
+    # -- in-memory solver gates --------------------------------------------
+    ns, ds = 4096, 32
+    Xs = rng.standard_normal((ns, ds)).astype(np.float32)
+    ys = (Xs @ np.random.RandomState(1).randn(ds) > 0).astype(np.float32)
+    ws = jnp.ones((ns,), jnp.float32)
+    beta0 = jnp.zeros((ds,), jnp.float32)
+    mask = jnp.ones((ds,), jnp.float32)
+    solver_rows = {}
+    for name, fn in (("lbfgs", glm_core.lbfgs), ("newton", glm_core.newton)):
+        b32, it32 = fn(jnp.asarray(Xs), jnp.asarray(ys), ws, beta0, mask,
+                       family="logistic", regularizer="l2", lamduh=1.0,
+                       max_iter=100)
+        b16, it16 = fn(jnp.asarray(Xs, jnp.bfloat16), jnp.asarray(ys), ws,
+                       beta0, mask, family="logistic", regularizer="l2",
+                       lamduh=1.0, max_iter=100)
+        delta = rel(b16, b32)
+        solver_rows[name] = {
+            "coef_rel_delta": round(delta, 5),
+            "n_iter_f32": int(it32), "n_iter_bf16": int(it16),
+        }
+        gates[f"{name}_coef_delta_le_tol"] = bool(delta <= COEF_RTOL)
+
+    from dask_ml_tpu.cluster import KMeans
+
+    centers = (rng.standard_normal((8, 16)) * 6).astype(np.float32)
+    Xk = np.concatenate([
+        c + rng.standard_normal((2048, 16)).astype(np.float32)
+        for c in centers])
+    # the f32 baseline is PINNED to the null policy: on TPU the default
+    # "auto" resolves to BF16, and an unpinned baseline would stage bf16
+    # itself — making the gate compare bf16 against bf16
+    with config.config_context(precision=None):
+        km32 = KMeans(n_clusters=8, init="random", random_state=0,
+                      max_iter=50).fit(Xk)
+    with config.config_context(precision="bf16"):
+        km16 = KMeans(n_clusters=8, init="random", random_state=0,
+                      max_iter=50).fit(Xk)
+    inertia_delta = abs(float(km16.inertia_) - float(km32.inertia_)) \
+        / float(km32.inertia_)
+    gates["kmeans_inertia_delta_le_tol"] = bool(
+        inertia_delta <= INERTIA_RTOL)
+
+    from dask_ml_tpu.ops import linalg
+    from dask_ml_tpu.parallel.sharding import prepare_data
+
+    A = rng.standard_normal((8192, 16)).astype(np.float32)
+    B = rng.standard_normal((16, 64)).astype(np.float32)
+    Xr = A @ B + 0.05 * rng.standard_normal((8192, 64)).astype(np.float32)
+    with config.config_context(precision=None):  # stage f32 on any backend
+        data = prepare_data(Xr)
+    _, S32, _ = linalg.svd_compressed(data.X, 12, 2, weights=data.weights,
+                                      compute_dtype=None)
+    _, S16, _ = linalg.svd_compressed(data.X, 12, 2, weights=data.weights,
+                                      compute_dtype=jnp.bfloat16)
+    sketch_delta = rel(S16, S32)
+    gates["sketch_singular_values_delta_le_tol"] = bool(
+        sketch_delta <= VAR_RTOL)
+
+    emit({
+        "metric": "precision_grid",
+        "value": round(min(admm_wire_reduction, pca_wire_reduction), 3),
+        "unit": "min wire-byte reduction (logical/wire) on the streamed "
+                "ADMM/PCA paths under the bf16 policy",
+        "vs_baseline": None,
+        "backend": jax.default_backend(),
+        "all_gates_pass": all(gates.values()),
+        "gates": gates,
+        "tolerances": {"coef_rtol": COEF_RTOL, "var_rtol": VAR_RTOL,
+                       "inertia_rtol": INERTIA_RTOL},
+        "admm_streamed": {
+            "rows": n, "cols": d, "blocks": n_blocks, "outer_iters": outer,
+            "f32": {"seconds": round(t32, 3), "wire_bytes": int(wire32),
+                    "logical_bytes": int(logical32),
+                    "wire_gbps": round(wire32 / t32 / 1e9, 4)},
+            "bf16": {"seconds": round(t16, 3), "wire_bytes": int(wire16),
+                     "logical_bytes": int(logical16),
+                     "wire_gbps": round(wire16 / t16 / 1e9, 4),
+                     "logical_gbps": round(logical16 / t16 / 1e9, 4)},
+            "wire_reduction": round(admm_wire_reduction, 3),
+            "coef_rel_delta": round(admm_delta, 5),
+        },
+        "pca_streamed_moments": {
+            "rows": np_, "cols": dp, "blocks": pblocks,
+            "f32": {"seconds": round(pt32, 3), "wire_bytes": int(pwire32),
+                    "logical_bytes": int(plogical32),
+                    "wire_gbps": round(pwire32 / pt32 / 1e9, 4)},
+            "bf16": {"seconds": round(pt16, 3), "wire_bytes": int(pwire16),
+                     "logical_bytes": int(plogical16),
+                     "wire_gbps": round(pwire16 / pt16 / 1e9, 4),
+                     "logical_gbps": round(plogical16 / pt16 / 1e9, 4)},
+            "wire_reduction": round(pca_wire_reduction, 3),
+            "explained_variance_rel_delta": round(pca_delta, 5),
+        },
+        "solvers": solver_rows,
+        "kmeans_inertia_rel_delta": round(inertia_delta, 6),
+        "kmeans_n_iter": [int(km32.n_iter_), int(km16.n_iter_)],
+        "sketch_singular_values_rel_delta": round(sketch_delta, 5),
+        "note": "wire/accuracy columns are backend-independent; the "
+                "seconds columns only mean speed on TPU (CPU emulates "
+                "bf16 matmuls). PRECISION_r01.json commits this record.",
+    })
+    if not all(gates.values()):
+        raise SystemExit(
+            "precision grid: failed gates: "
+            + ", ".join(k for k, v in gates.items() if not v))
+
+
+# ---------------------------------------------------------------------------
 # KDD-Cup'99 harness (the reference's flagship real-data benchmark,
 # benchmarks/k_means_kdd.py:95-125: KMeans(n_clusters=8,
 # oversampling_factor=2, random_state=0) on ~4.9M x 41)
@@ -1604,6 +1819,13 @@ if __name__ == "__main__":
         # print the clean-vs-injected recovery-overhead deltas
         _enable_compilation_cache()
         bench_faults(measure_rtt())
+        emit_summary()
+    elif "--precision" in sys.argv:
+        # f32-vs-bf16 precision grid (ISSUE 5); CI's precision job runs
+        # this: wire-byte reduction + accuracy gates, nonzero exit on any
+        # gate failure (committed as PRECISION_r01.json)
+        _enable_compilation_cache()
+        bench_precision(measure_rtt())
         emit_summary()
     elif "--compile-child" in sys.argv:
         _compile_child()

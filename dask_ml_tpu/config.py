@@ -34,6 +34,18 @@ Knobs (all also overridable per-call at the API they configure):
   padding); a :class:`~dask_ml_tpu.parallel.shapes.PadPolicy` customizes
   the waste cap / smallest bucket. Thread-local under
   :func:`config_context`.
+- ``precision`` — the mixed-precision execution policy
+  (:mod:`dask_ml_tpu.parallel.precision`): ``"auto"`` (default) runs bf16
+  wire + compute with f32 accumulation on TPU and plain f32 everywhere
+  else; ``None``/``"f32"`` forces f32; ``"bf16"`` forces the bf16 policy
+  on any backend; a :class:`~dask_ml_tpu.parallel.precision.PrecisionPolicy`
+  customizes storage/compute/accumulation dtypes and per-op overrides.
+  The policy acts at staging (``prepare_data`` storage dtype), on the
+  streamed tier's wire (``HostBlockSource`` casts blocks host-side before
+  ``device_put``), and on the PCA sketch dtype; solver state always stays
+  ≥ f32 (``precision.state_dtype``). Thread-local under
+  :func:`config_context`; see ``docs/precision.md``. An explicit ``dtype``
+  knob (above) wins over the policy's storage dtype where both are set.
 - ``compilation_cache`` — directory for XLA's PERSISTENT compilation cache
   (``set_config(compilation_cache="~/.cache/...")``): repeat invocations
   load compiled programs from disk and start warm. Process-wide only
@@ -56,6 +68,7 @@ _DEFAULTS: dict[str, Any] = {
     "mesh": None,
     "device_outputs": False,
     "pad_policy": "auto",
+    "precision": "auto",
     "compilation_cache": None,
 }
 

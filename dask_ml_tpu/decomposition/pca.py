@@ -59,19 +59,28 @@ def _total_var(Xc, n):
 
 
 @partial(jax.jit, static_argnames=("k", "n_power_iter", "randomized",
-                                   "mesh"))
-def _fit_program(X, w, key, n, *, k, n_power_iter, randomized, mesh):
+                                   "mesh", "sketch_dtype"))
+def _fit_program(X, w, key, n, *, k, n_power_iter, randomized, mesh,
+                 sketch_dtype=None):
     """The whole PCA device fit as ONE program: mean, centering+masking,
     the factorization, sign flip, and total variance. One dispatch instead
     of five — on a high-latency host link, per-op dispatch cost dominates
-    small fits (a CV sweep runs many)."""
+    small fits (a CV sweep runs many).
+
+    ``sketch_dtype`` (static; resolved by the caller from the precision
+    policy, docs/precision.md) sets the randomized range finder's matmul
+    operand dtype: the sketch ``Y = X·Ω`` and power-iteration passes run
+    low precision with f32 accumulation while the CholeskyQR2 repair and
+    small SVD stay f32. ``None`` follows the data dtype; the exact tsqr
+    path upcasts low-precision input itself (ops/linalg.py)."""
     from dask_ml_tpu.ops import linalg
 
     mean = _weighted_mean(X, w)
     Xc = _center_and_mask(X, w, mean)
     if randomized:
         U, S, Vt = linalg._svd_compressed_impl(
-            Xc, key, k=k, n_power_iter=n_power_iter, n_oversamples=10)
+            Xc, key, k=k, n_power_iter=n_power_iter, n_oversamples=10,
+            compute_dtype=sketch_dtype)
     else:
         U, S, Vt = linalg._tsvd_impl(Xc, mesh=mesh)
     U, Vt = linalg.svd_flip(U, Vt)
@@ -171,13 +180,20 @@ class PCA(BaseEstimator, TransformerMixin):
             k_fit = min(-(-n_components // 32) * 32,
                         min(n_samples, n_features))
         key = check_random_state(self.random_state)
+        # the precision policy's sketch dtype, resolved OUTSIDE the jit so
+        # it keys the compile cache as a static argument (docs/precision.md)
+        from dask_ml_tpu.parallel import precision as precision_lib
+
+        sketch_dtype = (precision_lib.resolve().compute_for("sketch")
+                        if randomized else None)
         with profile_phase(logger, "pca-fit-program"):
             # centering + masking + factorization + sign flip + total
             # variance as one dispatch (see _fit_program)
             mean, U, S, Vt, tv = _fit_program(
                 data.X, data.weights, key, float(n_samples),
                 k=k_fit, n_power_iter=int(self.iterated_power),
-                randomized=randomized, mesh=mesh)
+                randomized=randomized, mesh=mesh,
+                sketch_dtype=sketch_dtype)
 
         # tsvd on the padded array can return min(n_padded, d) singular
         # values; only min(n_samples, d) are real (padding rows are zeros, so

@@ -30,25 +30,45 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dask_ml_tpu.parallel import precision
+
 __all__ = ["streamed_moments", "pca_fit_blocks"]
 
 
 def _accumulate_block(carry, X_b, w_b):
     """One block's moment update — the single implementation both
-    block-source modes run (traced scan and host-streamed driver)."""
-    sw, s, G = carry
-    Xw = X_b * w_b[:, None]
+    block-source modes run (traced scan and host-streamed driver).
+
+    The carry holds Neumaier compensation terms next to the column-sum and
+    Gram accumulators (``precision.neumaier_add``): the streamed tier may
+    deliver MANY low-precision blocks (bf16 wire policy,
+    docs/precision.md), and a plain f32 running sum over a long block
+    chain drifts like O(n_blocks·eps) — the compensated pair holds the
+    error at O(eps) regardless of block count. Low-precision blocks upcast
+    once on device: accumulation is the accuracy-critical half of the
+    moment pass (the wire bytes were already halved host-side)."""
+    sw, s, cs, G, cG = carry
+    Xf = X_b.astype(jnp.float32)
+    Xw = Xf * w_b[:, None]
     sw = sw + jnp.sum(w_b)
-    s = s + jnp.sum(Xw, axis=0)
-    G = G + jax.lax.dot_general(
-        Xw, X_b, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    return sw, s, G
+    s, cs = precision.neumaier_add(s, cs, jnp.sum(Xw, axis=0))
+    G, cG = precision.neumaier_add(G, cG, jax.lax.dot_general(
+        Xw, Xf, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32))
+    return sw, s, cs, G, cG
 
 
 def _moments_init(d):
-    return (jnp.asarray(0.0, jnp.float32), jnp.zeros((d,), jnp.float32),
-            jnp.zeros((d, d), jnp.float32))
+    return (jnp.asarray(0.0, jnp.float32),
+            jnp.zeros((d,), jnp.float32), jnp.zeros((d,), jnp.float32),
+            jnp.zeros((d, d), jnp.float32), jnp.zeros((d, d), jnp.float32))
+
+
+def _moments_finalize(carry):
+    """Fold the compensation terms in: ``(sw, s, G)`` — the public moment
+    contract is unchanged by the compensated carry."""
+    sw, s, cs, G, cG = carry
+    return sw, s + cs, G + cG
 
 
 @partial(jax.jit, static_argnames=("block_fn", "n_blocks"))
@@ -59,9 +79,9 @@ def _streamed_moments_device(*, block_fn, n_blocks):
 
     shapes = jax.eval_shape(block_fn, jnp.asarray(0, jnp.int32))
     init = _moments_init(shapes[0].shape[1])
-    (sw, s, G), _ = jax.lax.scan(
+    carry, _ = jax.lax.scan(
         body, init, jnp.arange(n_blocks, dtype=jnp.int32))
-    return sw, s, G
+    return _moments_finalize(carry)
 
 
 @partial(jax.jit, static_argnames=("transform",))
@@ -97,7 +117,11 @@ def _streamed_moments_host(source, checkpoint_path=None,
             every=(source.n_blocks if checkpoint_every is None
                    else int(checkpoint_every)),
             bind={"what": "streamed_moments", "n_blocks": source.n_blocks,
-                  "d": int(d)}) as scan_ckpt:
+                  "d": int(d),
+                  # carry layout version: v2 added the Neumaier
+                  # compensation terms — a v1 snapshot must error loudly,
+                  # not resume into a different tree structure
+                  "carry_v": 2}) as scan_ckpt:
         if scan_ckpt is not None:
             snap = scan_ckpt.load()
             if snap is not None:
@@ -108,13 +132,15 @@ def _streamed_moments_host(source, checkpoint_path=None,
                                    start_block=start_block)
     if scan_ckpt is not None:
         scan_ckpt.delete()
-    return carry
+    return _moments_finalize(carry)
 
 
 def streamed_moments(*, block_fn, n_blocks, checkpoint_path=None,
                      checkpoint_every=None):
     """One pass over all blocks → ``(sw, sums, gram)``:
-    Σw, Σ w·x (d,), Σ w·xxᵀ (d, d) — f32 accumulation. ``block_fn`` is a
+    Σw, Σ w·x (d,), Σ w·xxᵀ (d, d) — f32 accumulation, Neumaier-compensated
+    across blocks (low-precision blocks upcast on device; see
+    ``docs/precision.md``). ``block_fn`` is a
     traced callable (one compiled scan) or a
     :class:`~dask_ml_tpu.parallel.stream.HostBlockSource` (double-buffered
     host streaming); both run :func:`_accumulate_block` per block, so the

@@ -334,6 +334,8 @@ class _GLM(BaseEstimator):
                 # surface transfer accounting on the CALLER's source (the
                 # intercept wrap is a stats-reset copy)
                 block_fn.bytes_streamed += wrapped.bytes_streamed
+                block_fn.logical_bytes_streamed += \
+                    wrapped.logical_bytes_streamed
                 block_fn.blocks_started += wrapped.blocks_started
         self.n_iter_ = int(n_iter)
         self._finalize_coef([np.asarray(beta)])

@@ -44,6 +44,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from dask_ml_tpu.parallel import precision as px
 from dask_ml_tpu.parallel.mesh import DATA_AXIS, shard_map
 
 # ---------------------------------------------------------------------------
@@ -144,7 +145,7 @@ def _make_objective(family, regularizer, smooth_penalty: bool):
     pen_value, _ = _penalty(regularizer)
 
     def objective(beta, X, y, w, lam_eff, mask):
-        eta = X @ beta
+        eta = _data_matvec(X, beta)
         f = jnp.sum(w * loss_fn(eta, y))
         if smooth_penalty:
             f = f + lam_eff * pen_value(beta * mask)
@@ -154,15 +155,44 @@ def _make_objective(family, regularizer, smooth_penalty: bool):
 
 
 def _state_dtype(X):
-    """Optimizer-state dtype for data of X's dtype: at least float32.
+    """Optimizer-state dtype for data of X's dtype, routed through the
+    precision layer's single state rule (at least float32 — see
+    :func:`dask_ml_tpu.parallel.precision.state_dtype` for why the rule is
+    a pure function of the data dtype and why low-precision carries are
+    structurally impossible): X may be staged bf16 (the matmuls read it on
+    the MXU and accumulate f32), but the carries (beta, objective values,
+    step sizes, curvature history, ADMM consensus state) stay f32."""
+    return px.state_dtype(X.dtype)
 
-    Mixed precision the TPU way — X may be staged bf16 (the matmuls read it
-    on the MXU and accumulate f32), but the carries (beta, objective values,
-    step sizes, curvature history, ADMM consensus state) stay f32: bf16's 8
-    mantissa bits cannot represent line-search/convergence arithmetic, and
-    ops like linalg.solve promote anyway (which would break while_loop carry
-    typing if state started as bf16)."""
-    return jnp.promote_types(X.dtype, jnp.float32)
+
+def _data_matvec(X, v):
+    """``X @ v`` in X's (possibly low) compute dtype with ≥f32 accumulation
+    — the precision-aware linear predictor every solver shares. For f32
+    data this is the same contraction it replaces; for bf16-staged data the
+    coefficient vector is cast down so the matmul feeds the MXU as bf16
+    while the output (and therefore gradients, objectives, backtracking
+    state) stays f32."""
+    return px.pmatmul(X, v, accum=px.state_dtype(X.dtype))
+
+
+def _data_pullback(X, r):
+    """``X.T @ r`` (the gradient pullback) with the same compute/accum
+    discipline as :func:`_data_matvec`: the f32 residual-like vector ``r``
+    is cast to X's compute dtype, the contraction over the (possibly
+    sharded) sample axis accumulates ≥f32."""
+    return px.pdot(X, r, (((0,), (0,)), ((), ())),
+                   accum=px.state_dtype(X.dtype))
+
+
+def _weighted_gram(X, h):
+    """GLM curvature ``X.T @ diag(h) @ X`` with bf16-aware operands and
+    ≥f32 accumulation — the d×d Hessian build every Newton path shares.
+    ``h`` (f32 per-row curvature weights) is applied first and the product
+    cast back to X's dtype, so on bf16 data both matmul operands are bf16
+    (MXU-native) while the Hessian itself lands f32 for the dense solve."""
+    Xh = (h[:, None] * X).astype(X.dtype)
+    return px.pdot(X, Xh, (((0,), (0,)), ((), ())),
+                   accum=px.state_dtype(X.dtype))
 
 
 # ---------------------------------------------------------------------------
@@ -259,12 +289,12 @@ def newton(X, y, w, beta0, mask, *, family="logistic", regularizer="l2",
 
     def body(state):
         beta, it, _ = state
-        eta = X @ beta
+        eta = _data_matvec(X, beta)
         # value+gradient in ONE data pass (gd/lbfgs do the same); a separate
         # obj(beta) call would add a redundant O(n·d) traversal per iteration
         f0, g = value_and_grad(beta)
         h = w * hess_fn(eta, y)
-        H = (X.T @ (h[:, None] * X)) / sw
+        H = _weighted_gram(X, h) / sw
         # Smooth-l2 curvature for the penalized coords + a tiny ridge so the
         # solve never blows up on collinear features.
         H = H + jnp.diag(lam_eff / sw * mask + 1e-8)
@@ -498,8 +528,9 @@ def _admm_impl(X, y, w, beta0, x0, u0, mask, lamduh, rho, abstol, reltol,
             def grad_eta(xx):
                 # one data pass yields BOTH the gradient and the linear
                 # predictor the Hessian weights need
-                eta = X_loc @ xx
-                g = X_loc.T @ (w_loc * dloss(eta)) / sw + rho * (xx - z + u)
+                eta = _data_matvec(X_loc, xx)
+                g = (_data_pullback(X_loc, w_loc * dloss(eta)) / sw
+                     + rho * (xx - z + u))
                 return g, eta
 
             def nt_cond(s):
@@ -513,7 +544,7 @@ def _admm_impl(X, y, w, beta0, x0, u0, mask, lamduh, rho, abstol, reltol,
                 # iteration makes exactly one gradient pass over the shard
                 xx, g, eta, it = s
                 h = w_loc * hess_fn(eta, y_loc)
-                H = (X_loc.T @ (h[:, None] * X_loc)) / sw
+                H = _weighted_gram(X_loc, h) / sw
                 H = H + rho * jnp.eye(d, dtype=xx.dtype)
                 xx_new = xx - jnp.linalg.solve(H, g)
                 g_new, eta_new = grad_eta(xx_new)
@@ -897,8 +928,9 @@ def _streamed_block_newton(X_b, y_b, w_b, x, z, u, rho, inner_tol, sw_total,
     dloss = jax.grad(lambda e: jnp.sum(loss_fn(e, y_b)))
 
     def grad_eta(xx):
-        eta = X_b @ xx
-        g = X_b.T @ (w_b * dloss(eta)) / sw_total + rho * (xx - z + u)
+        eta = _data_matvec(X_b, xx)
+        g = (_data_pullback(X_b, w_b * dloss(eta)) / sw_total
+             + rho * (xx - z + u))
         return g, eta
 
     def nt_cond(s):
@@ -909,7 +941,7 @@ def _streamed_block_newton(X_b, y_b, w_b, x, z, u, rho, inner_tol, sw_total,
     def nt_body(s):
         xx, g, eta, it = s
         h = w_b * hess_fn(eta, y_b)
-        H = (X_b.T @ (h[:, None] * X_b)) / sw_total
+        H = _weighted_gram(X_b, h) / sw_total
         H = H + rho * jnp.eye(d, dtype=xx.dtype)
         xx_new = xx - jnp.linalg.solve(H, g)
         g_new, eta_new = grad_eta(xx_new)
@@ -1111,6 +1143,13 @@ def admm_streamed(block_fn, n_blocks, d, sw_total, mask=None, *,
     ``(z, n_iter, (z, x, u), done)`` — the same checkpointable carry
     contract as :func:`admm`, with x/u stacked ``(n_blocks, d)``.
 
+    ``dtype`` names the BLOCK (data) dtype only. The consensus state
+    (z, x, u), scalars, and mask always live in
+    ``precision.state_dtype(dtype)`` — at least f32 — so streaming bf16
+    blocks (the wire-halving policy, docs/precision.md) still carries
+    full-precision solver state; passing ``dtype=bfloat16`` no longer
+    silently runs the consensus arithmetic in bf16.
+
     Preemption safety (host-source mode only): ``checkpoint_path`` makes
     the fit resumable — every ``checkpoint_every`` completed blocks
     (default: once per outer iteration) the scan state snapshots through
@@ -1125,12 +1164,19 @@ def admm_streamed(block_fn, n_blocks, d, sw_total, mask=None, *,
     ``solve_checkpointed`` pattern).
     """
     from dask_ml_tpu.parallel.stream import HostBlockSource
+
+    # ``dtype`` names the BLOCK/data dtype; the consensus state, scalars,
+    # and mask live in the precision layer's state dtype — at least f32 —
+    # so a bf16-storage run never silently carries bf16 solver state (the
+    # case the pre-policy code hit when a caller passed dtype=bfloat16:
+    # z/x/u would round every consensus update to 8 mantissa bits).
+    sdt = px.state_dtype(dtype)
     if state is None:
-        z0 = jnp.zeros((d,), dtype)
-        x0 = jnp.zeros((n_blocks, d), dtype)
-        u0 = jnp.zeros((n_blocks, d), dtype)
+        z0 = jnp.zeros((d,), sdt)
+        x0 = jnp.zeros((n_blocks, d), sdt)
+        u0 = jnp.zeros((n_blocks, d), sdt)
     else:
-        z0, x0, u0 = (jnp.asarray(s, dtype) for s in state)
+        z0, x0, u0 = (jnp.asarray(s, sdt) for s in state)
         if x0.shape != (n_blocks, d) or u0.shape != (n_blocks, d):
             raise ValueError(
                 f"streamed ADMM state has x/u of shapes {x0.shape}/"
@@ -1138,9 +1184,9 @@ def admm_streamed(block_fn, n_blocks, d, sw_total, mask=None, *,
                 "solver, consensus state cannot move between runs with "
                 "different block counts")
     if mask is None:
-        mask = jnp.ones((d,), dtype)
-    scalars = [jnp.asarray(v, dtype) for v in (lamduh, rho, abstol, reltol,
-                                               inner_tol, sw_total)]
+        mask = jnp.ones((d,), sdt)
+    scalars = [jnp.asarray(v, sdt) for v in (lamduh, rho, abstol, reltol,
+                                             inner_tol, sw_total)]
     if isinstance(block_fn, HostBlockSource):
         if block_fn.n_blocks != int(n_blocks):
             raise ValueError(
@@ -1164,7 +1210,7 @@ def admm_streamed(block_fn, n_blocks, d, sw_total, mask=None, *,
                                       float(inner_tol), float(sw_total),
                                       int(inner_max_iter)))}) as scan_ckpt:
             z, n_iter, x, u, done = _admm_streamed_host(
-                block_fn, z0, x0, u0, jnp.asarray(mask, dtype), lam_d,
+                block_fn, z0, x0, u0, jnp.asarray(mask, sdt), lam_d,
                 rho_d, abstol_d, reltol_d, tol_d, sw_d,
                 check_done=(float(abstol) != 0.0 or float(reltol) != 0.0),
                 family=family, regularizer=regularizer,
@@ -1179,7 +1225,7 @@ def admm_streamed(block_fn, n_blocks, d, sw_total, mask=None, *,
                 "preemption-safe chunking goes through state=/return_state "
                 "instead (see checkpoint.solve_checkpointed)")
         z, n_iter, x, u, done = _admm_streamed_impl(
-            z0, x0, u0, jnp.asarray(mask, dtype), *scalars,
+            z0, x0, u0, jnp.asarray(mask, sdt), *scalars,
             block_fn=block_fn, n_blocks=int(n_blocks), family=family,
             regularizer=regularizer, max_iter=int(max_iter),
             inner_max_iter=int(inner_max_iter))

@@ -134,9 +134,20 @@ def _row_sumsq(X):
 def _scores_ref(X, Y, mask):
     """(n, m) reduction scores ``|y|² − 2·x·y`` with masked rows at +inf —
     the reference the pallas kernel must reproduce (same compute dtype:
-    Y is cast to X's dtype for the MXU, accumulation in f32)."""
+    Y is cast to X's dtype for the MXU, accumulation in f32).
+
+    Precision audit (docs/precision.md): the ``|y|²`` term comes from the
+    ORIGINAL Y in f32, not from the compute-dtype copy ``Yc``. The score
+    is a difference of two O(|y|²) terms, so an error in the norm lands
+    directly on the (possibly tiny) distance gap: with bf16 X, rounding Y
+    to bf16 BEFORE squaring perturbs ``|y|²`` by up to ~0.8% — enough to
+    flip an argmin between near-duplicate centers whose separation is
+    below bf16 resolution (pinned by
+    ``tests/test_precision.py::test_fused_bf16_near_duplicate_centers``).
+    The ``−2x·y`` term keeps the compute-dtype operands (that is the MXU
+    path being bought), always accumulating f32."""
     Yc = Y.astype(X.dtype)
-    y2 = jnp.sum(Yc.astype(jnp.float32) ** 2, axis=1)  # (m,)
+    y2 = jnp.sum(Y.astype(jnp.float32) ** 2, axis=1)  # (m,) from ORIGINAL Y
     prod = jax.lax.dot_general(
         X, Yc, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)  # (n, m)
@@ -200,7 +211,7 @@ def _fused_pallas(X, Y, maskf, w2d, epilogue: str):
     grid = (n + blk - 1) // blk
     interpret = jax.default_backend() != "tpu"
 
-    def kernel(y_ref, mask_ref, x_ref, *rest):
+    def kernel(y_ref, y2_ref, mask_ref, x_ref, *rest):
         if epilogue == "argmin_weight":
             w_ref, am_ref, cw_ref, acc_cw = rest
         elif epilogue == "argmin_min":
@@ -219,8 +230,10 @@ def _fused_pallas(X, Y, maskf, w2d, epilogue: str):
             jax.lax.broadcasted_iota(jnp.int32, (blk, 1), 0) + i * blk < n,
             x_ref[:], 0)  # (blk, d)
 
-        y2 = jnp.sum(Yb.astype(jnp.float32) ** 2, axis=1,
-                     keepdims=True)  # (m, 1)
+        # |y|² arrives precomputed in f32 from the ORIGINAL Y (same
+        # convention as _scores_ref — see its precision-audit note), so a
+        # bf16 compute dtype never degrades the norm term of the score
+        y2 = y2_ref[:]  # (m, 1) f32
         prod = jax.lax.dot_general(
             Yb, Xb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # (m, blk) on the MXU
@@ -263,19 +276,22 @@ def _fused_pallas(X, Y, maskf, w2d, epilogue: str):
             jnp.min(scores, axis=0, keepdims=True) + x2, 0.0)
 
     y_spec = pl.BlockSpec((m, d), lambda i: (0, 0), memory_space=pltpu.VMEM)
-    mask_spec = pl.BlockSpec((m, 1), lambda i: (0, 0),
-                             memory_space=pltpu.VMEM)
+    col_spec = pl.BlockSpec((m, 1), lambda i: (0, 0),
+                            memory_space=pltpu.VMEM)
     x_spec = pl.BlockSpec((blk, d), lambda i: (i, 0),
                           memory_space=pltpu.VMEM)
     row_spec = pl.BlockSpec((1, blk), lambda i: (0, i),
                             memory_space=pltpu.VMEM)
 
     Yc = Y.astype(X.dtype)
+    # f32 norms of the ORIGINAL Y — the kernel's one full-precision input
+    # (see _scores_ref's precision-audit note)
+    y2f = jnp.sum(Y.astype(jnp.float32) ** 2, axis=1).reshape(m, 1)
     if epilogue == "argmin_weight":
         am, cw = pl.pallas_call(
             kernel,
             grid=(grid,),
-            in_specs=[y_spec, mask_spec, x_spec, row_spec],
+            in_specs=[y_spec, col_spec, col_spec, x_spec, row_spec],
             out_specs=[
                 row_spec,
                 pl.BlockSpec((m, 1), lambda i: (0, 0),
@@ -287,29 +303,29 @@ def _fused_pallas(X, Y, maskf, w2d, epilogue: str):
             ],
             scratch_shapes=[pltpu.VMEM((m, 1), jnp.float32)],
             interpret=interpret,
-        )(Yc, maskf, X, w2d)
+        )(Yc, y2f, maskf, X, w2d)
         return am[0], cw[:, 0]
     if epilogue == "argmin_min":
         am, mn = pl.pallas_call(
             kernel,
             grid=(grid,),
-            in_specs=[y_spec, mask_spec, x_spec],
+            in_specs=[y_spec, col_spec, col_spec, x_spec],
             out_specs=[row_spec, row_spec],
             out_shape=[
                 jax.ShapeDtypeStruct((1, n), jnp.int32),
                 jax.ShapeDtypeStruct((1, n), jnp.float32),
             ],
             interpret=interpret,
-        )(Yc, maskf, X)
+        )(Yc, y2f, maskf, X)
         return am[0], mn[0]
     mn = pl.pallas_call(
         kernel,
         grid=(grid,),
-        in_specs=[y_spec, mask_spec, x_spec],
+        in_specs=[y_spec, col_spec, col_spec, x_spec],
         out_specs=row_spec,
         out_shape=jax.ShapeDtypeStruct((1, n), jnp.float32),
         interpret=interpret,
-    )(Yc, maskf, X)
+    )(Yc, y2f, maskf, X)
     return mn[0]
 
 

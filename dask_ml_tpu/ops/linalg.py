@@ -42,6 +42,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from dask_ml_tpu.parallel import mesh as mesh_lib
+from dask_ml_tpu.parallel import precision as px
 from dask_ml_tpu.parallel.mesh import DATA_AXIS
 
 
@@ -114,6 +115,13 @@ def _tsqr_impl(X, *, mesh):
     """
     n_shards = mesh.shape[DATA_AXIS]
     n, d = X.shape
+    # the exact factorization stays ≥ f32 (docs/precision.md): a bf16 Gram
+    # would square bf16's 8-bit mantissa loss into the factor, and the
+    # orthogonality guard below is calibrated for f32 — low-precision
+    # inputs upcast once here (a static dtype decision, part of the jit
+    # signature). The mixed-precision win for the randomized path is the
+    # SKETCH, not the repair — see _svd_compressed_impl.
+    X = X.astype(px.state_dtype(X.dtype))
     if n // n_shards < d:
         # short shards: Householder handles the k1 = n_loc < d shapes
         return _tsqr_householder_impl(X, mesh=mesh)
@@ -209,36 +217,65 @@ def _cholesky_qr2(Y):
     return Q2, R2 @ R1
 
 
-@partial(jax.jit, static_argnames=("k", "n_power_iter", "n_oversamples"))
-def _svd_compressed_impl(X, key, *, k, n_power_iter, n_oversamples):
+@partial(jax.jit, static_argnames=("k", "n_power_iter", "n_oversamples",
+                                   "compute_dtype"))
+def _svd_compressed_impl(X, key, *, k, n_power_iter, n_oversamples,
+                         compute_dtype=None):
     # mesh-free since the CholeskyQR2 swap: every op is a plain matmul /
-    # replicated small factorization whose sharding GSPMD infers from X
+    # replicated small factorization whose sharding GSPMD infers from X.
+    #
+    # Mixed precision (docs/precision.md): ``compute_dtype`` sets the
+    # operand dtype of every X-touching matmul — the sketch Y = X·Ω, the
+    # power-iteration passes, and the B = Qᵀ·X projection — all of which
+    # accumulate f32 (``px.pdot``). This is the Halko/Martinsson/Tropp
+    # structure that makes a low-precision sketch provably safe: the
+    # range finder only needs Y to SPAN the dominant subspace (rounding Ω
+    # and X to bf16 is one more random perturbation of a random test
+    # matrix), while the CholeskyQR2 repair, the small QR/SVD, and the
+    # final compositions stay f32 — exactly the split the ISSUE names.
     d = X.shape[1]
     ell = min(k + n_oversamples, d)
-    omega = jax.random.normal(key, (d, ell), X.dtype)
-    # Range finder: Y = X·Ω is a sharded (n, ell) matmul on the MXU.
-    Y = X @ omega
+    cd = compute_dtype if compute_dtype is not None else X.dtype
+    Xc = X.astype(cd)
+    sdt = px.state_dtype(X.dtype)
+    omega = jax.random.normal(key, (d, ell), cd)
+    # Range finder: Y = X·Ω is a sharded (n, ell) matmul on the MXU —
+    # low-precision operands, f32 accumulation, f32 result for the repair.
+    Y = px.pmatmul(Xc, omega, accum=sdt)
     Q, _ = _cholesky_qr2(Y)
     for _ in range(n_power_iter):
         # QR-stabilized power iteration (the da.linalg.svd_compressed
         # ``n_power_iter`` loop). Z = Xᵀ·Q contracts the sharded axis → psum.
-        Z = X.T @ Q  # (d, ell) replicated
+        Z = px.pdot(Xc, Q.astype(cd), (((0,), (0,)), ((), ())),
+                    accum=sdt)  # (d, ell) replicated
         W, _ = jnp.linalg.qr(Z, mode="reduced")
-        Q, _ = _cholesky_qr2(X @ W)
-    B = Q.T @ X  # (ell, d) replicated — psum over the sharded contraction
+        Q, _ = _cholesky_qr2(px.pmatmul(Xc, W.astype(cd), accum=sdt))
+    # B = Qᵀ·X, replicated — psum over the sharded contraction; the small
+    # SVD of B stays f32
+    B = px.pdot(Q.astype(cd), Xc, (((0,), (0,)), ((), ())), accum=sdt)
     Ub, S, Vt = jnp.linalg.svd(B, full_matrices=False)
-    U = Q @ Ub  # (n, ell) sharded
+    U = Q @ Ub  # (n, ell) sharded, f32
     return U[:, :k], S[:k], Vt[:k]
 
 
 def svd_compressed(X, k: int, n_power_iter: int = 0, key=None,
                    n_oversamples: int = 10,
-                   mesh: Optional[jax.sharding.Mesh] = None, weights=None):
+                   mesh: Optional[jax.sharding.Mesh] = None, weights=None,
+                   compute_dtype="policy"):
     """Randomized truncated SVD (Halko et al. 2009) — the
     ``da.linalg.svd_compressed`` analogue (used by the reference at
     pca.py:236-241). ``weights`` masks padding rows to exact zeros (the
     ``Xᵀ·Q`` / ``Qᵀ·X`` contractions would otherwise pick up whatever the
-    caller left in the padding rows)."""
+    caller left in the padding rows).
+
+    ``compute_dtype`` is the sketch/matmul operand dtype (the range finder
+    tolerates low precision; the CholeskyQR2 repair and small SVD stay
+    f32). The default ``"policy"`` resolves the active precision policy's
+    ``"sketch"`` op override (then its global compute dtype) at call time
+    — resolved HERE, outside the jit, so the policy lands in the compile
+    key as a static argument; ``None`` follows X's dtype."""
+    if compute_dtype == "policy":
+        compute_dtype = px.resolve().compute_for("sketch")
     del mesh  # accepted for API compat; the CholeskyQR2 impl is mesh-free
     if key is None:
         key = jax.random.key(0)
@@ -246,7 +283,8 @@ def svd_compressed(X, k: int, n_power_iter: int = 0, key=None,
         X = _mask_padding_rows(X, weights)
     return _svd_compressed_impl(X, key, k=int(k),
                                 n_power_iter=int(n_power_iter),
-                                n_oversamples=int(n_oversamples))
+                                n_oversamples=int(n_oversamples),
+                                compute_dtype=compute_dtype)
 
 
 # canonical home is the utils layer (as in the reference, utils.py:18-25);

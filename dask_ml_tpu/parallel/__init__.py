@@ -44,6 +44,14 @@ from dask_ml_tpu.parallel.shapes import (  # noqa: F401
     reset_compile_stats,
     track_compiles,
 )
+from dask_ml_tpu.parallel.precision import (  # noqa: F401
+    BF16,
+    F32,
+    PrecisionPolicy,
+    neumaier_sum,
+    pdot,
+    pmatmul,
+)
 from dask_ml_tpu.parallel.stream import (  # noqa: F401
     HostBlockSource,
     prefetched_scan,

@@ -1,0 +1,322 @@
+"""Mixed-precision execution policy: bf16 wire + compute, f32 accumulation.
+
+PR 1 measured the host→device link as the real bottleneck of the >HBM
+streamed tier, and every solver core in this package is matmul-shaped —
+exactly the workloads where bf16 storage/compute with f32 accumulation
+halves the bytes moved and lands on the MXU's native path (the
+communication-minimizing framing of PAPERS.md's communication-avoiding
+k-means kernels). Before this module the precision story was implicit:
+everything-f32 unless the caller staged bf16 through the ``dtype`` config
+knob, with each consumer improvising its own accumulation discipline. This
+module makes it a policy surface — the same shape a training stack's AMP
+layer takes:
+
+- :class:`PrecisionPolicy` names the three dtypes that matter (``storage``
+  = what arrays weigh on the wire and in HBM, ``compute`` = what matmul
+  operands feed the MXU, ``accum`` = what reductions/solver state
+  accumulate in) plus per-op ``overrides`` (e.g. keep a specific
+  contraction f32 while the rest of the fit runs bf16).
+- The thread-local ``precision`` config knob
+  (:mod:`dask_ml_tpu.config`) selects the active policy: ``"auto"``
+  (default) resolves to :data:`BF16` on TPU and :data:`F32` everywhere
+  else, ``None``/``"f32"`` forces full f32, ``"bf16"`` forces the
+  bf16-wire policy, and an explicit :class:`PrecisionPolicy` customizes.
+- :func:`pdot` / :func:`pmatmul` are the contraction helpers every
+  precision-aware consumer routes through: operands cast to the COMPUTE
+  dtype, ``preferred_element_type`` forced to the accumulation dtype
+  (float32), so a bf16 matmul never accumulates in bf16.
+- :func:`neumaier_add` / :func:`neumaier_sum` provide compensated
+  (Neumaier-variant Kahan) summation for long accumulation chains over
+  low-precision inputs — the streamed moment accumulators
+  (:mod:`dask_ml_tpu.decomposition.streaming`) carry compensation terms so
+  a 40-block Gram/mean pass over bf16 blocks does not drift.
+
+**Where the policy acts — and the compile-cache rule.** The policy is
+resolved at FACADE level (staging in ``prepare_data``, the wire cast in
+:class:`~dask_ml_tpu.parallel.stream.HostBlockSource`, the PCA sketch
+dtype), never inside a jitted trace. Jitted solvers key their compile
+caches on input shapes+dtypes, so everything precision-dependent inside a
+trace must be derivable from the operand dtypes alone: the compute dtype
+follows the data array's dtype (bf16-staged X ⇒ bf16 matmuls), the state
+dtype is :func:`state_dtype` (a pure function of the data dtype — at
+least f32, fixing the silent bf16-optimizer-state case), and the
+accumulation dtype is structurally f32. This is what keeps the PR-4
+compile-once invariant intact: switching the policy mid-process changes
+the STAGED dtype, which is part of the jit signature, so a K-fold search
+under a new policy recompiles each group program exactly once — not per
+fold, and never a stale-cache wrong answer
+(``tests/test_precision.py::test_compile_gate_with_precision_policy``).
+
+Accuracy is gated, not hoped for: every solver family pins a tolerance
+against its f32 baseline (``tests/test_precision.py``; the tolerances are
+tabulated in ``docs/precision.md``) and ``bench.py --precision`` runs the
+f32-vs-bf16 grid — wire bytes, effective GB/s, end-to-end fit time,
+accuracy deltas — committed as ``PRECISION_r01.json``, exiting nonzero if
+any gate fails.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "PrecisionPolicy",
+    "F32",
+    "BF16",
+    "resolve",
+    "state_dtype",
+    "pdot",
+    "pmatmul",
+    "neumaier_add",
+    "neumaier_sum",
+    "cast_wire",
+]
+
+#: dtypes considered "low precision" for the state-dtype floor: optimizer
+#: carries (step sizes, objective values, curvature history, consensus
+#: state) can never live below f32 — 8 mantissa bits cannot represent
+#: line-search/convergence arithmetic, and ops like linalg.solve promote
+#: anyway (which would break while_loop carry typing mid-solve).
+_LOW_PRECISION = (jnp.bfloat16, jnp.float16)
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionPolicy:
+    """The three dtypes of a mixed-precision execution, plus per-op
+    overrides.
+
+    - ``storage`` — the dtype big arrays are staged/streamed in (the WIRE
+      dtype: host→device transfers and HBM residency). ``None`` keeps the
+      input dtype (the f32 status quo).
+    - ``compute`` — the dtype matmul operands are cast to before hitting
+      the MXU. ``None`` follows the data array's dtype (so bf16 storage
+      implies bf16 compute with no further casts).
+    - ``accum`` — the dtype contractions accumulate in and solver state
+      lives in; floored at float32 (see :func:`state_dtype`).
+    - ``overrides`` — ``{op_name: dtype}`` consulted by
+      :meth:`compute_for`: e.g. ``{"sketch": jnp.bfloat16}`` runs only the
+      PCA range-finder sketch in bf16, or ``{"sketch": jnp.float32}``
+      keeps the sketch f32 under an otherwise-bf16 policy.
+
+    Frozen + hashable so a policy can key jit static arguments and
+    staging-memo entries.
+    """
+
+    storage: Any = None
+    compute: Any = None
+    accum: Any = jnp.float32
+    overrides: Any = None
+
+    def __post_init__(self):
+        ov = self.overrides
+        if isinstance(ov, dict):
+            object.__setattr__(self, "overrides",
+                               tuple(sorted(ov.items(), key=lambda kv: kv[0])))
+        elif ov is not None:
+            object.__setattr__(self, "overrides", tuple(ov))
+
+    # -- dtype resolution --------------------------------------------------
+
+    def storage_dtype(self, default=None):
+        """The staging/wire dtype, or ``default`` when the policy keeps
+        input dtypes."""
+        return self.storage if self.storage is not None else default
+
+    def compute_for(self, op: Optional[str] = None):
+        """Compute dtype for ``op`` (overrides first, then the policy-wide
+        ``compute``); ``None`` means "follow the data array's dtype"."""
+        if op is not None and self.overrides:
+            for name, dt in self.overrides:
+                if name == op:
+                    return dt
+        return self.compute
+
+    def state_dtype(self, data_dtype):
+        """Solver-state dtype for data of ``data_dtype`` under this
+        policy: the accumulation dtype, floored at f32 (never a
+        low-precision carry)."""
+        return state_dtype(data_dtype, accum=self.accum)
+
+    def signature(self) -> tuple:
+        """Hashable identity for cache/memo keys."""
+        return ("PrecisionPolicy", str(self.storage), str(self.compute),
+                str(self.accum), self.overrides)
+
+
+#: the f32 status quo: input dtypes kept, f32 accumulation. The null policy
+#: every pre-precision behavior reduces to.
+F32 = PrecisionPolicy()
+
+#: bf16 wire + compute with f32 accumulation — the MXU-native policy
+#: ``"auto"`` resolves to on TPU: staged arrays and streamed blocks move
+#: as bf16 (half the host→device bytes), matmul operands feed the MXU as
+#: bf16, every contraction and all solver state stays f32.
+BF16 = PrecisionPolicy(storage=jnp.bfloat16, compute=jnp.bfloat16)
+
+
+def resolve(knob: Any = "__config__") -> PrecisionPolicy:
+    """The active :class:`PrecisionPolicy`, resolved from the thread-local
+    ``precision`` config knob (:mod:`dask_ml_tpu.config`):
+
+    - ``"auto"`` (the default) → :data:`BF16` on a TPU backend, :data:`F32`
+      everywhere else — low precision only where the MXU makes it native;
+    - ``None`` / ``"f32"`` / ``"float32"`` → :data:`F32`;
+    - ``"bf16"`` / ``"bfloat16"`` → :data:`BF16` on any backend;
+    - a :class:`PrecisionPolicy` → itself.
+
+    Resolution happens at facade level (staging, stream construction,
+    sketch-dtype selection) — never inside a jitted trace; see the module
+    docstring for the compile-cache rule that forces this.
+    """
+    if knob == "__config__":
+        from dask_ml_tpu.config import get_config
+
+        knob = get_config()["precision"]
+    if knob is None:
+        return F32
+    if isinstance(knob, PrecisionPolicy):
+        return knob
+    if knob == "auto":
+        return BF16 if jax.default_backend() == "tpu" else F32
+    if knob in ("bf16", "bfloat16"):
+        return BF16
+    if knob in ("f32", "float32"):
+        return F32
+    raise ValueError(
+        "precision must be 'auto', None, 'f32'/'float32', "
+        f"'bf16'/'bfloat16', or a PrecisionPolicy; got {knob!r}")
+
+
+def state_dtype(data_dtype, accum=jnp.float32):
+    """Optimizer/solver-state dtype for data of ``data_dtype``: at least
+    float32, regardless of how low the data's storage dtype goes.
+
+    This is the ONE definition of the mixed-precision state rule (the GLM
+    solvers' ``_state_dtype`` and the streamed tier's state initialization
+    both route through it): X may be staged bf16 — the matmuls read it on
+    the MXU and accumulate f32 — but the carries (beta, objective values,
+    step sizes, curvature history, ADMM consensus state) stay ≥ f32.
+    Deliberately a pure function of the data dtype, not of the thread-local
+    policy: jitted solvers key their compile caches on input dtypes, so an
+    in-trace thread-local read would go stale when the policy changes
+    without the signature changing (the policy reaches the solvers by
+    choosing the storage dtype the data ARRIVES in). ``accum`` raises the
+    floor (e.g. f64 accumulation for a custom policy) but can never lower
+    it below f32 — passing ``accum=bf16`` still yields an f32 state, which
+    is exactly the silent-bf16-state case this function exists to close.
+    """
+    dt = jnp.dtype(data_dtype)
+    if dt in (jnp.dtype(t) for t in _LOW_PRECISION):
+        dt = jnp.dtype(jnp.float32)
+    floor = jnp.promote_types(dt, jnp.float32)
+    acc = jnp.dtype(accum)
+    if acc in (jnp.dtype(t) for t in _LOW_PRECISION):
+        acc = jnp.dtype(jnp.float32)
+    return jnp.promote_types(floor, acc)
+
+
+# ---------------------------------------------------------------------------
+# precision-aware contractions
+# ---------------------------------------------------------------------------
+
+
+def pdot(a, b, dimension_numbers, *, compute=None, accum=jnp.float32):
+    """``lax.dot_general`` with both operands cast to the COMPUTE dtype and
+    accumulation forced to ``accum`` (f32) via ``preferred_element_type``.
+
+    ``compute=None`` follows the FIRST operand's dtype — by convention the
+    data array (X / a streamed block), whose staged dtype carries the
+    active policy into the trace. A bf16-staged X therefore pulls the
+    second operand (coefficients, test matrices) down to bf16 so the
+    matmul runs on the MXU's native path, while the f32 output keeps
+    gradients/objectives/epilogues in full precision. For f32 data this is
+    bit-identical to the plain ``@`` it replaces (same contraction, same
+    f32 accumulation), so enabling the policy is a no-op until low-
+    precision data actually arrives.
+    """
+    cd = compute if compute is not None else a.dtype
+    return lax.dot_general(a.astype(cd), b.astype(cd), dimension_numbers,
+                           preferred_element_type=accum)
+
+
+def pmatmul(a, b, **kwargs):
+    """``a @ b`` through :func:`pdot`: contract ``a``'s last axis with
+    ``b``'s first (the matmul/matvec shapes the solvers use)."""
+    dn = (((a.ndim - 1,), (0,)), ((), ()))
+    return pdot(a, b, dn, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# compensated summation (Neumaier's improved Kahan)
+# ---------------------------------------------------------------------------
+
+
+def neumaier_add(total, comp, x):
+    """One compensated-summation step: ``(total, comp) += x`` with the
+    rounding error captured in ``comp`` (Neumaier's variant, which unlike
+    plain Kahan stays correct when ``|x| > |total|``). The true running sum
+    is ``total + comp``; add them once at the END of the accumulation
+    chain. Shapes broadcast elementwise, so the same step serves scalars
+    (Σw), vectors (column sums), and matrices (the streamed Gram)."""
+    t = total + x
+    comp = comp + jnp.where(jnp.abs(total) >= jnp.abs(x),
+                            (total - t) + x, (x - t) + total)
+    return t, comp
+
+
+def neumaier_sum(x, axis: int = 0, dtype=jnp.float32):
+    """Compensated sum of ``x`` along ``axis``, accumulated in ``dtype``.
+
+    The utility for moment/inertia accumulation over low-precision inputs:
+    a plain f32 ``sum`` over n terms drifts like O(n·eps) in the worst
+    case, while the compensated sum holds O(eps) — the difference shows up
+    exactly where bf16 inputs meet long accumulation chains (many streamed
+    blocks, large-n inertia totals). Implemented as a ``lax.fori_loop``
+    over the reduced axis (vectorized over all others), so it works inside
+    jitted programs.
+    """
+    x = jnp.moveaxis(jnp.asarray(x), axis, 0).astype(dtype)
+    n = x.shape[0]
+    zero = jnp.zeros(x.shape[1:], dtype)
+
+    def body(i, carry):
+        return neumaier_add(*carry, x[i])
+
+    total, comp = lax.fori_loop(0, n, body, (zero, zero))
+    return total + comp
+
+
+# ---------------------------------------------------------------------------
+# host-side wire casting (the streamed tier's storage cast)
+# ---------------------------------------------------------------------------
+
+
+def cast_wire(block: tuple, storage) -> tuple:
+    """Cast a host block tuple to the wire/storage dtype.
+
+    Only floating arrays with ``ndim >= 2`` (the data matrix) are cast —
+    1-D per-row vectors (labels, sample weights) stay exact: they are a
+    vanishing fraction of the wire bytes, weight exactness is what makes
+    padding rows inert, and {0, 1} labels gain nothing from narrowing.
+    Never upcasts (an f16 input is not widened to bf16's byte width), so
+    ``storage=None`` or an already-narrow block is a no-op returning the
+    same tuple.
+    """
+    if storage is None:
+        return tuple(block)
+    import numpy as np
+
+    st = jnp.dtype(storage)
+    out = []
+    for a in block:
+        a = np.asarray(a)
+        if (a.ndim >= 2 and np.issubdtype(a.dtype, np.floating)
+                and a.dtype.itemsize > st.itemsize):
+            a = a.astype(st)
+        out.append(a)
+    return tuple(out)

@@ -318,9 +318,18 @@ def prepare_data(
     objects return the already-staged ``DeviceData`` (one transfer per
     distinct slice, however many search candidates share it)."""
     from dask_ml_tpu import config as config_lib
+    from dask_ml_tpu.parallel import precision as precision_lib
 
     if dtype is None:
         dtype = config_lib.get_config()["dtype"]
+    if dtype is None:
+        # the mixed-precision policy's storage dtype (docs/precision.md):
+        # "auto" is bf16 on TPU / keep-input elsewhere, so every estimator
+        # fit stages bf16 wire+HBM bytes without touching estimator code.
+        # Resolved HERE (facade level) so the staged dtype — part of every
+        # jit signature downstream — is the only channel the policy takes
+        # into traced code, and the memo key below sees the resolved value.
+        dtype = precision_lib.resolve().storage_dtype()
     mesh = mesh or mesh_lib.default_mesh()
     # EFFECTIVE flag: on a data-only mesh feature sharding is a no-op, so
     # the memo key must not distinguish callers that pass it unconditionally

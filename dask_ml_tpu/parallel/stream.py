@@ -142,12 +142,25 @@ class HostBlockSource:
     :class:`~dask_ml_tpu.parallel.faults.FaultInjector`) deterministically
     injects those failures for tests and the ``bench.py --faults`` drill.
 
+    ``storage_dtype`` is the WIRE dtype (docs/precision.md): each block's
+    floating 2-D+ arrays are cast host-side BEFORE ``device_put``, so a
+    bf16 policy halves the bytes every transfer moves — the host→device
+    link is this tier's measured bottleneck (PR 1), making the wire cast
+    the highest-leverage place low precision can act. 1-D per-row vectors
+    (labels, weights) stay exact. The default ``"policy"`` resolves the
+    active :mod:`~dask_ml_tpu.parallel.precision` policy's storage dtype
+    at construction ("auto" = bf16 on TPU, no cast elsewhere); ``None``
+    disables casting; an explicit dtype forces it.
+
     The source tracks ``bytes_streamed``/``blocks_started`` for effective-
-    bandwidth accounting (``reset_stats()`` between timed runs). The
-    counters increment only when a transfer is successfully issued — a
-    failed-then-retried ``device_put`` counts once — and
-    ``discard_inflight()`` rolls issued-but-never-consumed transfers back
-    out, so the stats always equal the blocks compute actually consumed.
+    bandwidth accounting (``reset_stats()`` between timed runs), plus
+    ``logical_bytes_streamed`` — what the same blocks would have weighed
+    uncast — so the bench can report wire vs logical effective GB/s side
+    by side (their ratio IS the policy's wire win). The counters increment
+    only when a transfer is successfully issued — a failed-then-retried
+    ``device_put`` counts once — and ``discard_inflight()`` rolls
+    issued-but-never-consumed transfers back out, so the stats always
+    equal the blocks compute actually consumed.
     """
 
     def __init__(self, arrays: Optional[Sequence[np.ndarray]] = None,
@@ -156,7 +169,8 @@ class HostBlockSource:
                  transform: Optional[Callable] = None,
                  prefetch: int = 2, device=None,
                  retry_policy=None, fault_injector=None,
-                 pad_tail: Optional[bool] = None):
+                 pad_tail: Optional[bool] = None,
+                 storage_dtype="policy"):
         if (arrays is None) == (loader is None):
             raise ValueError(
                 "pass exactly one of `arrays` (host array tuple) or "
@@ -191,11 +205,17 @@ class HostBlockSource:
                     "program compiled once")
             self._arrays = arrays
             self._rows = -(-n // self.n_blocks)  # ceil: tail block pads
+        from dask_ml_tpu.parallel import precision as precision_lib
+
+        if storage_dtype == "policy":
+            storage_dtype = precision_lib.resolve().storage_dtype()
+        self.storage_dtype = storage_dtype
         self.retry_policy = retry_policy
         self.fault_injector = fault_injector
         self._inflight: dict = {}
         self._inflight_bytes: dict = {}
         self.bytes_streamed = 0
+        self.logical_bytes_streamed = 0
         self.blocks_started = 0
 
     def _may_pad(self, blk) -> bool:
@@ -284,7 +304,7 @@ class HostBlockSource:
         if cached is not None:
             return cached
         structs = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
-                        for a in self.host_block(0))
+                        for a in self._cast_wire(self.host_block(0)))
         if self.transform is not None:
             structs = jax.eval_shape(self.transform, structs)
         self._out_struct = tuple(structs)
@@ -302,6 +322,10 @@ class HostBlockSource:
         if b in self._inflight:
             return
         blk = self.host_block(b)
+        logical = sum(int(a.nbytes) for a in blk)
+        # the wire cast happens HERE, after the (exact) host read and
+        # before the transfer: wire bytes are what actually cross the link
+        blk = self._cast_wire(blk)
 
         def put():
             if self.fault_injector is not None:
@@ -315,9 +339,15 @@ class HostBlockSource:
                                         detail=f"block {b}")
         nbytes = sum(int(a.nbytes) for a in blk)
         self._inflight[b] = dev
-        self._inflight_bytes[b] = nbytes
+        self._inflight_bytes[b] = (nbytes, logical)
         self.bytes_streamed += nbytes
+        self.logical_bytes_streamed += logical
         self.blocks_started += 1
+
+    def _cast_wire(self, blk: tuple) -> tuple:
+        from dask_ml_tpu.parallel import precision as precision_lib
+
+        return precision_lib.cast_wire(blk, self.storage_dtype)
 
     def take(self, b: int) -> tuple:
         """Device arrays for block ``b`` — already in flight when the
@@ -356,9 +386,11 @@ class HostBlockSource:
         ``reset_stats()`` boundary (rollback entry ``None``) were never
         part of the current counters and are dropped without subtracting."""
         for b in list(self._inflight):
-            nbytes = self._inflight_bytes.pop(b, None)
-            if nbytes is not None:
-                self.bytes_streamed -= nbytes
+            entry = self._inflight_bytes.pop(b, None)
+            if entry is not None:
+                wire, logical = entry
+                self.bytes_streamed -= wire
+                self.logical_bytes_streamed -= logical
                 self.blocks_started -= 1
             del self._inflight[b]
 
@@ -371,6 +403,7 @@ class HostBlockSource:
         they double as the deadline budget, which a new timed run does not
         automatically refill."""
         self.bytes_streamed = 0
+        self.logical_bytes_streamed = 0
         self.blocks_started = 0
         self._inflight_bytes = {b: None for b in self._inflight}
 

@@ -56,6 +56,13 @@ SECTIONS = [
      "scale with buckets instead of distinct shapes — plus jax.monitoring "
      "compile counters and the persistent-compilation-cache hook; see "
      "docs/compile.md for the policy and the CI gate."),
+    ("dask_ml_tpu.parallel.precision", "Mixed precision",
+     "The bf16-wire/bf16-compute/f32-accumulation execution policy "
+     "(storage, compute, and accumulation dtypes plus per-op overrides), "
+     "the precision-aware contraction helpers, Neumaier compensated "
+     "summation, and the solver-state f32 floor — see docs/precision.md "
+     "for the policy semantics, the accuracy-gate tolerances, and what "
+     "'auto' picks on each backend."),
     ("dask_ml_tpu.parallel.faults", "Fault tolerance",
      "Retry/backoff for transient host-I/O and device-transfer failures, "
      "preemption-safe checkpoint/drain/resume for the streamed tier, and "
@@ -88,6 +95,10 @@ EXTRA = {
         "PadPolicy", "active_policy", "bucket_rows", "pad_tail",
         "compile_stats", "reset_compile_stats", "track_compiles",
         "enable_persistent_cache",
+    ],
+    "dask_ml_tpu.parallel.precision": [
+        "PrecisionPolicy", "resolve", "state_dtype", "pdot", "pmatmul",
+        "neumaier_add", "neumaier_sum", "cast_wire",
     ],
     "dask_ml_tpu.datasets": ["make_blobs", "make_regression",
                              "make_classification", "make_counts"],

@@ -23,7 +23,8 @@ def _clean_config():
 def test_defaults():
     cfg = get_config()
     assert cfg == {"dtype": None, "mesh": None, "device_outputs": False,
-                   "pad_policy": "auto", "compilation_cache": None}
+                   "pad_policy": "auto", "precision": "auto",
+                   "compilation_cache": None}
 
 
 def test_device_outputs_scopes_transform_results():
@@ -53,8 +54,10 @@ def test_set_config_is_process_wide():
 
 
 def test_unknown_option_rejected():
+    # NB: `precision` graduated to a real knob (docs/precision.md), so the
+    # unknown-option example must be a name that stays invalid
     with pytest.raises(KeyError, match="unknown config option"):
-        set_config(precision="bf16")
+        set_config(presicion="bf16")
     with pytest.raises(KeyError, match="unknown config option"):
         with config_context(nope=1):
             pass

@@ -1,0 +1,510 @@
+"""Mixed-precision execution policy (parallel/precision.py): policy
+resolution, the pdot/pmatmul f32-accumulation contract, Neumaier
+compensated summation, the streamed tier's wire cast (wire vs logical
+bytes), and — the teeth — every solver family's ACCURACY GATE pinned
+against its f32 baseline (docs/precision.md tabulates the tolerances).
+
+Satellites pinned here: the fused-distance |y|² f32-norm audit
+(near-duplicate centers whose bf16 norms would flip an argmin), the
+silent-bf16-solver-state fix, checkpoint/resume dtype+trajectory fidelity
+under a bf16 policy, and the PR-4 compile-once gate's interaction with
+policy switching (dtype is part of the jit signature: a policy switch
+recompiles each group program exactly once, never per fold)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dask_ml_tpu import config
+from dask_ml_tpu.parallel import precision as px
+from dask_ml_tpu.parallel.stream import HostBlockSource
+
+
+# accuracy-gate tolerances vs the f32 baseline (docs/precision.md): a bf16
+# mantissa carries ~3 decimal digits, so relative deltas land around
+# 1e-3..1e-2 on well-conditioned problems; the gates pin the order of
+# magnitude, loudly catching a broken accumulation path (which lands at
+# 1e-1+ or diverges).
+COEF_RTOL = 5e-2       # GLM coefficient vectors, streamed ADMM consensus
+# proximal gradient stops on step size rather than gradient/objective, so
+# bf16 gradient noise perturbs WHERE it stops more than the others — its
+# gate is correspondingly looser
+PROX_COEF_RTOL = 1.5e-1
+VAR_RTOL = 2e-2        # PCA explained-variance / singular values
+INERTIA_RTOL = 1e-2    # KMeans inertia
+ITER_SLACK = 5         # convergence-iteration parity: |n_bf16 - n_f32| <=
+
+
+# ---------------------------------------------------------------------------
+# policy object + resolution
+# ---------------------------------------------------------------------------
+
+
+def test_policy_resolution_knob():
+    # "auto" on the CPU test backend is the f32 null policy
+    assert px.resolve() is px.F32
+    with config.config_context(precision=None):
+        assert px.resolve() is px.F32
+    with config.config_context(precision="bf16"):
+        assert px.resolve() is px.BF16
+    with config.config_context(precision="f32"):
+        assert px.resolve() is px.F32
+    custom = px.PrecisionPolicy(storage=jnp.bfloat16)
+    with config.config_context(precision=custom):
+        assert px.resolve() is custom
+    with config.config_context(precision="bogus"):
+        with pytest.raises(ValueError, match="precision"):
+            px.resolve()
+
+
+def test_policy_overrides_and_hashability():
+    p = px.PrecisionPolicy(compute=jnp.bfloat16,
+                           overrides={"sketch": jnp.float32})
+    assert p.compute_for("sketch") == jnp.float32
+    assert p.compute_for("anything-else") == jnp.bfloat16
+    assert p.compute_for() == jnp.bfloat16
+    hash(p)  # frozen + canonicalized overrides: usable as a jit static
+    assert p == px.PrecisionPolicy(compute=jnp.bfloat16,
+                                   overrides={"sketch": jnp.float32})
+    assert px.BF16.storage_dtype() == jnp.bfloat16
+    assert px.F32.storage_dtype() is None
+    assert px.F32.storage_dtype(jnp.float32) == jnp.float32
+
+
+def test_state_dtype_floor():
+    """The one state rule: never below f32, whatever the data or the
+    requested accumulation dtype — the silent-bf16-state case is
+    structurally impossible."""
+    assert px.state_dtype(jnp.bfloat16) == jnp.float32
+    assert px.state_dtype(jnp.float16) == jnp.float32
+    assert px.state_dtype(jnp.float32) == jnp.float32
+    assert px.state_dtype(jnp.float64) == jnp.float64
+    # an accum override can raise the floor but never lower it
+    assert px.state_dtype(jnp.float32, accum=jnp.float64) == jnp.float64
+    assert px.state_dtype(jnp.bfloat16, accum=jnp.bfloat16) == jnp.float32
+    assert px.PrecisionPolicy().state_dtype(jnp.bfloat16) == jnp.float32
+
+
+def test_pdot_bf16_operands_f32_accumulation():
+    """bf16 operands, f32 result — and the accumulation really happens in
+    f32: a [big, 1, -big] row sums to exactly 1 under f32 accumulation,
+    while bf16 accumulation (spacing 8 at 1024) would lose the 1."""
+    X = jnp.asarray([[1024.0, 1.0, -1024.0]], jnp.bfloat16)
+    v = jnp.ones((3,), jnp.float32)
+    out = px.pmatmul(X, v)
+    assert out.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out), [1.0])
+    # dimension-numbers form: X.T @ r over the row axis
+    r = jnp.ones((1,), jnp.float32)
+    g = px.pdot(X, r, (((0,), (0,)), ((), ())))
+    assert g.dtype == jnp.float32 and g.shape == (3,)
+
+
+def test_neumaier_sum_beats_sequential_f32():
+    """The compensated sum holds the small terms a sequential f32
+    accumulation drops entirely (1e8 absorbs every 0.25)."""
+    v = jnp.asarray([1e8] + [0.25] * 4096, jnp.float32)
+
+    def naive(x):
+        def body(i, acc):
+            return acc + x[i]
+        return jax.lax.fori_loop(0, x.shape[0], body,
+                                 jnp.asarray(0.0, jnp.float32))
+
+    sequential = float(jax.jit(naive)(v))
+    compensated = float(px.neumaier_sum(v))
+    true = 1e8 + 0.25 * 4096
+    assert sequential == 1e8  # every 0.25 lost below f32 resolution at 1e8
+    assert abs(compensated - true) <= 16.0  # within one ulp at 1e8
+    # axis + shape semantics
+    M = jnp.asarray(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_allclose(np.asarray(px.neumaier_sum(M, axis=0)),
+                               np.asarray(M.sum(0)))
+    np.testing.assert_allclose(np.asarray(px.neumaier_sum(M, axis=1)),
+                               np.asarray(M.sum(1)))
+
+
+# ---------------------------------------------------------------------------
+# the streamed tier's wire cast
+# ---------------------------------------------------------------------------
+
+
+def _stream_data(n=512, d=64, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    w_true = np.random.RandomState(3).randn(d).astype(np.float32)
+    y = (X @ w_true + rng.standard_normal(n).astype(np.float32)
+         > 0).astype(np.float32)
+    w = np.ones(n, np.float32)
+    return X, y, w
+
+
+def test_wire_cast_halves_stream_bytes():
+    """Under a bf16 policy the 2-D block arrays cross the wire as bf16
+    (half the bytes); 1-D labels/weights stay exact; wire vs logical
+    stats track both sides, surviving discard/reset bookkeeping."""
+    X, y, w = _stream_data()
+    with config.config_context(precision="bf16"):
+        src = HostBlockSource((X, y, w), n_blocks=4)
+    assert src.storage_dtype == jnp.bfloat16
+    blk = src.take(0)
+    assert blk[0].dtype == jnp.bfloat16
+    assert blk[1].dtype == jnp.float32 and blk[2].dtype == jnp.float32
+    # out_struct advertises the consumer-seen (cast) dtype
+    assert src.out_struct[0].dtype == jnp.bfloat16
+    per_block_wire = X.nbytes // 4 // 2 + y.nbytes // 4 + w.nbytes // 4
+    per_block_logical = (X.nbytes + y.nbytes + w.nbytes) // 4
+    assert src.bytes_streamed == per_block_wire
+    assert src.logical_bytes_streamed == per_block_logical
+    # host_block stays the exact host view (the cast happens at transfer)
+    assert src.host_block(1)[0].dtype == np.float32
+    # discard rolls BOTH counters back out
+    src.start(1)
+    src.discard_inflight()
+    assert src.bytes_streamed == per_block_wire
+    assert src.logical_bytes_streamed == per_block_logical
+    src.reset_stats()
+    assert src.bytes_streamed == 0 and src.logical_bytes_streamed == 0
+    # no policy → no cast, wire == logical (the f32 status quo)
+    src32 = HostBlockSource((X, y, w), n_blocks=4, storage_dtype=None)
+    src32.take(0)
+    assert src32.bytes_streamed == src32.logical_bytes_streamed
+
+
+def test_wire_cast_never_upcasts():
+    X = np.random.RandomState(0).standard_normal((8, 4)).astype(np.float16)
+    out = px.cast_wire((X,), jnp.bfloat16)
+    assert out[0].dtype == np.float16  # narrower than the wire dtype: kept
+
+
+# ---------------------------------------------------------------------------
+# streamed ADMM: wire reduction + accuracy gate + state-dtype fix
+# ---------------------------------------------------------------------------
+
+ADMM_KW = dict(family="logistic", regularizer="l2", lamduh=1.0,
+               max_iter=4, abstol=0.0, reltol=0.0)
+
+
+def test_streamed_admm_bf16_gate():
+    """The tier the policy was built for: bf16 blocks halve the wire
+    (>= 1.8x at d=64), the consensus state stays f32, and the result lands
+    within the coefficient gate of the f32 baseline — with identical
+    iteration count (fixed-iteration run) and convergence behavior."""
+    from dask_ml_tpu.models import glm as glm_core
+
+    X, y, w = _stream_data()
+    n, d = X.shape
+    src32 = HostBlockSource((X, y, w), n_blocks=4, storage_dtype=None)
+    z32, it32 = glm_core.admm_streamed(src32, 4, d, float(n), **ADMM_KW)
+    with config.config_context(precision="bf16"):
+        src16 = HostBlockSource((X, y, w), n_blocks=4)
+    z16, it16, (zs, xs, us), _ = glm_core.admm_streamed(
+        src16, 4, d, float(n), return_state=True, **ADMM_KW)
+    assert src16.bytes_streamed < src32.bytes_streamed
+    wire_reduction = src16.logical_bytes_streamed / src16.bytes_streamed
+    assert wire_reduction >= 1.8, wire_reduction
+    for a in (z16, zs, xs, us):
+        assert a.dtype == jnp.float32  # bf16 blocks, f32 consensus state
+    rel = (np.linalg.norm(np.asarray(z16) - np.asarray(z32))
+           / max(np.linalg.norm(np.asarray(z32)), 1e-12))
+    assert rel <= COEF_RTOL, rel
+    assert abs(int(it16) - int(it32)) <= ITER_SLACK
+
+
+def test_streamed_admm_dtype_param_state_floor():
+    """The silent-bf16-state fix: passing dtype=bfloat16 (the block dtype)
+    no longer puts the consensus carry itself in bf16."""
+    from dask_ml_tpu.models import glm as glm_core
+
+    X, y, w = _stream_data(n=256, d=8)
+    src = HostBlockSource((X.astype(np.dtype(jnp.bfloat16)), y, w),
+                          n_blocks=4, storage_dtype=None)
+    z, _, (zs, xs, us), _ = glm_core.admm_streamed(
+        src, 4, 8, 256.0, dtype=jnp.bfloat16, return_state=True, **ADMM_KW)
+    for a in (z, zs, xs, us):
+        assert a.dtype == jnp.float32
+
+
+def test_scan_checkpoint_bf16_resume_bit_identical(tmp_path):
+    """Checkpoint/resume interplay under a bf16 policy: a ScanCheckpoint
+    snapshot taken mid-run restores with identical dtypes and the resumed
+    fit reproduces the uninterrupted (z, x, u) BIT-identically."""
+    from dask_ml_tpu.models import glm as glm_core
+    from dask_ml_tpu.parallel.faults import FaultInjector, Preempted
+
+    X, y, w = _stream_data()
+    n, d = X.shape
+    ckpt = str(tmp_path / "bf16.ckpt")
+    with config.config_context(precision="bf16"):
+        clean_src = HostBlockSource((X, y, w), n_blocks=4)
+        _, _, clean, _ = glm_core.admm_streamed(
+            clean_src, 4, d, float(n), return_state=True, **ADMM_KW)
+        inj = FaultInjector().preempt_at(block=2, epoch=2)
+        with pytest.raises(Preempted):
+            glm_core.admm_streamed(
+                HostBlockSource((X, y, w), n_blocks=4, fault_injector=inj),
+                4, d, float(n), checkpoint_path=ckpt, **ADMM_KW)
+        _, _, resumed, _ = glm_core.admm_streamed(
+            HostBlockSource((X, y, w), n_blocks=4), 4, d, float(n),
+            checkpoint_path=ckpt, return_state=True, **ADMM_KW)
+    for a, b in zip(clean, resumed):
+        assert a.dtype == b.dtype == jnp.float32
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# per-solver accuracy gates (bf16-staged data vs the f32 baseline)
+# ---------------------------------------------------------------------------
+
+
+def _glm_problem(n=512, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    w_true = np.random.RandomState(1).randn(d).astype(np.float32)
+    y = (X @ w_true + 0.5 * rng.standard_normal(n).astype(np.float32)
+         > 0).astype(np.float32)
+    return X, y
+
+
+@pytest.mark.parametrize("solver", ["lbfgs", "newton", "gradient_descent",
+                                    "proximal_grad"])
+def test_glm_solver_bf16_accuracy_gate(solver):
+    from dask_ml_tpu.models import glm as glm_core
+
+    X, y = _glm_problem()
+    d = X.shape[1]
+    w = jnp.ones((X.shape[0],), jnp.float32)
+    beta0 = jnp.zeros((d,), jnp.float32)
+    mask = jnp.ones((d,), jnp.float32)
+    kw = dict(family="logistic", regularizer="l2", lamduh=1.0, max_iter=100)
+    if solver == "proximal_grad":
+        # ISTA stops on step size, which bf16 gradient noise perturbs far
+        # more than the gradient/objective criteria — gate it at a FIXED
+        # iteration budget so the comparison tests the arithmetic, not
+        # where the step-size heuristic happens to trip
+        kw.update(tol=0.0, max_iter=50)
+    fn = {"lbfgs": glm_core.lbfgs, "newton": glm_core.newton,
+          "gradient_descent": glm_core.gradient_descent,
+          "proximal_grad": glm_core.proximal_grad}[solver]
+    b32, it32 = fn(jnp.asarray(X), jnp.asarray(y), w, beta0, mask, **kw)
+    b16, it16 = fn(jnp.asarray(X, jnp.bfloat16), jnp.asarray(y), w, beta0,
+                   mask, **kw)
+    assert b16.dtype == jnp.float32  # state floor holds on bf16 data
+    rel = (np.linalg.norm(np.asarray(b16) - np.asarray(b32))
+           / max(np.linalg.norm(np.asarray(b32)), 1e-12))
+    tol = PROX_COEF_RTOL if solver == "proximal_grad" else COEF_RTOL
+    assert rel <= tol, (solver, rel)
+    assert abs(int(it16) - int(it32)) <= ITER_SLACK, (solver, it16, it32)
+
+
+def test_glm_admm_bf16_accuracy_gate(mesh8):
+    from dask_ml_tpu.models import glm as glm_core
+    from dask_ml_tpu.parallel.sharding import prepare_data
+
+    X, y = _glm_problem()
+    d = X.shape[1]
+    beta0 = jnp.zeros((d,), jnp.float32)
+    mask = jnp.ones((d,), jnp.float32)
+    kw = dict(family="logistic", regularizer="l2", lamduh=1.0, max_iter=20,
+              abstol=0.0, reltol=0.0)
+    outs = {}
+    for name, dt in (("f32", None), ("bf16", jnp.bfloat16)):
+        data = prepare_data(X, y=y, mesh=mesh8, dtype=dt,
+                            y_dtype=jnp.float32)
+        z, it = glm_core.admm(data.X, data.y, data.weights, beta0, mask,
+                              mesh8, **kw)
+        assert z.dtype == jnp.float32
+        outs[name] = (np.asarray(z), int(it))
+    rel = (np.linalg.norm(outs["bf16"][0] - outs["f32"][0])
+           / max(np.linalg.norm(outs["f32"][0]), 1e-12))
+    assert rel <= COEF_RTOL, rel
+    assert abs(outs["bf16"][1] - outs["f32"][1]) <= ITER_SLACK
+
+
+def test_kmeans_bf16_accuracy_gate():
+    """Well-separated blobs under a bf16 policy: inertia within the gate,
+    iteration parity, and near-total label agreement. Exact label equality
+    is NOT the contract — when random init seeds two centers in one blob,
+    both runs converge to the same split-cluster optimum whose internal
+    boundary a bf16 rounding can legitimately move by a few points."""
+    from dask_ml_tpu.cluster import KMeans
+
+    rng = np.random.RandomState(0)
+    centers = np.array([[8.0, 0, 0], [-8, 8, 0], [0, -8, 8]], np.float32)
+    X = np.concatenate([
+        c + rng.standard_normal((120, 3)).astype(np.float32)
+        for c in centers])
+    a = KMeans(n_clusters=3, init="random", random_state=0, max_iter=50).fit(X)
+    with config.config_context(precision="bf16"):
+        b = KMeans(n_clusters=3, init="random", random_state=0,
+                   max_iter=50).fit(X)
+    assert b.cluster_centers_.dtype == np.float32
+    agreement = float(np.mean(a.labels_ == b.labels_))
+    assert agreement >= 0.98, agreement
+    rel = abs(float(a.inertia_) - float(b.inertia_)) / float(a.inertia_)
+    assert rel <= INERTIA_RTOL, rel
+    assert abs(int(a.n_iter_) - int(b.n_iter_)) <= ITER_SLACK
+
+
+def test_pca_bf16_sketch_accuracy_gate(mesh8):
+    """The Halko range finder tolerates a low-precision sketch: bf16
+    sketch + f32 CholeskyQR2 repair lands the explained variance within
+    the gate of the all-f32 run."""
+    from dask_ml_tpu.ops import linalg
+    from dask_ml_tpu.parallel.sharding import prepare_data
+
+    rng = np.random.RandomState(0)
+    A = rng.standard_normal((1024, 8)).astype(np.float32)
+    B = rng.standard_normal((8, 32)).astype(np.float32)
+    X = A @ B + 0.05 * rng.standard_normal((1024, 32)).astype(np.float32)
+    data = prepare_data(X, mesh=mesh8)
+    _, S32, _ = linalg.svd_compressed(data.X, 6, n_power_iter=2,
+                                      weights=data.weights,
+                                      compute_dtype=None)
+    _, S16, _ = linalg.svd_compressed(data.X, 6, n_power_iter=2,
+                                      weights=data.weights,
+                                      compute_dtype=jnp.bfloat16)
+    assert S16.dtype == jnp.float32  # the repair/small-SVD stayed f32
+    np.testing.assert_allclose(np.asarray(S16), np.asarray(S32),
+                               rtol=VAR_RTOL)
+
+
+def test_pca_estimator_bf16_policy_gate():
+    from dask_ml_tpu.decomposition import PCA
+
+    rng = np.random.RandomState(0)
+    A = rng.standard_normal((2048, 6)).astype(np.float32)
+    B = rng.standard_normal((6, 24)).astype(np.float32)
+    X = A @ B + 0.05 * rng.standard_normal((2048, 24)).astype(np.float32)
+    a = PCA(n_components=4, svd_solver="randomized", iterated_power=2,
+            random_state=0).fit(X)
+    with config.config_context(precision="bf16"):
+        b = PCA(n_components=4, svd_solver="randomized", iterated_power=2,
+                random_state=0).fit(X)
+    np.testing.assert_allclose(b.explained_variance_ratio_,
+                               a.explained_variance_ratio_, atol=VAR_RTOL)
+
+
+def test_streamed_moments_bf16_gate():
+    """bf16 blocks through the compensated moment pass: mean/Gram within
+    bf16 input-rounding tolerance of the f32 moments."""
+    from dask_ml_tpu.decomposition.streaming import streamed_moments
+
+    rng = np.random.RandomState(0)
+    X = rng.standard_normal((1024, 16)).astype(np.float32) + 1.0
+    w = np.ones(1024, np.float32)
+    sw32, s32, G32 = streamed_moments(
+        block_fn=HostBlockSource((X, w), 8, storage_dtype=None), n_blocks=8)
+    with config.config_context(precision="bf16"):
+        src = HostBlockSource((X, w), 8)
+    sw16, s16, G16 = streamed_moments(block_fn=src, n_blocks=8)
+    assert float(sw16) == float(sw32)
+    np.testing.assert_allclose(np.asarray(s16), np.asarray(s32),
+                               rtol=2e-2, atol=2e-1)
+    np.testing.assert_allclose(np.asarray(G16), np.asarray(G32),
+                               rtol=2e-2, atol=2.0)
+
+
+# ---------------------------------------------------------------------------
+# fused-distance |y|² audit (satellite): near-duplicate centers
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kernel", ["xla", "pallas"])
+def test_fused_bf16_near_duplicate_centers(kernel):
+    """Two centers separated by LESS than bf16 resolution: the compute-
+    dtype copy of Y collapses them (identical bf16 rows, identical −2x·y),
+    so only the f32 |y|² term — computed from the ORIGINAL Y — can break
+    the tie toward the true nearest center. The pre-audit code computed
+    the norm from the bf16 copy and returned the wrong argmin here."""
+    from dask_ml_tpu.ops.fused_distance import fused_argmin_min
+
+    d = 8
+    base = np.zeros(d, np.float32)
+    base[0] = 8.0                      # bf16-exact
+    plus = base.copy()
+    plus[0] = 8.01                     # rounds to 8.0 in bf16 (spacing 1/16)
+    assert float(jnp.asarray(plus[0], jnp.bfloat16)) == 8.0
+    Y = jnp.asarray(np.stack([plus, base]))        # true nearest of x: row 1
+    X = jnp.asarray(np.tile(base, (16, 1)), jnp.bfloat16)  # x == base exactly
+    idx, mind = fused_argmin_min(X, Y, kernel=kernel)
+    np.testing.assert_array_equal(np.asarray(idx), np.ones(16, np.int32))
+    # and the min value reflects the exact-match center (clamped at >= 0)
+    assert float(np.max(np.asarray(mind))) <= 1e-2
+
+
+def test_fused_bf16_pallas_matches_reference_bitwise():
+    """The pallas kernel and the jnp reference share the f32-norm score
+    convention bit-for-bit on bf16 inputs (integer-valued, so the
+    arithmetic is exact)."""
+    from dask_ml_tpu.ops.fused_distance import (_argmin_min_ref,
+                                                fused_argmin_min)
+
+    rng = np.random.RandomState(0)
+    X = jnp.asarray(rng.randint(0, 8, size=(64, 8)), jnp.bfloat16)
+    Y = jnp.asarray(rng.randint(0, 8, size=(5, 8)).astype(np.float32))
+    ir, mr = _argmin_min_ref(X, Y, None)
+    ip, mp = fused_argmin_min(X, Y, kernel="pallas")
+    np.testing.assert_array_equal(np.asarray(ir), np.asarray(ip))
+    np.testing.assert_array_equal(np.asarray(mr), np.asarray(mp))
+
+
+# ---------------------------------------------------------------------------
+# staging + compile-gate interaction
+# ---------------------------------------------------------------------------
+
+
+def test_prepare_data_stages_policy_storage(mesh8):
+    from dask_ml_tpu.parallel.sharding import prepare_data
+
+    X = np.random.RandomState(0).standard_normal((64, 4)).astype(np.float32)
+    y = np.zeros(64, np.float32)
+    with config.config_context(precision="bf16"):
+        data = prepare_data(X, y=y, mesh=mesh8, y_dtype=jnp.float32)
+        assert data.X.dtype == jnp.bfloat16
+        assert data.y.dtype == jnp.float32     # labels stay exact
+        assert data.weights.dtype == jnp.float32
+        # the explicit dtype knob outranks the policy's storage dtype
+        with config.config_context(dtype=jnp.float32):
+            assert prepare_data(X, mesh=mesh8).X.dtype == jnp.float32
+    assert prepare_data(X, mesh=mesh8).X.dtype == jnp.float32
+
+
+def test_compile_gate_with_precision_policy(mesh8):
+    """PR-4 interaction (satellite): with a precision policy active the
+    bucketed K-fold search still compiles its batched group program ONCE
+    (the staged dtype is part of the signature, folds share a bucket), and
+    switching the policy mid-process costs exactly one recompile — not one
+    per fold — while a repeat search under the new policy adds zero."""
+    from dask_ml_tpu.cluster import KMeans
+    from dask_ml_tpu.model_selection import GridSearchCV
+    from dask_ml_tpu.models import kmeans as km_core
+
+    grid = {"n_clusters": [2, 3], "tol": [1e-4, 1e-2]}
+
+    def search(n, seed):
+        rng = np.random.RandomState(seed)
+        X = (rng.randn(n, 12) @ np.diag(np.linspace(2, 0.5, 12))).astype(
+            np.float32)
+        return GridSearchCV(
+            KMeans(init="random", max_iter=8, random_state=0), grid,
+            cv=3, refit=False, n_jobs=1).fit(X)
+
+    search(400, seed=0)  # f32 warm-up: the f32-signature program exists
+    before = km_core._batched_cells_impl._cache_size()
+    with config.config_context(precision="bf16"):
+        gs = search(400, seed=0)  # folds: train 266/267/267 — one bucket
+        assert gs.n_batched_cells_ == 12
+        # the policy switch recompiled the batched program EXACTLY once
+        assert km_core._batched_cells_impl._cache_size() - before == 1
+        # second bf16 search in the same buckets: zero new group programs
+        before2 = km_core._batched_cells_impl._cache_size()
+        gs2 = search(398, seed=7)
+        assert gs2.shape_buckets_ == gs.shape_buckets_
+        assert km_core._batched_cells_impl._cache_size() - before2 == 0
+    # back to f32: the original program is still cached — zero new
+    before3 = km_core._batched_cells_impl._cache_size()
+    search(400, seed=0)
+    assert km_core._batched_cells_impl._cache_size() - before3 == 0
