@@ -1530,6 +1530,204 @@ def bench_precision(rtt):
 
 
 # ---------------------------------------------------------------------------
+# bounded-Lloyd drill (ISSUE 6): exactness gate vs the oracle loops +
+# measured iteration speedup / pruned fraction, committed as
+# BOUNDS_r01.json — the CI `kernels` job runs this and exits nonzero if
+# the bounded path diverges from the oracle
+# ---------------------------------------------------------------------------
+
+
+def _bounds_synth(n, d, key_seed=99):
+    """KDD-character synthetic at a chosen n (the bench_kdd stand-in's
+    recipe: 23 imbalanced clusters, per-feature scale spread) sharded over
+    the default mesh."""
+    import jax
+    import jax.numpy as jnp
+
+    from dask_ml_tpu.parallel import mesh as mesh_lib
+
+    mesh = mesh_lib.default_mesh()
+    row_sh = mesh_lib.data_sharding(mesh, ndim=2)
+    kt = 23
+
+    def gen(key):
+        kc, ks, kp, ki, kn = jax.random.split(key, 5)
+        centers = jax.random.normal(kc, (kt, d)) * \
+            jnp.exp(jax.random.normal(ks, (1, d)) * 1.5)
+        logits = -0.45 * jnp.arange(kt, dtype=jnp.float32)
+        ids = jax.random.categorical(ki, logits, shape=(n,))
+        noise = jax.random.normal(kn, (n, d), jnp.float32)
+        return centers[ids] + noise * 0.3 * jnp.exp(
+            jax.random.normal(kp, (1, d)) * 0.5)
+
+    X = jax.jit(gen, out_shardings=row_sh)(jax.random.key(key_seed))
+    jax.block_until_ready(X)
+    return X, mesh
+
+
+def bench_bounds(_rtt):
+    """Bounded-Lloyd exactness + speedup drill (docs/kernels.md,
+    "Bound-based pruning"):
+
+    1. **Exactness gates** — bounded vs oracle (``lloyd_loop_fused``) on
+       KDD-shaped synthetic data: bit-identical centers, identical
+       labels, identical re-evaluated inertia, identical stopping — for
+       ``kernel='xla'`` at pin scale and interpret-mode pallas at smoke
+       scale. Any divergence exits nonzero.
+    2. **Iteration speedup** — full-loop wall times at ``BOUNDS_N`` rows
+       (env-overridable; tol=0 so the loop runs a fixed iteration count)
+       plus a STEADY-STATE comparison: both loops restarted from the
+       converged centers, where the bounds are saturated and the bounded
+       loop skips ~all distance work — the regime the optimization buys.
+    3. **Pruned fraction** — per-iteration ``rows_skipped / n`` from the
+       bounded carry; gated ``> 0.5`` by the late iterations.
+    4. **Compile-count gate** — a second bounded fit at the same shapes
+       must add ZERO compiles (the bound path is one program, not a
+       recompile per iteration).
+
+    The record is committed as BOUNDS_r01.json.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from dask_ml_tpu.models import kmeans as core
+    from dask_ml_tpu.parallel.shapes import track_compiles
+    from dask_ml_tpu.parallel.sharding import prepare_data
+
+    gates = {}
+    k, d, max_iter = 8, 41, 24
+    tol0 = jnp.asarray(0.0, jnp.float32)
+
+    # -- 1. exactness pins -------------------------------------------------
+    def pin(n, kernel):
+        X, mesh = _bounds_synth(n, d)
+        data = prepare_data(np.asarray(X))
+        c0 = core.init_random(data.X, data.weights, data.n, k,
+                              jax.random.key(0))
+        tol = jnp.asarray(1e-4, jnp.float32)
+        of = core.lloyd_loop_fused(data.X, data.weights, c0, tol,
+                                   mesh=data.mesh, max_iter=max_iter,
+                                   kernel="xla")
+        ob = core.lloyd_loop_bounded(data.X, data.weights, c0, tol,
+                                     mesh=data.mesh, max_iter=max_iter,
+                                     kernel=kernel)
+        centers_ok = bool(
+            (np.asarray(of[0]) == np.asarray(ob[0])).all())
+        labels_ok = bool((np.asarray(core.predict_labels(data.X, of[0]))
+                          == np.asarray(ob[4])).all())
+        inertia_ok = bool(
+            float(core.compute_inertia(data.X, data.weights, of[0]))
+            == float(core.compute_inertia(data.X, data.weights, ob[0])))
+        # the bounded loop's own RETURNED inertia (its jitted
+        # final-assignment epilogue) must agree with an independent
+        # recompute on its centers — compute_inertia is a different
+        # expression, so this is a tight-tolerance consistency gate, not
+        # a bit pin; it catches an epilogue regression (e.g. the
+        # eager-reduction drift _bounded_final_assign exists to prevent)
+        # that the centers-level bit pins above are blind to
+        recomputed = float(core.compute_inertia(data.X, data.weights,
+                                                ob[0]))
+        ret_inertia_ok = bool(
+            abs(float(ob[1]) - recomputed) <= 1e-6 * max(recomputed, 1.0))
+        iters_ok = int(of[2]) == int(ob[2])
+        return (centers_ok and labels_ok and inertia_ok and iters_ok
+                and ret_inertia_ok)
+
+    n_pin = int(os.environ.get("BOUNDS_PIN_N", 200_000))
+    gates["bounded_xla_bit_identical"] = pin(n_pin, "xla")
+    # interpret-mode pallas is slow on CPU — smoke scale keeps the CI job
+    # honest about the kernel path without a multi-minute pin
+    gates["bounded_pallas_bit_identical"] = pin(
+        int(os.environ.get("BOUNDS_PALLAS_N", 20_000)), "pallas")
+
+    # -- 2+3. measured speedup + pruned fraction ---------------------------
+    n_big = int(os.environ.get("BOUNDS_N", 2_000_000))
+    X, mesh = _bounds_synth(n_big, d)
+    data = prepare_data(np.asarray(X))
+    c0 = core.init_random(data.X, data.weights, data.n, k,
+                          jax.random.key(1))
+
+    def t_full(c_init, iters):
+        return measure(partial(core.lloyd_loop_fused, mesh=data.mesh,
+                               max_iter=iters, kernel="xla"),
+                       data.X, data.weights, c_init, tol0, reps=2)
+
+    def t_bound(c_init, iters):
+        return measure(partial(core.lloyd_loop_bounded, mesh=data.mesh,
+                               max_iter=iters, kernel="xla"),
+                       data.X, data.weights, c_init, tol0, reps=2)
+
+    t_oracle = t_full(c0, max_iter)
+    t_bounded = t_bound(c0, max_iter)
+    out = core.lloyd_loop_bounded(data.X, data.weights, c0, tol0,
+                                  mesh=data.mesh, max_iter=max_iter,
+                                  kernel="xla")
+    n_iter = int(out[2])
+    pruned = [round(float(s) / data.n, 4)
+              for s in np.asarray(out[5]["rows_skipped"])[:n_iter]]
+    held = [round(float(s) / data.n, 4)
+            for s in np.asarray(out[5]["bounds_held"])[:n_iter]]
+    late = pruned[-max(2, len(pruned) // 4):]
+    gates["late_pruned_fraction_gt_0.5"] = bool(
+        min(late) > 0.5) if late else False
+
+    # steady state: restart both loops from the converged centers — the
+    # bounds saturate after the first iteration and the remaining ones
+    # skip ~all distance work
+    c_conv = out[0]
+    tail_iters = 8
+    t_tail_oracle = t_full(c_conv, tail_iters)
+    t_tail_bounded = t_bound(c_conv, tail_iters)
+
+    # -- 4. compile-count gate ---------------------------------------------
+    with track_compiles() as tc:
+        core.lloyd_loop_bounded(data.X, data.weights, c0, tol0,
+                                mesh=data.mesh, max_iter=max_iter,
+                                kernel="xla")
+    gates["bounded_refit_zero_compiles"] = int(tc["n_compiles"]) == 0
+
+    rec = {
+        "metric": "bounded_lloyd",
+        "value": round(t_tail_oracle / max(t_tail_bounded, 1e-9), 3),
+        "unit": "steady-state Lloyd-iteration speedup (oracle/bounded, "
+                "bounds saturated)",
+        "vs_baseline": None,
+        "backend": jax.default_backend(),
+        "all_gates_pass": all(gates.values()),
+        "gates": gates,
+        "rows": n_big, "cols": d, "n_clusters": k, "max_iter": max_iter,
+        "full_loop_seconds": {"oracle": round(t_oracle, 3),
+                              "bounded": round(t_bounded, 3),
+                              "speedup": round(
+                                  t_oracle / max(t_bounded, 1e-9), 3)},
+        "steady_state_seconds": {
+            "iters": tail_iters,
+            "oracle": round(t_tail_oracle, 3),
+            "bounded": round(t_tail_bounded, 3),
+            "speedup": round(t_tail_oracle / max(t_tail_bounded, 1e-9), 3)},
+        "lloyd_pruned_fraction": pruned,
+        "lloyd_bound_held_fraction": held,
+        "pin_rows": n_pin,
+        "note": "exactness gates compare against the lloyd_loop_fused "
+                "oracle (bit-identical centers / labels / inertia / "
+                "stopping); pruned fraction is distance work actually "
+                "avoided (block granularity, ops/fused_distance.py "
+                "row_need contract), bound_held the row-level bound hit "
+                "rate. Off-TPU the speedups measure the XLA block-skip "
+                "lowering only.",
+    }
+    emit(rec)
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BOUNDS_r01.json")
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=1)
+    if not all(gates.values()):
+        raise SystemExit(
+            "bounded lloyd drill: failed gates: "
+            + ", ".join(g for g, v in gates.items() if not v))
+
+
+# ---------------------------------------------------------------------------
 # KDD-Cup'99 harness (the reference's flagship real-data benchmark,
 # benchmarks/k_means_kdd.py:95-125: KMeans(n_clusters=8,
 # oversampling_factor=2, random_state=0) on ~4.9M x 41)
@@ -1617,6 +1815,20 @@ def bench_kdd(_rtt):
     else:
         t = t1
 
+    # bounded-Lloyd pruning observability at the flagship shape: one
+    # algorithm='bounded' fit (bit-identical results, pinned elsewhere)
+    # recording the per-iteration pruned fraction next to the PR-2
+    # roofline keys (full grid + exactness gates: bench.py --bounds)
+    def bounded_fit():
+        kb = KMeans(n_clusters=8, oversampling_factor=2, random_state=0,
+                    algorithm="bounded")
+        t0 = time.perf_counter()
+        kb.fit(X)
+        return kb, time.perf_counter() - t0
+
+    bounded_fit()  # warm (compile)
+    km_b, t_bounded = bounded_fit()
+
     bl = _measured_baselines().get("kdd")
     if bl and "seconds" in bl:
         vs = round(float(bl["seconds"]) / t, 1)
@@ -1665,7 +1877,17 @@ def bench_kdd(_rtt):
             k_: round(float(v), 2)
             for k_, v in init_phases["effective_gbps"].items()},
         "init_fused_dispatch": init_phases["fused"],
+        "init_round_skip_ratio": round(
+            float(init_phases["round_skip_ratio"]), 4),
         "lloyd_seconds": round(float(phases.get("lloyd", 0.0)), 2),
+        # bounded-Lloyd pruning next to the roofline keys (ISSUE 6): the
+        # algorithm='bounded' fit at the same flagship shape
+        "bounded_fit_seconds": round(t_bounded, 2),
+        "lloyd_pruned_fraction": [
+            round(f, 4)
+            for f in km_b.lloyd_pruning_["pruned_fraction_per_iter"]],
+        "lloyd_rows_skipped": km_b.lloyd_pruning_["rows_skipped"],
+        "lloyd_distances_avoided": km_b.lloyd_pruning_["distances_avoided"],
         "n_iter": int(km.n_iter_),
         "inertia": float(km.inertia_),
         "samples_per_sec_per_chip": round(n / t / jax.device_count(), 1),
@@ -1819,6 +2041,14 @@ if __name__ == "__main__":
         # print the clean-vs-injected recovery-overhead deltas
         _enable_compilation_cache()
         bench_faults(measure_rtt())
+        emit_summary()
+    elif "--bounds" in sys.argv:
+        # bounded-Lloyd drill (ISSUE 6); CI's kernels job runs this:
+        # bit-identical-vs-oracle gates + measured iteration speedup +
+        # pruned-fraction trajectory, nonzero exit on any gate failure
+        # (committed as BOUNDS_r01.json)
+        _enable_compilation_cache()
+        bench_bounds(measure_rtt())
         emit_summary()
     elif "--precision" in sys.argv:
         # f32-vs-bf16 precision grid (ISSUE 5); CI's precision job runs

@@ -10,9 +10,13 @@ from dask_ml_tpu.cluster.k_means import (  # noqa: F401
     k_init,
     k_means,
 )
-from dask_ml_tpu.cluster.minibatch import PartialMiniBatchKMeans  # noqa: F401
+from dask_ml_tpu.cluster.minibatch import (  # noqa: F401
+    MiniBatchKMeans,
+    PartialMiniBatchKMeans,
+)
 from dask_ml_tpu.cluster.spectral import SpectralClustering, embed  # noqa: F401
 
-__all__ = ["KMeans", "SpectralClustering", "PartialMiniBatchKMeans",
+__all__ = ["KMeans", "MiniBatchKMeans", "SpectralClustering",
+           "PartialMiniBatchKMeans",
            "k_means", "compute_inertia", "evaluate_cost", "embed",
            "k_init", "init_pp", "init_random", "init_scalable"]

@@ -45,8 +45,18 @@ class KMeans(TransformerMixin, BaseEstimator):
         sklearn and the reference.
     random_state : int, jax PRNG key, or None
     init_max_iter : int or None — cap on k-means|| rounds.
-    n_jobs / precompute_distances / copy_x / algorithm are accepted for
-        signature parity and ignored (placement is the mesh's job).
+    algorithm : {'full', 'lloyd', 'bounded', 'elkan', 'auto'}, default 'full'
+        Lloyd-iteration implementation. 'full' (alias 'lloyd') is the
+        plain fused loop; 'bounded' (alias 'elkan', sklearn's name for
+        the idea) carries Elkan/Yinyang center-movement bounds and skips
+        the distance pass block-wise for rows whose bounds prove the
+        assignment unchanged — converged centers, labels, and inertia
+        are bit-identical to 'full' (pinned by test), only the work
+        differs; 'auto' picks 'bounded' in its winning regimes
+        (``models.kmeans._bounded_auto_wins``). A bounded fit exposes
+        its pruning counters as ``lloyd_pruning_``.
+    n_jobs / precompute_distances / copy_x are accepted for signature
+        parity and ignored (placement is the mesh's job).
 
     Attributes
     ----------
@@ -91,6 +101,18 @@ class KMeans(TransformerMixin, BaseEstimator):
             raise ValueError(
                 f"n_clusters={self.n_clusters} must be <= n_samples={n_samples}"
             )
+        if self.algorithm not in ("full", "lloyd", "bounded", "elkan",
+                                  "auto"):
+            raise ValueError(
+                "algorithm must be 'full'/'lloyd', 'bounded'/'elkan', or "
+                f"'auto'; got {self.algorithm!r}")
+
+    def _use_bounded(self, n: int, d: int) -> bool:
+        if self.algorithm in ("bounded", "elkan"):
+            return True
+        if self.algorithm == "auto":
+            return core._bounded_auto_wins(n, self.n_clusters, d)
+        return False
 
     def fit(self, X, y=None, sample_weight=None):
         t0 = tic()
@@ -114,11 +136,22 @@ class KMeans(TransformerMixin, BaseEstimator):
         logger.info("init (%s) finished in %.2fs", self.init, t_init - t0)
 
         tol = core.scaled_tolerance(data.X, data.weights, self.tol)
+        bounded = self._use_bounded(data.n, data.n_features)
         with profile_phase(logger, "kmeans-lloyd"):
-            centers, _, n_iter, _ = core.lloyd_loop_fused(
-                data.X, data.weights, centers, tol,
-                mesh=data.mesh, max_iter=self.max_iter,
-            )
+            if bounded:
+                from dask_ml_tpu.parallel.precision import lloyd_bounds_dtype
+
+                centers, _, n_iter, _, _, prune_stats = \
+                    core.lloyd_loop_bounded(
+                        data.X, data.weights, centers, tol,
+                        mesh=data.mesh, max_iter=self.max_iter,
+                        bounds_dtype=lloyd_bounds_dtype(data.X.dtype),
+                    )
+            else:
+                centers, _, n_iter, _ = core.lloyd_loop_fused(
+                    data.X, data.weights, centers, tol,
+                    mesh=data.mesh, max_iter=self.max_iter,
+                )
         # Recompute cost against the *final* centers so inertia_ is consistent
         # with cluster_centers_/labels_ and score(X) — the reference likewise
         # re-assigns after the loop (reference: cluster/k_means.py:504-507).
@@ -139,6 +172,31 @@ class KMeans(TransformerMixin, BaseEstimator):
         self.inertia_ = float(inertia)
         self.n_iter_ = int(n_iter)
         self.n_features_in_ = data.n_features
+        if bounded:
+            # pruning observability (surfaced next to the PR-2 roofline
+            # keys by bench_kdd as lloyd_pruned_fraction): rows_skipped
+            # counts distance work actually avoided (block granularity),
+            # bounds_held the rows whose bound held (row granularity)
+            n_it = int(n_iter)
+            skip = np.asarray(
+                jax.device_get(prune_stats["rows_skipped"]))[:n_it]
+            held = np.asarray(
+                jax.device_get(prune_stats["bounds_held"]))[:n_it]
+            # the loop's counters run over POSITIVE-weight rows only, so
+            # the fractions must too — under zero sample_weights (or row
+            # padding) data.n would understate the pruning rate
+            n_real = int(jax.device_get(
+                jnp.sum((data.weights > 0).astype(jnp.int32))))
+            denom = max(n_real, 1)
+            self.lloyd_pruning_ = {
+                "rows_skipped": int(skip.sum()),
+                "rows_considered": n_it * n_real,
+                "distances_avoided": int(skip.sum()) * int(self.n_clusters),
+                "pruned_fraction_per_iter": [
+                    float(s) / denom for s in skip],
+                "bound_held_fraction_per_iter": [
+                    float(h) / denom for h in held],
+            }
         # phase split for benchmarks/observability: init ends at the
         # device_get barrier inside k_init; lloyd covers the fused loop +
         # final re-assignment fetch

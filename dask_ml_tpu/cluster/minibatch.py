@@ -1,11 +1,224 @@
-"""Deprecated PartialMiniBatchKMeans wrapper
-(reference: cluster/minibatch.py:9-11)."""
+"""Mini-batch KMeans over the fused assignment kernel, plus the deprecated
+``PartialMiniBatchKMeans`` wrapper (reference: cluster/minibatch.py:9-11).
+
+:class:`MiniBatchKMeans` is the TPU-native streaming variant of
+:class:`~dask_ml_tpu.cluster.KMeans` (Sculley 2010 web-scale k-means): each
+step draws a batch, assigns it to the nearest centers, and moves each center
+toward its batch mean with a per-center learning rate ``1/v_j`` (``v_j`` =
+total weight the center has absorbed). The assignment routes through
+:func:`~dask_ml_tpu.ops.fused_distance.fused_argmin_min` — the single
+implementation of the distance+reduce idiom, so the (batch × k) distance
+matrix follows the same fused/XLA dispatch as every other consumer instead
+of materializing privately — and the whole multi-step optimization runs as
+ONE ``lax.scan`` program on device (no per-batch host round trip).
+
+The deprecated :class:`PartialMiniBatchKMeans` (sklearn's estimator fed
+block-wise through the ``_BigPartialFitMixin``) is kept for drop-in parity.
+"""
 
 from __future__ import annotations
 
+import logging
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from sklearn.base import BaseEstimator, TransformerMixin
 from sklearn.cluster import MiniBatchKMeans as _MiniBatchKMeans
 
 from dask_ml_tpu._partial import _BigPartialFitMixin, _copy_partial_doc
+from dask_ml_tpu.config import maybe_host
+from dask_ml_tpu.models import kmeans as core
+from dask_ml_tpu.ops.fused_distance import fused_argmin_min
+from dask_ml_tpu.parallel.sharding import prepare_data, unpad_rows
+from dask_ml_tpu.utils.validation import check_array, check_random_state
+
+logger = logging.getLogger(__name__)
+
+
+def _minibatch_update(batch, wb, centers, v):
+    """One Sculley update from an assigned batch: per-center batch sums and
+    weighted counts via the one-hot contraction (the M-step idiom), then
+    ``c_j ← (1 − η_j)·c_j + η_j·mean_j`` with ``η_j = n_j / v_j`` — centers
+    that caught nothing stay put. Assignment is the FUSED family's
+    argmin (not a private distance matrix)."""
+    k = centers.shape[0]
+    labels, _ = fused_argmin_min(batch, centers)
+    onehot = jax.nn.one_hot(labels, k, dtype=jnp.float32) * wb[:, None]
+    sums = onehot.T @ batch.astype(jnp.float32)  # (k, d)
+    counts = jnp.sum(onehot, axis=0)  # (k,)
+    v_new = v + counts
+    eta = jnp.where(counts > 0, counts / jnp.maximum(v_new, 1.0), 0.0)
+    mean = sums / jnp.maximum(counts, 1e-30)[:, None]
+    centers = jnp.where(counts[:, None] > 0,
+                        (1.0 - eta)[:, None] * centers
+                        + eta[:, None] * mean,
+                        centers)
+    return centers, v_new, labels
+
+
+@partial(jax.jit, static_argnames=("n_steps", "batch_size", "n_valid"))
+def _minibatch_steps(X, w, centers0, v0, key, *, n_steps: int,
+                     batch_size: int, n_valid: int):
+    """All mini-batch steps as one ``lax.scan``: step t draws
+    ``batch_size`` row indices uniformly from the ``n_valid`` real rows
+    (with replacement — the Sculley sampling model) and applies one
+    update. ``n_steps`` is static (it sizes the scan's key array), so
+    one program serves every fit at the same (shape, epochs) signature —
+    the same compile-cache discipline as ``lloyd_loop``'s ``max_iter``.
+    """
+    def step(carry, kt):
+        centers, v = carry
+        idx = jax.random.randint(kt, (batch_size,), 0, n_valid)
+        batch = jnp.take(X, idx, axis=0)
+        wb = jnp.take(w, idx)
+        centers, v, _ = _minibatch_update(batch, wb, centers, v)
+        return (centers, v), None
+
+    keys = jax.random.split(key, n_steps)
+    (centers, v), _ = jax.lax.scan(step, (centers0, v0), keys)
+    return centers, v
+
+
+@jax.jit
+def _partial_step(X, w, centers, v):
+    return _minibatch_update(X, w, centers, v)
+
+
+class MiniBatchKMeans(TransformerMixin, BaseEstimator):
+    """Mini-batch KMeans (Sculley 2010) on the fused assignment kernel.
+
+    Parameters
+    ----------
+    n_clusters : int, default 8
+    init : {'k-means||', 'k-means++', 'random'} or ndarray, default 'k-means||'
+        Initial centers — the same dispatch as :class:`KMeans`
+        (``models.kmeans.k_init``). The smart default matters more here
+        than for full Lloyd: the Sculley update never moves a center
+        that catches no batch points, so a center stranded by a bad
+        random draw stays lost (sklearn's MiniBatchKMeans defaults to
+        k-means++ for the same reason).
+    batch_size : int, default 1024
+    max_iter : int, default 10
+        Epochs: each epoch runs ``ceil(n / batch_size)`` uniformly-drawn
+        batches (sampling with replacement, so an "epoch" is a work
+        budget, not a partition).
+    compute_labels : bool, default True
+        Run one full assignment pass after fitting to populate
+        ``labels_``/``inertia_`` (exactly :class:`KMeans`'s post-loop
+        re-assignment contract).
+    random_state : int, jax PRNG key, or None
+
+    Attributes: ``cluster_centers_``, ``labels_``, ``inertia_``,
+    ``n_iter_`` (total mini-batch steps), ``counts_`` (per-center absorbed
+    weight — the streaming state; ``partial_fit`` continues from it).
+    """
+
+    def __init__(self, n_clusters: int = 8, init: str = "k-means||",
+                 batch_size: int = 1024, max_iter: int = 10,
+                 compute_labels: bool = True, random_state=None,
+                 oversampling_factor: float = 2.0, init_max_iter=None):
+        self.n_clusters = n_clusters
+        self.init = init
+        self.batch_size = batch_size
+        self.max_iter = max_iter
+        self.compute_labels = compute_labels
+        self.random_state = random_state
+        self.oversampling_factor = oversampling_factor
+        self.init_max_iter = init_max_iter
+
+    def _init_centers(self, data, key):
+        return core.k_init(
+            data.X, data.weights, data.n, self.n_clusters, key,
+            init=self.init, oversampling_factor=self.oversampling_factor,
+            max_iter=self.init_max_iter, mesh=data.mesh)
+
+    def fit(self, X, y=None, sample_weight=None):
+        if self.n_clusters < 1:
+            raise ValueError("n_clusters must be >= 1")
+        if self.batch_size < 1:
+            raise ValueError("batch_size must be >= 1")
+        X = check_array(X)
+        data = prepare_data(X, sample_weight=sample_weight)
+        if self.n_clusters > data.n:
+            raise ValueError(
+                f"n_clusters={self.n_clusters} must be <= "
+                f"n_samples={data.n}")
+        key = check_random_state(self.random_state)
+        key, k_init_key, k_steps = jax.random.split(key, 3)
+        centers = self._init_centers(data, k_init_key)
+        bs = int(min(self.batch_size, data.n))
+        steps_per_epoch = -(-data.n // bs)
+        n_steps = int(max(self.max_iter, 1)) * steps_per_epoch
+        centers, v = _minibatch_steps(
+            data.X, data.weights, jnp.asarray(centers, jnp.float32),
+            jnp.zeros((self.n_clusters,), jnp.float32), k_steps,
+            n_steps=n_steps, batch_size=bs, n_valid=data.n)
+        self.cluster_centers_ = np.asarray(centers)
+        self.counts_ = np.asarray(v)
+        self.n_iter_ = int(n_steps)
+        self.n_features_in_ = data.n_features
+        if self.compute_labels:
+            labels = core.predict_labels(data.X, centers)
+            self.labels_ = np.asarray(
+                unpad_rows(labels, data.n)).astype(np.int32)
+            self.inertia_ = float(
+                core.compute_inertia(data.X, data.weights, centers))
+        return self
+
+    def partial_fit(self, X, y=None, sample_weight=None):
+        """One mini-batch update from the given rows (the whole input is
+        the batch). First call initializes centers from the batch."""
+        X = check_array(X)
+        data = prepare_data(X, sample_weight=sample_weight)
+        if not hasattr(self, "cluster_centers_"):
+            key = check_random_state(self.random_state)
+            if self.n_clusters > data.n:
+                raise ValueError(
+                    f"n_clusters={self.n_clusters} must be <= "
+                    f"n_samples={data.n} in the first partial_fit batch")
+            self.cluster_centers_ = np.asarray(
+                self._init_centers(data, key))
+            self.counts_ = np.zeros((self.n_clusters,), np.float32)
+            self.n_iter_ = 0
+            self.n_features_in_ = data.n_features
+        centers, v, _ = _partial_step(
+            data.X, data.weights,
+            jnp.asarray(self.cluster_centers_, jnp.float32),
+            jnp.asarray(self.counts_))
+        self.cluster_centers_ = np.asarray(centers)
+        self.counts_ = np.asarray(v)
+        self.n_iter_ += 1
+        return self
+
+    def _check_fitted(self):
+        if not hasattr(self, "cluster_centers_"):
+            raise AttributeError("Model not fitted; call fit first")
+
+    def predict(self, X):
+        self._check_fitted()
+        X = check_array(X)
+        data = prepare_data(X)
+        labels = core.predict_labels(
+            data.X, jnp.asarray(self.cluster_centers_))
+        return maybe_host(unpad_rows(labels, data.n))
+
+    def transform(self, X):
+        from dask_ml_tpu.ops.pairwise import euclidean_distances
+
+        self._check_fitted()
+        X = check_array(X)
+        data = prepare_data(X)
+        d = euclidean_distances(data.X, jnp.asarray(self.cluster_centers_))
+        return maybe_host(unpad_rows(d, data.n))
+
+    def score(self, X, y=None):
+        self._check_fitted()
+        X = check_array(X)
+        data = prepare_data(X)
+        return -float(core.compute_inertia(
+            data.X, data.weights, jnp.asarray(self.cluster_centers_)))
 
 
 @_copy_partial_doc

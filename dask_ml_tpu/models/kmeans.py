@@ -35,8 +35,10 @@ import numpy as np
 
 from dask_ml_tpu.ops.fused_distance import (
     fused_argmin_min,
+    fused_argmin_min2,
     fused_argmin_weight,
     fused_rowwise_min,
+    row_block_evaluated,
 )
 
 logger = logging.getLogger(__name__)
@@ -411,6 +413,421 @@ def lloyd_loop_fused(X, w, centers0, tol, *, mesh, max_iter: int,
                jnp.asarray(tol, jnp.float32))
 
 
+# ---------------------------------------------------------------------------
+# Bound-based Lloyd: skip distance work with Elkan/Yinyang center-movement
+# bounds (arxiv 2105.02936, arxiv 1605.02989; ROADMAP item 3)
+# ---------------------------------------------------------------------------
+
+#: relative inflation applied to every bound-side quantity (seeds and
+#: movement decrements). The bounds' validity argument is exact-arithmetic
+#: triangle inequality; the slack absorbs f32 rounding of the sqrt and
+#: the movement norms, so a row is only ever skipped when its margin
+#: exceeds FP noise by ~two orders of magnitude — a skipped row's
+#: assignment provably cannot change even under the oracle's own rounded
+#: scores. Near-exact ties (margin below the slack) always re-evaluate
+#: and inherit the oracle's lowest-index convention.
+_BOUND_SLACK = 1e-5
+
+#: ABSOLUTE slack on the seeded squared distances, scaled by the operand
+#: magnitudes ``|x|² + max|c|²``: the ``|c|² − 2x·c + |x|²`` expression
+#: cancels catastrophically when the distance is far smaller than the
+#: operands, so its f32 error is relative to the NORMS, not the distance
+#: — a purely relative slack under-covers exactly the near-center rows
+#: the bounds most want to skip. 1e-5 ≈ 84·eps_f32 of headroom.
+_BOUND_EPS_ABS = 1e-5
+
+#: while_loop carry layout version of the bounded Lloyd loop
+#: (``lloyd_bounded_resumable`` binds it into every snapshot; a resume
+#: against a snapshot written by a different layout is a loud error,
+#: never a silently mis-shaped carry). Bump on ANY carry change.
+BOUNDED_CARRY_VERSION = 1
+
+
+def _bounded_auto_wins(n: int, k: int, d: int) -> bool:
+    """The regimes where ``algorithm='auto'`` selects the bounded loop.
+
+    The bound machinery pays O(n·(G+1)) state plus per-iteration bound
+    updates to skip the O(n·k·d) assignment pass; the skip only
+    amortizes once n is large enough that the assignment pass dominates
+    the loop and k is large enough that a skipped row saves real work
+    (k ≥ 4 — below that the assignment pass is already cheaper than the
+    M-step it cannot skip). Small problems keep the plain fused loop:
+    the bench trajectory (BOUNDS_r01.json) measures the crossover; this
+    rule is deliberately conservative so 'auto' never loses."""
+    return n >= (1 << 16) and k >= 4
+
+
+def _bounded_groups(k: int, groups):
+    """(G, size) for the Yinyang center grouping: ``groups='auto'``
+    follows the Yinyang paper's t = ⌈k/10⌉ (one group — pure
+    Hamerly-style single lower bound — until k reaches double digits),
+    an int clips to [1, k]. Centers are grouped by contiguous index
+    (``gid = arange(k) // size``): center identity is stable across
+    iterations (the M-step never permutes rows), so no re-grouping is
+    ever needed and the carry stays O(n·G)."""
+    if groups == "auto":
+        G = max(1, -(-k // 10))
+    else:
+        G = max(1, min(int(groups), k))
+    size = -(-k // G)
+    return -(-k // size), size
+
+
+def _bounded_need(ub, lb, w_pos, *, prune: bool):
+    """The Yinyang global filter: a row needs distance work only when its
+    upper bound fails to clear the tightest group lower bound. Strict
+    inequality — at equality the true distances may tie, and ties are the
+    oracle's (lowest index) to break, so the row re-evaluates."""
+    if not prune:
+        return w_pos
+    return jnp.logical_and(w_pos, ub >= jnp.min(lb, axis=1))
+
+
+def _bounded_assign(X_pad, x2_pad, centers, labels, ub, lb, w_pos, *,
+                    kernel: str, prune: bool, bdt):
+    """One bounded assignment step: evaluate the rows the bounds cannot
+    clear (block-wise through :func:`fused_argmin_min2`), overlay carried
+    labels/bounds for skipped blocks, and reseed bounds for evaluated
+    rows (upper = best distance, every group lower = global second-best —
+    a valid lower bound for each group's non-assigned minimum at once).
+    The seeds carry the magnitude-scaled absolute slack
+    (:data:`_BOUND_EPS_ABS` — the computed squared distances cancel
+    against ``|x|² + |c|²``, so their f32 error scales with the norms).
+    ``x2_pad`` is the hoisted per-row ``Σx²``. Returns
+    (labels, ub, lb, n_rows_skipped, n_bounds_held)."""
+    s = _BOUND_SLACK
+    need = _bounded_need(ub, lb, w_pos, prune=prune)
+    idx, d1, d2 = fused_argmin_min2(X_pad, centers, row_need=need,
+                                    kernel=kernel)
+    ev = row_block_evaluated(need)
+    labels = jnp.where(ev, idx, labels)
+    c2max = jnp.max(jnp.sum(centers * centers, axis=1))
+    slack_sq = _BOUND_EPS_ABS * (x2_pad + c2max)
+    ub = jnp.where(ev, (jnp.sqrt(d1 + slack_sq) * (1 + s)).astype(bdt), ub)
+    lb_seed = (jnp.sqrt(jnp.maximum(d2 - slack_sq, 0.0)) * (1 - s)
+               ).astype(bdt)
+    lb = jnp.where(ev[:, None], lb_seed[:, None], lb)
+    skipped = jnp.sum(jnp.logical_and(w_pos, jnp.logical_not(ev))
+                      .astype(jnp.int32))
+    held = jnp.sum(jnp.logical_and(w_pos, jnp.logical_not(need))
+                   .astype(jnp.int32))
+    return labels, ub, lb, skipped, held
+
+
+def _bounded_move(ub, lb, labels, centers, new_centers, gid, G, bdt):
+    """Center-movement bound maintenance: the upper bound drifts up by the
+    assigned center's movement, each group lower bound drifts down by its
+    group's LARGEST movement (the whole point of Yinyang groups — one
+    decrement per group instead of the global max). Movements are
+    inflated by the slack so FP-rounded norms can never under-account a
+    real move."""
+    delta = (jnp.sqrt(jnp.sum((new_centers - centers) ** 2, axis=1))
+             .astype(bdt)) * (1 + _BOUND_SLACK)
+    dg = jnp.zeros((G,), bdt).at[gid].max(delta)
+    return ub + delta[labels], lb - dg[None, :]
+
+
+def _bounded_init_state(centers0, n_pad: int, G: int, max_iter: int, bdt):
+    """Zero'd carry: zero bounds force a full evaluation on iteration 0
+    (``ub >= min(lb)`` holds at 0 ≥ 0), which seeds everything."""
+    return (centers0.astype(jnp.float32),
+            jnp.zeros((n_pad,), jnp.int32),
+            jnp.zeros((n_pad,), bdt),
+            jnp.zeros((n_pad, G), bdt),
+            jnp.asarray(0, jnp.int32),
+            jnp.asarray(jnp.inf, jnp.float32),
+            jnp.zeros((max_iter,), jnp.int32),
+            jnp.zeros((max_iter,), jnp.int32))
+
+
+def _pad_rows_to_blocks(X, w):
+    """Zero-row/zero-weight padding up to the family's skip-block quantum,
+    done ONCE before the while_loop (a per-iteration pad would re-copy X
+    every step). Zero-weight rows are inert everywhere by the package-wide
+    padding contract."""
+    from dask_ml_tpu.ops.fused_distance import _row_blocks
+
+    n = X.shape[0]
+    _, n_pad = _row_blocks(n)
+    if n_pad == n:
+        return X, w
+    return (jnp.pad(X, ((0, n_pad - n), (0, 0))),
+            jnp.pad(w, (0, n_pad - n)))
+
+
+@partial(jax.jit, static_argnames=("mesh", "max_iter", "kernel", "groups",
+                                   "prune", "bounds_dtype"))
+def lloyd_loop_bounded(X, w, centers0, tol, *, max_iter: int, mesh=None,
+                       kernel: str = "auto", groups="auto",
+                       prune: bool = True, bounds_dtype=jnp.float32):
+    """Lloyd optimization that SKIPS distance work via Elkan/Yinyang
+    center-movement bounds — the existing loops are the bit-compatible
+    oracles (``lloyd_loop`` replicated, ``lloyd_loop_fused`` sharded).
+
+    The carry extends the oracle's (centers, it, shift) with O(n·(G+1))
+    bound state in ``bounds_dtype`` (≥ f32 via the precision policy's
+    :func:`~dask_ml_tpu.parallel.precision.lloyd_bounds_dtype` — 8
+    mantissa bits cannot hold a bound that must out-resolve FP noise on
+    distances):
+
+    - ``labels (n,) int32`` — each row's current assignment,
+    - ``ub (n,)`` — upper bound on the distance (NOT squared: the
+      triangle inequality lives in metric space) to the assigned center,
+    - ``lb (n, G)`` — per-group lower bounds on the distance to the
+      nearest NON-assigned center of each Yinyang group.
+
+    Per iteration: rows with ``ub < min_g lb_g`` provably keep their
+    assignment and skip the distance pass BLOCK-wise (the family's
+    ``row_need`` contract — XLA blocks genuinely don't execute via
+    ``lax.map``+``cond``, pallas blocks skip under ``pl.when``);
+    everyone else re-evaluates through :func:`fused_argmin_min2`, whose
+    best/second-best distances reseed ub and every group's lb. The
+    M-step then runs over ALL rows from the (exact) labels with the
+    ORACLE'S OWN expression — ``_m_step`` on the replicated path, the
+    ``lloyd_loop_fused`` one-hot/XT contraction + psum on the mesh path
+    — so center trajectories, shifts, and the stopping iteration are
+    bit-identical to the unpruned loop: pruning only removes distance
+    work whose outcome the bounds already prove. Finally each center's
+    movement inflates ub and deflates its group's lb (by the group max),
+    keeping both valid without touching the data.
+
+    Returns ``(centers, inertia, n_iter, shift, labels, stats)``:
+    inertia and labels come from one full assignment pass against the
+    RETURNED centers (the estimator's post-loop re-assignment contract —
+    the loop itself never knows skipped rows' exact distances), and
+    ``stats`` carries ``rows_skipped``/``bounds_held`` per-iteration
+    int32 arrays of length ``max_iter`` (entries beyond ``n_iter`` are
+    zero) — ``rows_skipped`` counts rows whose distance work was
+    actually avoided (block granularity), ``bounds_held`` counts rows
+    whose bound held (row granularity, ≥ the block-wise number).
+    """
+    k, d = centers0.shape
+    G, size = _bounded_groups(k, groups)
+    gid = jnp.arange(k, dtype=jnp.int32) // size
+    if kernel not in ("auto", "pallas", "xla"):
+        raise ValueError(f"kernel must be auto|pallas|xla, got {kernel!r}")
+
+    if mesh is None:
+        X_pad, w_pad = _pad_rows_to_blocks(X, w)
+        n_pad = X_pad.shape[0]
+        w_pos = w_pad > 0
+        x2_pad = jnp.sum(X_pad.astype(jnp.float32) ** 2, axis=1)  # invariant
+        bdt = jnp.dtype(bounds_dtype)
+
+        def cond(state):
+            _, _, _, _, it, shift, _, _ = state
+            return jnp.logical_and(it < max_iter, shift >= tol)
+
+        def body(state):
+            centers, labels, ub, lb, it, _, skip_h, held_h = state
+            labels, ub, lb, skipped, held = _bounded_assign(
+                X_pad, x2_pad, centers, labels, ub, lb, w_pos,
+                kernel=kernel, prune=prune, bdt=bdt)
+            # the ORACLE'S M-step expression over the ORIGINAL (un-block-
+            # padded) rows: identical reduction lengths → identical bits
+            new_centers, _ = _m_step(X, w, labels[:X.shape[0]], centers)
+            shift = jnp.sum((new_centers - centers) ** 2)
+            ub, lb = _bounded_move(ub, lb, labels, centers, new_centers,
+                                   gid, G, bdt)
+            skip_h = skip_h.at[it].set(skipped)
+            held_h = held_h.at[it].set(held)
+            return (new_centers, labels, ub, lb, it + 1,
+                    shift.astype(jnp.float32), skip_h, held_h)
+
+        state = jax.lax.while_loop(
+            cond, body, _bounded_init_state(centers0, n_pad, G, max_iter,
+                                            bdt))
+        centers, _, _, _, n_iter, shift, skip_h, held_h = state
+        labels_f, mind_f = fused_argmin_min(X, centers, kernel=kernel)
+        inertia = jnp.sum(mind_f * w)
+        return (centers, inertia, n_iter, shift, labels_f,
+                {"rows_skipped": skip_h, "bounds_held": held_h})
+
+    # ---- sharded path: the lloyd_loop_fused counterpart -----------------
+    from jax.sharding import PartitionSpec as P
+
+    from dask_ml_tpu.parallel.mesh import DATA_AXIS, shard_map
+
+    bdt = jnp.dtype(bounds_dtype)
+    kidx = jnp.arange(k, dtype=jnp.int32)[:, None]
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(), P()),
+        out_specs=(P(), P(), P(), P(), P(DATA_AXIS), P()),
+        # the row-skipping eval runs lax.cond/pallas inside — vma typing
+        # can't see through either (same rule as the fused family's own
+        # shard_map wrappers)
+        check_vma=False,
+    )
+    def run(X_loc, w_loc, c0, tol_):
+        n_loc = X_loc.shape[0]
+        X_pad, w_pad = _pad_rows_to_blocks(X_loc, w_loc)
+        w_pos = w_pad > 0
+        x2_pad = jnp.sum(X_pad.astype(jnp.float32) ** 2, axis=1)  # invariant
+        # feature-major copy for the M-step — the lloyd_loop_fused layout
+        # (lane padding off the minor dim); the assignment blocks read the
+        # row-major original, so both layouts stay resident for the loop
+        XT = jax.lax.optimization_barrier(X_loc.T)  # (d, n_loc)
+
+        def m_step(labels, centers):
+            # VERBATIM lloyd_loop_fused local_stats M-step: same onehot,
+            # same contraction, same psum order → bit-identical centers
+            onehot = (kidx == labels[None, :n_loc]).astype(jnp.float32)
+            oh_w = onehot * w_loc[None, :]
+            sums = jax.lax.dot_general(
+                oh_w, XT.astype(jnp.float32), (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # (k, d)
+            counts = oh_w.sum(axis=1)
+            sums = jax.lax.psum(sums, DATA_AXIS)
+            counts = jax.lax.psum(counts, DATA_AXIS)
+            return _new_centers(sums, counts, centers)
+
+        def cond(state):
+            _, _, _, _, it, shift, _, _ = state
+            return jnp.logical_and(it < max_iter, shift >= tol_)
+
+        def body(state):
+            centers, labels, ub, lb, it, _, skip_h, held_h = state
+            labels, ub, lb, skipped, held = _bounded_assign(
+                X_pad, x2_pad, centers, labels, ub, lb, w_pos,
+                kernel=kernel, prune=prune, bdt=bdt)
+            new_centers = m_step(labels, centers)
+            shift = jnp.sum((new_centers - centers) ** 2)
+            ub, lb = _bounded_move(ub, lb, labels, centers, new_centers,
+                                   gid, G, bdt)
+            skip_h = skip_h.at[it].set(skipped)
+            held_h = held_h.at[it].set(held)
+            return (new_centers, labels, ub, lb, it + 1,
+                    shift.astype(jnp.float32), skip_h, held_h)
+
+        state = jax.lax.while_loop(
+            cond, body,
+            _bounded_init_state(c0, X_pad.shape[0], G, max_iter, bdt))
+        centers, _, _, _, n_iter, shift, skip_h, held_h = state
+        labels_f, mind_f = fused_argmin_min(X_loc, centers, kernel=kernel)
+        inertia = jax.lax.psum(jnp.sum(mind_f * w_loc), DATA_AXIS)
+        stats = {"rows_skipped": jax.lax.psum(skip_h, DATA_AXIS),
+                 "bounds_held": jax.lax.psum(held_h, DATA_AXIS)}
+        return centers, inertia, n_iter, shift, labels_f, stats
+
+    return run(X, w, centers0.astype(jnp.float32),
+               jnp.asarray(tol, jnp.float32))
+
+
+@partial(jax.jit, static_argnames=("max_iter", "chunk", "kernel", "groups",
+                                   "prune", "bounds_dtype"))
+def _bounded_chunk(X, w, state, tol, *, max_iter: int, chunk: int,
+                   kernel: str, groups, prune: bool, bounds_dtype):
+    """Up to ``chunk`` bounded Lloyd iterations from a threaded carry —
+    the resumable unit :func:`lloyd_bounded_resumable` drives. Same body
+    and stopping rule as the replicated :func:`lloyd_loop_bounded`, with
+    the extra per-chunk budget, so chunked execution composes to the
+    exact same trajectory."""
+    k = state[0].shape[0]
+    G, size = _bounded_groups(k, groups)
+    gid = jnp.arange(k, dtype=jnp.int32) // size
+    bdt = jnp.dtype(bounds_dtype)
+    X_pad, w_pad = _pad_rows_to_blocks(X, w)
+    w_pos = w_pad > 0
+    x2_pad = jnp.sum(X_pad.astype(jnp.float32) ** 2, axis=1)  # invariant
+    it0 = state[4]
+
+    def cond(st):
+        _, _, _, _, it, shift, _, _ = st
+        return jnp.logical_and(
+            jnp.logical_and(it < max_iter, it - it0 < chunk), shift >= tol)
+
+    def body(st):
+        centers, labels, ub, lb, it, _, skip_h, held_h = st
+        labels, ub, lb, skipped, held = _bounded_assign(
+            X_pad, x2_pad, centers, labels, ub, lb, w_pos,
+            kernel=kernel, prune=prune, bdt=bdt)
+        new_centers, _ = _m_step(X, w, labels[:X.shape[0]], centers)
+        shift = jnp.sum((new_centers - centers) ** 2)
+        ub, lb = _bounded_move(ub, lb, labels, centers, new_centers,
+                               gid, G, bdt)
+        skip_h = skip_h.at[it].set(skipped)
+        held_h = held_h.at[it].set(held)
+        return (new_centers, labels, ub, lb, it + 1,
+                shift.astype(jnp.float32), skip_h, held_h)
+
+    return jax.lax.while_loop(cond, body, state)
+
+
+@partial(jax.jit, static_argnames=("kernel",))
+def _bounded_final_assign(X, w, centers, *, kernel: str):
+    """The bounded loops' post-loop full assignment + inertia, as ONE
+    jitted program. :func:`lloyd_bounded_resumable` must run this jitted,
+    not eagerly: the one-shot :func:`lloyd_loop_bounded` compiles the
+    identical expression inside its own program, and the eager op-by-op
+    ``sum(mind * w)`` reduces in a different order — last-bit inertia
+    drift that breaks the "same tuple as the one-shot" contract."""
+    labels_f, mind_f = fused_argmin_min(X, centers, kernel=kernel)
+    return labels_f, jnp.sum(mind_f * w)
+
+
+def lloyd_bounded_resumable(X, w, centers0, tol, *, max_iter: int,
+                            path: str, chunk_iters: int = 10,
+                            every: int = 1, kernel: str = "auto",
+                            groups="auto", prune: bool = True,
+                            bounds_dtype=jnp.float32):
+    """Preemption-safe bounded Lloyd: chunks of device iterations with the
+    extended carry snapshotted through the :class:`ScanCheckpoint`
+    machinery (parallel/faults.py) between chunks, so a killed fit
+    resumes BIT-identically from the last snapshot — the bounds are part
+    of the carry, so a resume neither loses pruning power nor re-derives
+    stale bounds.
+
+    The snapshot binds :data:`BOUNDED_CARRY_VERSION` plus the problem
+    shape; loading a snapshot written by a different carry layout (or a
+    different problem) is a loud error, never a silently mis-shaped
+    carry. Returns the same tuple as the replicated
+    :func:`lloyd_loop_bounded`; the snapshot is deleted on completion
+    (the admm_streamed contract)."""
+    from dask_ml_tpu.parallel.faults import ScanCheckpoint
+
+    class _BoundedLloydCheckpoint(ScanCheckpoint):
+        KIND = "lloyd_bounded"
+
+    k, d = centers0.shape
+    G, size = _bounded_groups(k, groups)
+    gid = jnp.arange(k, dtype=jnp.int32) // size
+    bdt = jnp.dtype(bounds_dtype)
+    from dask_ml_tpu.ops.fused_distance import _row_blocks
+
+    _, n_pad = _row_blocks(X.shape[0])
+    ckpt = _BoundedLloydCheckpoint(
+        path, every=every,
+        bind={"carry_version": BOUNDED_CARRY_VERSION,
+              "n": int(X.shape[0]), "k": int(k), "d": int(d),
+              "G": int(G), "max_iter": int(max_iter)})
+    snap = ckpt.load()
+    if snap is None:
+        state = _bounded_init_state(jnp.asarray(centers0), n_pad, G,
+                                    max_iter, bdt)
+    else:
+        carry, _outs, _nb, _ep = snap
+        state = tuple(jnp.asarray(leaf) for leaf in carry)
+    tol_dev = jnp.asarray(tol, jnp.float32)
+    while True:
+        it, shift = int(state[4]), float(state[5])
+        if it >= max_iter or not (shift >= float(jax.device_get(tol_dev))):
+            break
+        state = _bounded_chunk(
+            X, w, state, tol_dev, max_iter=max_iter,
+            chunk=int(chunk_iters), kernel=kernel, groups=groups,
+            prune=prune, bounds_dtype=bounds_dtype)
+        state = tuple(jax.block_until_ready(s) for s in state)
+        ckpt.tick(state, [], int(state[4]), 0)
+    centers = state[0]
+    labels_f, inertia = _bounded_final_assign(X, w, centers, kernel=kernel)
+    ckpt.delete()
+    return (centers, inertia, state[4], state[5], labels_f,
+            {"rows_skipped": state[6], "bounds_held": state[7]})
+
+
 @jax.jit
 def compute_inertia(X, w, centers):
     """Weighted cost of assigning X to ``centers``
@@ -699,17 +1116,37 @@ def _init_seed_phase(X, w, k0, *, max_rounds: int, max_cand: int):
 
 def _init_rounds_phase(X, w, l, cand, mind0, n_rounds, key, *,
                        max_rounds: int, max_cand: int, cap: int,
-                       mesh=None, kernel: str = "auto"):
+                       mesh=None, kernel: str = "auto", prune: bool = True):
     """k-means|| phase 2 — the sampling rounds (incremental min-distance
     maintenance + top_k index packing; see :func:`_init_scalable_device`).
     The per-round distance+mask+min against the new rows routes through
     the fused family — on TPU the (n × cap) distance block never reaches
-    HBM (``kernel='auto'`` dispatch, ops/fused_distance.py)."""
+    HBM (``kernel='auto'`` dispatch, ops/fused_distance.py).
+
+    ``prune=True`` (default) additionally SKIPS the distance work for rows
+    whose stale minimum provably cannot improve — the bounded-Lloyd
+    companion optimization (arxiv 2105.02936's norm-filter specialized to
+    the incremental update): a point's min distance to the candidate set
+    only shrinks, and ``d(x, c) ≥ |‖x‖ − ‖c‖|`` (reverse triangle
+    inequality), so when the squared gap between ``‖x‖`` and the new
+    rows' norm interval ``[r_lo, r_hi]`` already exceeds ``mind`` — minus
+    an absolute slack that over-covers f32 rounding of both sides — the
+    round cannot touch that row and its block skips via the family's
+    ``row_need`` contract. Skipped rows keep ``mind`` bit-exactly (the
+    skipped output is ``+inf``, the incremental-min identity), so pruned
+    and unpruned rounds produce IDENTICAL candidate trajectories. ‖x‖ is
+    loop-invariant and hoisted; the per-round extra cost is O(n) against
+    the O(n·cap·d) pass it can skip. Returns two extra counters
+    ``(rows_skipped, rows_considered)`` summed over executed rounds for
+    the init-phase observability report."""
     n_padded = X.shape[0]
     cap_iota = jnp.arange(cap)
+    if prune:
+        x2 = jnp.sum(X.astype(jnp.float32) ** 2, axis=1)  # (n,) invariant
+        xnorm = jnp.sqrt(x2)
 
     def do_round(carry):
-        cand, n_cand, mind, key, overflow = carry
+        cand, n_cand, mind, key, overflow, skipped, considered = carry
         key, kr = jax.random.split(key)
         phi = jnp.sum(mind * w)
         p = jnp.minimum(1.0, l * mind * w / jnp.maximum(phi, 1e-30))
@@ -730,20 +1167,44 @@ def _init_rounds_phase(X, w, l, cand, mind0, n_rounds, key, *,
         # incremental min-distance update against ONLY the new rows; the
         # ok-mask keeps unfilled slots at +inf inside the fused reduction,
         # so an empty round leaves mind unchanged
-        dmin_new = fused_rowwise_min(X, rows, mask=ok, kernel=kernel,
-                                     mesh=mesh)
+        if prune:
+            rn = jnp.sqrt(jnp.sum(rows * rows, axis=1))  # (cap,) f32
+            r_lo = jnp.min(jnp.where(ok, rn, jnp.inf))
+            r_hi = jnp.max(jnp.where(ok, rn, 0.0))
+            gap = jnp.maximum(jnp.maximum(r_lo - xnorm, xnorm - r_hi), 0.0)
+            # skip only when the margin clears an absolute slack that
+            # over-covers f32 rounding of the computed distance AND the
+            # computed gap (~80× headroom over eps·scale²) — a skipped
+            # row's minimum(mind, d̂²) is then provably a no-op even in
+            # rounded arithmetic. An empty round (gap = +inf against a
+            # finite slack) skips every row.
+            slack = 1e-5 * (x2 + r_hi * r_hi) + 1e-12
+            need = jnp.logical_and(gap * gap - slack < mind, w > 0)
+            w_real = w > 0
+            skipped = skipped + jnp.sum(
+                jnp.logical_and(w_real, jnp.logical_not(need))
+                .astype(jnp.int32))
+            considered = considered + jnp.sum(w_real.astype(jnp.int32))
+            dmin_new = fused_rowwise_min(X, rows, mask=ok, kernel=kernel,
+                                         mesh=mesh, row_need=need)
+        else:
+            dmin_new = fused_rowwise_min(X, rows, mask=ok, kernel=kernel,
+                                         mesh=mesh)
         mind = jnp.where(w > 0, jnp.minimum(mind, dmin_new), 0.0)
         overflow = jnp.maximum(overflow, total - count)
-        return cand, n_cand + count, mind, key, overflow
+        return (cand, n_cand + count, mind, key, overflow, skipped,
+                considered)
 
     def round_body(r, carry):
         return jax.lax.cond(r < n_rounds, do_round, lambda c: c, carry)
 
-    cand, n_cand, _mind, _key, overflow = jax.lax.fori_loop(
-        0, max_rounds, round_body,
-        (cand, jnp.asarray(1, jnp.int32), mind0, key,
-         jnp.asarray(0, jnp.int32)))
-    return cand, n_cand, overflow
+    cand, n_cand, _mind, _key, overflow, skipped, considered = \
+        jax.lax.fori_loop(
+            0, max_rounds, round_body,
+            (cand, jnp.asarray(1, jnp.int32), mind0, key,
+             jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
+             jnp.asarray(0, jnp.int32)))
+    return cand, n_cand, overflow, skipped, considered
 
 
 def _init_weights_phase(X, w, cand, n_cand, k_extra, *, n_clusters: int,
@@ -857,15 +1318,17 @@ def _init_scalable_device(X, w, l, tol, key, *, n_clusters: int,
       with the same math on device.
 
     Returns ``(centers, aux)`` where aux = (n_rounds, n_cand, φ₀,
-    max round overflow beyond ``cap``) — all device scalars; the caller
-    fetches them in one round trip for logging/no-silent-caps warnings.
+    max round overflow beyond ``cap``, rows bound-skipped over all
+    executed rounds, rows considered) — all device scalars; the caller
+    fetches them in one round trip for logging/no-silent-caps warnings
+    and the init-round skip-ratio observability.
     """
     key, k0, k_extra, k_pp = jax.random.split(key, 4)
     with jax.named_scope("kmeans-init-seed"):
         cand, mind0, phi0, n_rounds = _init_seed_phase(
             X, w, k0, max_rounds=max_rounds, max_cand=max_cand)
     with jax.named_scope("kmeans-init-rounds"):
-        cand, n_cand, overflow = _init_rounds_phase(
+        cand, n_cand, overflow, r_skip, r_total = _init_rounds_phase(
             X, w, l, cand, mind0, n_rounds, key,
             max_rounds=max_rounds, max_cand=max_cand, cap=cap,
             mesh=mesh, kernel=kernel)
@@ -880,7 +1343,7 @@ def _init_scalable_device(X, w, l, tol, key, *, n_clusters: int,
         centers = _init_finish_phase(
             cand, cw, tol, k_pp, n_clusters=n_clusters, n_trials=n_trials,
             finish_iters=finish_iters)
-    return centers, (n_rounds, n_cand, phi0, overflow)
+    return centers, (n_rounds, n_cand, phi0, overflow, r_skip, r_total)
 
 
 def _init_scalable_config(n_padded: int, n_clusters: int,
@@ -948,10 +1411,12 @@ def measure_init_phases(X, w, n_clusters: int, key,
     the fused :func:`_init_scalable_device` inlines — with a completion
     fetch between phases. Returns::
 
-        {"seconds":        {phase: wall seconds},
-         "bytes_moved":    {phase: logical bytes streamed},
-         "effective_gbps": {phase: bytes_moved / seconds / 1e9},
-         "fused":          {"rounds": bool, "weights": bool}}
+        {"seconds":          {phase: wall seconds},
+         "bytes_moved":      {phase: logical bytes streamed},
+         "effective_gbps":   {phase: bytes_moved / seconds / 1e9},
+         "fused":            {"rounds": bool, "weights": bool},
+         "round_skip_ratio": fraction of (row, round) distance work the
+                             rounds' norm-filter bound skipped}
 
     ``bytes_moved`` follows :func:`_init_phase_traffic` (logical, dominant
     terms, reflecting whether the fused kernel family or the unfused XLA
@@ -1011,7 +1476,7 @@ def measure_init_phases(X, w, n_clusters: int, key,
         return out
 
     cand, mind0, phi0, n_rounds = timed("seed", seed_fn, X, w, k0)
-    cand, n_cand, _overflow = timed(
+    cand, n_cand, _overflow, r_skip, r_total = timed(
         "rounds", rounds_fn, X, w, l_dev, cand, mind0, n_rounds, key)
     cand, n_cand, cw = timed(
         "weights", weights_fn, X, w, cand, n_cand, k_extra)
@@ -1032,6 +1497,11 @@ def measure_init_phases(X, w, n_clusters: int, key,
         "effective_gbps": {
             p: traffic[p] / max(phases[p], 1e-9) / 1e9 for p in phases},
         "fused": fused,
+        # norm-filter pruning of the rounds' incremental min-distance
+        # update (see _init_rounds_phase): fraction of (row, round) pairs
+        # whose distance work the reverse-triangle bound skipped
+        "round_skip_ratio": (float(jax.device_get(r_skip))
+                             / max(float(jax.device_get(r_total)), 1.0)),
     }
 
 
@@ -1069,10 +1539,12 @@ def init_scalable(
         mesh=mesh, kernel=kernel)
     # ONE host round trip, for observability only (centers stay on device);
     # also serves as the init-phase completion barrier for phase timing.
-    n_rounds, n_cand, phi0, overflow = jax.device_get(aux)
+    n_rounds, n_cand, phi0, overflow, r_skip, r_total = jax.device_get(aux)
     logger.info(
-        "k-means|| init: phi0=%.4g, %d rounds, %d candidates",
-        float(phi0), int(n_rounds), int(n_cand))
+        "k-means|| init: phi0=%.4g, %d rounds, %d candidates, "
+        "round skip ratio %.3f",
+        float(phi0), int(n_rounds), int(n_cand),
+        float(r_skip) / max(float(r_total), 1.0))
     if int(overflow) > 0:
         logger.warning(
             "k-means|| round drew %d candidates beyond the per-round cap "

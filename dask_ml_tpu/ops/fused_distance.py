@@ -19,8 +19,28 @@ slots never need a ``jnp.inf`` re-masking pass over an (n × m) matrix):
 
 - :func:`fused_rowwise_min` — per-row min squared distance.
 - :func:`fused_argmin_min` — per-row (argmin index, min squared distance).
+- :func:`fused_argmin_min2` — per-row (argmin index, min squared distance,
+  SECOND-best squared distance) — the seeding primitive for Elkan/Yinyang
+  center-movement bounds (models/kmeans.py ``lloyd_loop_bounded``): the
+  best distance seeds the upper bound, the second-best seeds every group
+  lower bound.
 - :func:`fused_argmin_weight` — per-row argmin plus the per-target sum of
   row weights (the candidate-weighting / M-step-count contraction).
+
+Row-level work skipping (``row_need=``): :func:`fused_rowwise_min` and
+:func:`fused_argmin_min2` accept an optional boolean ``row_need`` over X
+rows. The distance work is then skipped BLOCK-wise — X streams through in
+``_FUSED_BLK``-row blocks, and a block none of whose rows need evaluation
+never pays for its distance pass: the XLA path runs a ``lax.map`` over row
+blocks with a scalar ``lax.cond`` per block (the batched-cells freeze
+precedent — map keeps the predicate scalar, so skipped blocks genuinely
+don't execute the matmul), and the pallas path predicates each grid step
+with ``pl.when``. Skipped rows return the identity of the consumer's
+reduction (``+inf`` for the incremental-min consumer, zeros for the
+argmin consumers — overlay with :func:`row_block_evaluated`). This is the
+mechanism the bound-maintaining Lloyd loop and the k-means|| rounds use
+to not compute distances for rows whose bounds prove the answer
+unchanged (docs/kernels.md, "Bound-based pruning").
 
 Each has three implementations selected by ``kernel=``:
 
@@ -169,6 +189,97 @@ def _argmin_min_ref(X, Y, mask):
     return idx, mind
 
 
+def _argmin_min2_ref(X, Y, mask):
+    """(argmin, min d², second-best d²) — the reduction scores' best value
+    and the best value with the argmin column masked out. With m == 1 (or
+    everything-but-best masked) the second-best is ``+inf``, the natural
+    "no competitor" value: a bound seeded from it never forces a
+    re-evaluation."""
+    s = _scores_ref(X, Y, mask)
+    idx = jnp.argmin(s, axis=1).astype(jnp.int32)
+    m = Y.shape[0]
+    s2 = jnp.where(jnp.arange(m, dtype=jnp.int32)[None, :] == idx[:, None],
+                   jnp.inf, s)
+    x2 = _row_sumsq(X)
+    mind = jnp.maximum(jnp.min(s, axis=1) + x2, 0.0)
+    mind2 = jnp.maximum(jnp.min(s2, axis=1) + x2, 0.0)
+    return idx, mind, mind2
+
+
+def _row_blocks(n: int):
+    """(n_blocks, padded_n) for the ``row_need`` blocking — one definition
+    shared by the XLA blocked path, the pallas grid, and
+    :func:`row_block_evaluated`, so "which rows share a skip decision" can
+    never diverge between implementations."""
+    blk = _FUSED_BLK
+    nb = (n + blk - 1) // blk
+    return nb, nb * blk
+
+
+def row_block_evaluated(row_need):
+    """Per-row "this row's block was evaluated" mask for a ``row_need``
+    vector: True for every row sharing a ``_FUSED_BLK`` block with at
+    least one needed row. Consumers overlay block-skipped outputs with
+    their carried values through exactly this mask — evaluated blocks
+    recompute ALL their rows (the recomputed values are the full
+    answers, so overwriting un-needed rows in an evaluated block is free
+    tightening, never a wrong value)."""
+    n = row_need.shape[0]
+    nb, n_pad = _row_blocks(n)
+    need = row_need
+    if n_pad != n:
+        need = jnp.pad(need, (0, n_pad - n))
+    ev = jnp.any(need.reshape(nb, _FUSED_BLK), axis=1)
+    return jnp.repeat(ev, _FUSED_BLK)[:n]
+
+
+def _blocked_xla(X, Y, mask, row_need, epilogue: str):
+    """The XLA row-skipping lowering: ``lax.map`` over ``_FUSED_BLK``-row
+    blocks with a scalar ``lax.cond`` per block, so a fully-skippable
+    block's distance matmul genuinely does not execute (the
+    `_batched_cells_impl` freeze precedent — under ``vmap`` the cond would
+    lower to a both-branches select and skip nothing). Evaluated blocks
+    run the SAME reference expression as the unskipped path on their row
+    slice, so evaluated rows reproduce the full-array answer; skipped
+    blocks return the consumer's reduction identity (+inf for ``min``,
+    zeros for ``argmin_min2`` — overlaid via :func:`row_block_evaluated`).
+    """
+    n, d = X.shape
+    nb, n_pad = _row_blocks(n)
+    blk = _FUSED_BLK
+    Xp = jnp.pad(X, ((0, n_pad - n), (0, 0))) if n_pad != n else X
+    needp = (jnp.pad(row_need, (0, n_pad - n))
+             if n_pad != n else row_need)
+    Xb = Xp.reshape(nb, blk, d)
+    needb = needp.reshape(nb, blk)
+
+    if epilogue == "min":
+        def one(args):
+            xb, nd = args
+            return jax.lax.cond(
+                jnp.any(nd),
+                lambda x: _min_ref(x, Y, mask),
+                lambda x: jnp.full((blk,), jnp.inf, jnp.float32),
+                xb)
+
+        out = jax.lax.map(one, (Xb, needb))
+        return out.reshape(-1)[:n]
+
+    def one(args):
+        xb, nd = args
+        return jax.lax.cond(
+            jnp.any(nd),
+            lambda x: _argmin_min2_ref(x, Y, mask),
+            lambda x: (jnp.zeros((blk,), jnp.int32),
+                       jnp.zeros((blk,), jnp.float32),
+                       jnp.zeros((blk,), jnp.float32)),
+            xb)
+
+    idx, mind, mind2 = jax.lax.map(one, (Xb, needb))
+    return (idx.reshape(-1)[:n], mind.reshape(-1)[:n],
+            mind2.reshape(-1)[:n])
+
+
 def _argmin_weight_ref(X, w, Y, mask):
     s = _scores_ref(X, Y, mask)
     idx = jnp.argmin(s, axis=1).astype(jnp.int32)
@@ -190,7 +301,7 @@ def _argmin_weight_ref(X, w, Y, mask):
 # ---------------------------------------------------------------------------
 
 
-def _fused_pallas(X, Y, maskf, w2d, epilogue: str):
+def _fused_pallas(X, Y, maskf, w2d, epilogue: str, need2d=None):
     """One pass over row blocks of X with the whole (m, d) Y resident in
     VMEM. Per block: scores on the MXU in (m, blk) layout (m on sublanes —
     the block's minor dim stays the 128-lane-aligned ``blk``), then the
@@ -200,7 +311,11 @@ def _fused_pallas(X, Y, maskf, w2d, epilogue: str):
     revisited output blocks would serialize the loop on tiny DMAs).
 
     ``maskf`` is the (m, 1) f32 validity mask (1=real row); ``w2d`` the
-    (1, n) f32 row weights (``epilogue='argmin_weight'`` only).
+    (1, n) f32 row weights (``epilogue='argmin_weight'`` only); ``need2d``
+    the optional (1, n) f32 row-need vector (``'min'``/``'argmin_min2'``
+    only): grid steps none of whose rows need evaluation skip the matmul +
+    epilogue under ``pl.when`` and write the reduction identity instead —
+    only the tiny need-block read reaches VMEM for a skipped block.
     """
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -214,31 +329,108 @@ def _fused_pallas(X, Y, maskf, w2d, epilogue: str):
     def kernel(y_ref, y2_ref, mask_ref, x_ref, *rest):
         if epilogue == "argmin_weight":
             w_ref, am_ref, cw_ref, acc_cw = rest
+        elif epilogue == "argmin_min2":
+            if need2d is not None:
+                need_ref, am_ref, mn_ref, mn2_ref = rest
+            else:
+                am_ref, mn_ref, mn2_ref = rest
         elif epilogue == "argmin_min":
             am_ref, mn_ref = rest
         else:  # "min"
-            (mn_ref,) = rest
+            if need2d is not None:
+                need_ref, mn_ref = rest
+            else:
+                (mn_ref,) = rest
         i = pl.program_id(0)
 
-        Yb = y_ref[:]  # (m, d), X's compute dtype
         col = i * blk + jax.lax.broadcasted_iota(jnp.int32, (1, blk), 1)
         valid_col = col < n
-        # zero OOB columns of the final partial block with a SELECT: their
-        # contents are undefined (NaN in interpret mode) and 0·NaN = NaN
-        # would survive a multiplicative mask into the matmul contraction
-        Xb = jnp.where(
-            jax.lax.broadcasted_iota(jnp.int32, (blk, 1), 0) + i * blk < n,
-            x_ref[:], 0)  # (blk, d)
 
-        # |y|² arrives precomputed in f32 from the ORIGINAL Y (same
-        # convention as _scores_ref — see its precision-audit note), so a
-        # bf16 compute dtype never degrades the norm term of the score
-        y2 = y2_ref[:]  # (m, 1) f32
-        prod = jax.lax.dot_general(
-            Yb, Xb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # (m, blk) on the MXU
-        scores = y2 - 2.0 * prod
-        scores = jnp.where(mask_ref[:] > 0, scores, jnp.inf)
+        if need2d is not None:
+            # OOB columns of the final partial need block are undefined —
+            # select them to 0 before the any-reduction (0·NaN discipline)
+            needv = jnp.where(valid_col, need_ref[:], 0.0)  # (1, blk)
+            evaluate = jnp.sum(needv) > 0.0
+
+            @pl.when(jnp.logical_not(evaluate))
+            def _():
+                # reduction identities for a skipped block: +inf for the
+                # incremental-min consumer (minimum(prev, inf) is a
+                # no-op), zeros for the argmin consumer (overlaid via
+                # row_block_evaluated)
+                if epilogue == "min":
+                    mn_ref[:] = jnp.full_like(mn_ref, jnp.inf)
+                else:
+                    am_ref[:] = jnp.zeros_like(am_ref)
+                    mn_ref[:] = jnp.zeros_like(mn_ref)
+                    mn2_ref[:] = jnp.zeros_like(mn2_ref)
+
+        def block_scores():
+            # the ONE definition of the block's masked scores, shared by
+            # every epilogue (drift here is exactly the divergence the
+            # module's single-definition discipline forbids)
+            Yb = y_ref[:]  # (m, d), X's compute dtype
+            # zero OOB columns of the final partial block with a SELECT:
+            # their contents are undefined (NaN in interpret mode) and
+            # 0·NaN = NaN would survive a multiplicative mask into the
+            # matmul contraction
+            Xb = jnp.where(
+                jax.lax.broadcasted_iota(jnp.int32, (blk, 1), 0)
+                + i * blk < n,
+                x_ref[:], 0)  # (blk, d)
+
+            # |y|² arrives precomputed in f32 from the ORIGINAL Y (same
+            # convention as _scores_ref — see its precision-audit note),
+            # so a bf16 compute dtype never degrades the norm term
+            y2 = y2_ref[:]  # (m, 1) f32
+            prod = jax.lax.dot_general(
+                Yb, Xb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # (m, blk) on the MXU
+            scores = y2 - 2.0 * prod
+            scores = jnp.where(mask_ref[:] > 0, scores, jnp.inf)
+            return Xb, scores
+
+        def row_x2(Xb):
+            # per-row |x|² as a ones-matmul, f32 — the SAME op order as
+            # _row_sumsq so values match the reference bit-for-bit where
+            # exact
+            ones = jnp.ones((1, d), jnp.float32)
+            Xf = Xb.astype(jnp.float32)
+            return jax.lax.dot_general(
+                ones, Xf * Xf, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # (1, blk)
+
+        def compute():
+            Xb, scores = block_scores()
+
+            if epilogue == "argmin_min2":
+                best = jnp.argmin(scores, axis=0, keepdims=True)  # (1, blk)
+                am_ref[:] = best.astype(jnp.int32)
+                kiota = jax.lax.broadcasted_iota(jnp.int32, (m, blk), 0)
+                s2 = jnp.where(kiota == best, jnp.inf, scores)
+                x2 = row_x2(Xb)
+                mn_ref[:] = jnp.maximum(
+                    jnp.min(scores, axis=0, keepdims=True) + x2, 0.0)
+                mn2_ref[:] = jnp.maximum(
+                    jnp.min(s2, axis=0, keepdims=True) + x2, 0.0)
+                return
+
+            if epilogue == "argmin_min":
+                best = jnp.argmin(scores, axis=0, keepdims=True)
+                am_ref[:] = best.astype(jnp.int32)
+            # min value: add the per-row |x|² back, clamp cancellation at 0
+            x2 = row_x2(Xb)
+            mn_ref[:] = jnp.maximum(
+                jnp.min(scores, axis=0, keepdims=True) + x2, 0.0)
+
+        if epilogue in ("min", "argmin_min2") and need2d is not None:
+            pl.when(evaluate)(compute)
+            return
+        if epilogue in ("min", "argmin_min", "argmin_min2"):
+            compute()
+            return
+
+        _, scores = block_scores()
 
         if epilogue == "argmin_weight":
             best = jnp.argmin(scores, axis=0, keepdims=True)  # (1, blk)
@@ -260,20 +452,6 @@ def _fused_pallas(X, Y, maskf, w2d, epilogue: str):
                 # matching the reference's final where(mask, cw, 0)
                 cw_ref[:] = acc_cw[:] * jnp.minimum(mask_ref[:], 1.0)
             return
-
-        if epilogue == "argmin_min":
-            best = jnp.argmin(scores, axis=0, keepdims=True)
-            am_ref[:] = best.astype(jnp.int32)
-        # min value: add the per-row |x|² back (ones-matmul, f32 — the
-        # SAME op order as _row_sumsq so values match the reference
-        # bit-for-bit where exact), clamp cancellation at 0
-        ones = jnp.ones((1, d), jnp.float32)
-        Xf = Xb.astype(jnp.float32)
-        x2 = jax.lax.dot_general(
-            ones, Xf * Xf, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # (1, blk)
-        mn_ref[:] = jnp.maximum(
-            jnp.min(scores, axis=0, keepdims=True) + x2, 0.0)
 
     y_spec = pl.BlockSpec((m, d), lambda i: (0, 0), memory_space=pltpu.VMEM)
     col_spec = pl.BlockSpec((m, 1), lambda i: (0, 0),
@@ -318,14 +496,38 @@ def _fused_pallas(X, Y, maskf, w2d, epilogue: str):
             interpret=interpret,
         )(Yc, y2f, maskf, X)
         return am[0], mn[0]
+    if epilogue == "argmin_min2":
+        in_specs = [y_spec, col_spec, col_spec, x_spec]
+        args = [Yc, y2f, maskf, X]
+        if need2d is not None:
+            in_specs.append(row_spec)
+            args.append(need2d)
+        am, mn, mn2 = pl.pallas_call(
+            kernel,
+            grid=(grid,),
+            in_specs=in_specs,
+            out_specs=[row_spec, row_spec, row_spec],
+            out_shape=[
+                jax.ShapeDtypeStruct((1, n), jnp.int32),
+                jax.ShapeDtypeStruct((1, n), jnp.float32),
+                jax.ShapeDtypeStruct((1, n), jnp.float32),
+            ],
+            interpret=interpret,
+        )(*args)
+        return am[0], mn[0], mn2[0]
+    in_specs = [y_spec, col_spec, col_spec, x_spec]
+    args = [Yc, y2f, maskf, X]
+    if need2d is not None:
+        in_specs.append(row_spec)
+        args.append(need2d)
     mn = pl.pallas_call(
         kernel,
         grid=(grid,),
-        in_specs=[y_spec, col_spec, col_spec, x_spec],
+        in_specs=in_specs,
         out_specs=row_spec,
         out_shape=jax.ShapeDtypeStruct((1, n), jnp.float32),
         interpret=interpret,
-    )(Yc, y2f, maskf, X)
+    )(*args)
     return mn[0]
 
 
@@ -340,26 +542,63 @@ def _maskf(mask, m):
 # ---------------------------------------------------------------------------
 
 
-def fused_rowwise_min(X, Y, mask=None, *, kernel: str = "auto", mesh=None):
+def fused_rowwise_min(X, Y, mask=None, *, kernel: str = "auto", mesh=None,
+                      row_need=None):
     """Per-row ``min_j d²(x_i, y_j)`` over valid Y rows, shape (n,) f32.
 
     Masked rows score +inf; all-masked returns +inf per row (so an
     incremental-min consumer's ``jnp.minimum(prev, ...)`` is a no-op for
     empty rounds). ``mesh`` wraps the pallas path in ``shard_map`` over
-    the data axis for row-sharded X (see module docstring)."""
+    the data axis for row-sharded X (see module docstring).
+
+    ``row_need`` (optional (n,) bool) enables BLOCK-wise row skipping:
+    ``_FUSED_BLK``-row blocks with no needed row never execute their
+    distance pass and return ``+inf`` for every row (the incremental-min
+    identity — a skipped row's ``jnp.minimum(prev, out)`` keeps ``prev``
+    exactly). Rows sharing a block with a needed row are evaluated and
+    return the full answer. With a mesh, ``row_need`` is sharded with X
+    and the skip decisions are per-shard blocks."""
     m, d = Y.shape
-    if not _use_pallas(kernel, X.shape[0], m, d, X.dtype, mesh):
-        return _min_ref(X, Y, mask)
+    use_pallas = _use_pallas(kernel, X.shape[0], m, d, X.dtype, mesh)
+    if row_need is None:
+        if not use_pallas:
+            return _min_ref(X, Y, mask)
+        maskf = _maskf(mask, m)
+        if mesh is None:
+            return _fused_pallas(X, Y, maskf, None, "min")
+        from dask_ml_tpu.parallel.mesh import DATA_AXIS, shard_map
+
+        fn = shard_map(
+            lambda Xl, Yl, ml: _fused_pallas(Xl, Yl, ml, None, "min"),
+            mesh=mesh, in_specs=(P(DATA_AXIS, None), P(), P()),
+            out_specs=P(DATA_AXIS), check_vma=False)
+        return fn(X, Y, maskf)
     maskf = _maskf(mask, m)
+    if not use_pallas:
+        if mesh is None:
+            return _blocked_xla(X, Y, mask, row_need, "min")
+        # the blocked lax.map must run PER SHARD (a global block any()
+        # would all-reduce per block under GSPMD) — same shard_map shape
+        # as the pallas path
+        from dask_ml_tpu.parallel.mesh import DATA_AXIS, shard_map
+
+        fn = shard_map(
+            lambda Xl, nl: _blocked_xla(Xl, Y, mask, nl, "min"),
+            mesh=mesh, in_specs=(P(DATA_AXIS, None), P(DATA_AXIS)),
+            out_specs=P(DATA_AXIS), check_vma=False)
+        return fn(X, row_need)
+    need2d = row_need.astype(jnp.float32)[None, :]
     if mesh is None:
-        return _fused_pallas(X, Y, maskf, None, "min")
+        return _fused_pallas(X, Y, maskf, None, "min", need2d=need2d)
     from dask_ml_tpu.parallel.mesh import DATA_AXIS, shard_map
 
     fn = shard_map(
-        lambda Xl, Yl, ml: _fused_pallas(Xl, Yl, ml, None, "min"),
-        mesh=mesh, in_specs=(P(DATA_AXIS, None), P(), P()),
+        lambda Xl, Yl, ml, nl: _fused_pallas(Xl, Yl, ml, None, "min",
+                                             need2d=nl),
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(), P(), P(None, DATA_AXIS)),
         out_specs=P(DATA_AXIS), check_vma=False)
-    return fn(X, Y, maskf)
+    return fn(X, Y, maskf, need2d)
 
 
 def fused_argmin_min(X, Y, mask=None, *, kernel: str = "auto", mesh=None):
@@ -379,6 +618,69 @@ def fused_argmin_min(X, Y, mask=None, *, kernel: str = "auto", mesh=None):
         mesh=mesh, in_specs=(P(DATA_AXIS, None), P(), P()),
         out_specs=(P(DATA_AXIS), P(DATA_AXIS)), check_vma=False)
     return fn(X, Y, maskf)
+
+
+def fused_argmin_min2(X, Y, mask=None, *, kernel: str = "auto", mesh=None,
+                      row_need=None):
+    """Per-row (argmin index int32, min squared distance f32, SECOND-best
+    squared distance f32) over valid Y rows — the bound-seeding primitive:
+    the best distance seeds an Elkan-style upper bound on the assigned
+    center, the second-best seeds the lower bound of every Yinyang center
+    group (the global second-best lower-bounds the per-group minimum over
+    non-assigned centers for every group at once — see
+    models/kmeans.py ``lloyd_loop_bounded``).
+
+    Same contracts as the rest of the family: ties break to the lowest
+    index identically across implementations, masked Y rows never win,
+    all-masked returns (0, +inf, +inf), a single valid row returns
+    second-best ``+inf`` (no competitor — a bound seeded from it never
+    forces re-evaluation). ``row_need`` enables block-wise row skipping:
+    blocks with no needed row skip the distance pass and return zeros —
+    overlay skipped rows with carried values via
+    :func:`row_block_evaluated`."""
+    m, d = Y.shape
+    use_pallas = _use_pallas(kernel, X.shape[0], m, d, X.dtype, mesh)
+    if row_need is None:
+        if not use_pallas:
+            return _argmin_min2_ref(X, Y, mask)
+        maskf = _maskf(mask, m)
+        if mesh is None:
+            return _fused_pallas(X, Y, maskf, None, "argmin_min2")
+        from dask_ml_tpu.parallel.mesh import DATA_AXIS, shard_map
+
+        fn = shard_map(
+            lambda Xl, Yl, ml: _fused_pallas(Xl, Yl, ml, None,
+                                             "argmin_min2"),
+            mesh=mesh, in_specs=(P(DATA_AXIS, None), P(), P()),
+            out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+            check_vma=False)
+        return fn(X, Y, maskf)
+    if not use_pallas:
+        if mesh is None:
+            return _blocked_xla(X, Y, mask, row_need, "argmin_min2")
+        from dask_ml_tpu.parallel.mesh import DATA_AXIS, shard_map
+
+        fn = shard_map(
+            lambda Xl, nl: _blocked_xla(Xl, Y, mask, nl, "argmin_min2"),
+            mesh=mesh, in_specs=(P(DATA_AXIS, None), P(DATA_AXIS)),
+            out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+            check_vma=False)
+        return fn(X, row_need)
+    maskf = _maskf(mask, m)
+    need2d = row_need.astype(jnp.float32)[None, :]
+    if mesh is None:
+        return _fused_pallas(X, Y, maskf, None, "argmin_min2",
+                             need2d=need2d)
+    from dask_ml_tpu.parallel.mesh import DATA_AXIS, shard_map
+
+    fn = shard_map(
+        lambda Xl, Yl, ml, nl: _fused_pallas(Xl, Yl, ml, None,
+                                             "argmin_min2", need2d=nl),
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(), P(), P(None, DATA_AXIS)),
+        out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        check_vma=False)
+    return fn(X, Y, maskf, need2d)
 
 
 def fused_argmin_weight(X, w, Y, mask=None, *, kernel: str = "auto",

@@ -70,6 +70,7 @@ __all__ = [
     "BF16",
     "resolve",
     "state_dtype",
+    "lloyd_bounds_dtype",
     "pdot",
     "pmatmul",
     "neumaier_add",
@@ -218,6 +219,30 @@ def state_dtype(data_dtype, accum=jnp.float32):
     if acc in (jnp.dtype(t) for t in _LOW_PRECISION):
         acc = jnp.dtype(jnp.float32)
     return jnp.promote_types(floor, acc)
+
+
+def lloyd_bounds_dtype(data_dtype, policy=None):
+    """Dtype of the bounded-Lloyd bound state (the ``ub``/``lb`` carries of
+    :func:`dask_ml_tpu.models.kmeans.lloyd_loop_bounded`) under the active
+    policy: the ``"lloyd_bounds"`` op override when the policy sets one,
+    else :func:`state_dtype` of the data dtype — and in EITHER case never
+    below f32. The override can only *raise* the floor (e.g. f64 bounds
+    for a paranoid audit policy): bounds are solver state whose entire job
+    is out-resolving FP noise on distances, so the bf16 wire policy must
+    not narrow them (``lloyd_bounds: bf16`` still yields f32 — the same
+    silent-low-precision-state case :func:`state_dtype` closes).
+
+    Resolved at FACADE level like every policy read (the bound dtype
+    enters the jitted loop as a static argument, so the compile-once rule
+    holds: flipping the policy changes the signature and recompiles the
+    loop exactly once, never a stale-cache wrong answer).
+    """
+    p = resolve() if policy is None else policy
+    base = state_dtype(data_dtype, accum=p.accum)
+    override = p.compute_for("lloyd_bounds")
+    if override is None:
+        return base
+    return jnp.promote_types(state_dtype(override), base)
 
 
 # ---------------------------------------------------------------------------

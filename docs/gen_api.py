@@ -31,8 +31,10 @@ SECTIONS = [
      "Wrap any scikit-learn-compatible estimator for sharded prediction "
      "or streamed (incremental) training."),
     ("dask_ml_tpu.cluster", "Clustering",
-     "Scalable KMeans (k-means|| + fused Lloyd), Nyström spectral "
-     "clustering, and streaming mini-batch KMeans."),
+     "Scalable KMeans (k-means|| + fused Lloyd, with bound-based "
+     "Elkan/Yinyang pruning via `algorithm='bounded'` — see "
+     "docs/kernels.md), Nyström spectral clustering, and streaming "
+     "mini-batch KMeans."),
     ("dask_ml_tpu.decomposition", "Matrix Decomposition",
      "PCA / TruncatedSVD via distributed tall-skinny QR and randomized "
      "SVD."),
@@ -89,7 +91,8 @@ EXTRA = {
         "pairwise_kernels",
     ],
     "dask_ml_tpu.ops.fused_distance": [
-        "fused_rowwise_min", "fused_argmin_min", "fused_argmin_weight",
+        "fused_rowwise_min", "fused_argmin_min", "fused_argmin_min2",
+        "fused_argmin_weight", "row_block_evaluated",
     ],
     "dask_ml_tpu.parallel.shapes": [
         "PadPolicy", "active_policy", "bucket_rows", "pad_tail",
@@ -97,8 +100,8 @@ EXTRA = {
         "enable_persistent_cache",
     ],
     "dask_ml_tpu.parallel.precision": [
-        "PrecisionPolicy", "resolve", "state_dtype", "pdot", "pmatmul",
-        "neumaier_add", "neumaier_sum", "cast_wire",
+        "PrecisionPolicy", "resolve", "state_dtype", "lloyd_bounds_dtype",
+        "pdot", "pmatmul", "neumaier_add", "neumaier_sum", "cast_wire",
     ],
     "dask_ml_tpu.datasets": ["make_blobs", "make_regression",
                              "make_classification", "make_counts"],

@@ -1,0 +1,335 @@
+"""Bound-based Lloyd acceleration (models/kmeans.py lloyd_loop_bounded):
+the existing loops are the bit-compatible oracles — bounded runs must
+converge to bit-identical centers/assignments/inertia while skipping
+distance work — plus the bound invariants themselves (upper ≥ true ≥
+group lower after every iteration, against an unrolled numpy oracle),
+checkpoint/resume of the extended carry, and the estimator's
+``algorithm=`` knob."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dask_ml_tpu import datasets
+from dask_ml_tpu.cluster import KMeans
+from dask_ml_tpu.models import kmeans as core
+from dask_ml_tpu.ops import fused_distance as fd
+
+
+@pytest.fixture
+def small_blocks():
+    """Shrink the row-skip block so small test inputs produce multi-block
+    need grids (the skip decision is per block; one block would hide
+    cross-block regressions). Opt-in per test — the block size is baked
+    into traced programs, so caches must be cleared around it."""
+    old = fd._FUSED_BLK
+    fd._FUSED_BLK = 128
+    jax.clear_caches()
+    yield
+    fd._FUSED_BLK = old
+    jax.clear_caches()
+
+
+def _kdd_shaped(n=20_000, d=41, seed=0):
+    """KDD-character synthetic: imbalanced cluster mass, per-feature
+    scales spanning orders of magnitude (the bench_kdd stand-in's recipe
+    at test scale)."""
+    rng = np.random.RandomState(seed)
+    k_true = 9
+    centers = rng.randn(k_true, d) * np.exp(rng.randn(1, d) * 1.2)
+    ids = rng.choice(k_true, size=n, p=np.exp(-0.4 * np.arange(k_true))
+                     / np.exp(-0.4 * np.arange(k_true)).sum())
+    X = centers[ids] + rng.randn(n, d) * 0.3
+    return X.astype(np.float32)
+
+
+@pytest.mark.parametrize("kernel", ["xla", "pallas"])
+def test_bounded_matches_oracle_replicated(kernel, small_blocks):
+    """Replicated bounded loop vs the lloyd_loop oracle: bit-identical
+    centers, stopping iteration, shift, labels, and (re-evaluated)
+    inertia — for the XLA block-skip path and the interpret-mode pallas
+    path alike."""
+    n = 4000 if kernel == "xla" else 1500
+    X = jnp.asarray(_kdd_shaped(n=n, d=7, seed=1))
+    w = jnp.ones((n,), jnp.float32)
+    c0 = core.init_random(X, w, n, 6, jax.random.key(0))
+    tol = jnp.asarray(1e-6, jnp.float32)
+    co, _, no, so = core.lloyd_loop(X, w, c0, tol, max_iter=40)
+    cb, ib, nb, sb, lb, stats = core.lloyd_loop_bounded(
+        X, w, c0, tol, max_iter=40, kernel=kernel)
+    np.testing.assert_array_equal(np.asarray(co), np.asarray(cb))
+    assert int(no) == int(nb) and float(so) == float(sb)
+    # inertia/labels are the post-loop re-assignment against the final
+    # centers — the same expression compute_inertia/predict_labels run
+    assert float(ib) == float(core.compute_inertia(X, w, co))
+    np.testing.assert_array_equal(np.asarray(lb),
+                                  np.asarray(core.predict_labels(X, co)))
+    # the bounds actually did something: by late iterations most rows'
+    # bounds hold
+    held = np.asarray(stats["bounds_held"])[: int(nb)]
+    assert held[-1] > 0.8 * n
+
+
+@pytest.mark.parametrize("kernel", ["xla", "pallas"])
+def test_bounded_matches_oracle_mesh(kernel, any_mesh, small_blocks):
+    """Sharded bounded loop vs the lloyd_loop_fused oracle on 1/3/8-device
+    meshes (3 exercises shard padding): bit-identical centers and
+    stopping, identical labels, identical re-evaluated inertia."""
+    from dask_ml_tpu.parallel.sharding import prepare_data
+
+    X = _kdd_shaped(n=2400, d=6, seed=2)
+    rng = np.random.RandomState(3)
+    sw = rng.uniform(0.5, 2.0, X.shape[0]).astype(np.float32)
+    data = prepare_data(X, sample_weight=sw, mesh=any_mesh)
+    c0 = core.init_random(data.X, data.weights, data.n, 5, jax.random.key(1))
+    tol = jnp.asarray(1e-6, jnp.float32)
+    of = core.lloyd_loop_fused(data.X, data.weights, c0, tol,
+                               mesh=any_mesh, max_iter=30, kernel="xla")
+    ob = core.lloyd_loop_bounded(data.X, data.weights, c0, tol,
+                                 mesh=any_mesh, max_iter=30, kernel=kernel)
+    np.testing.assert_array_equal(np.asarray(of[0]), np.asarray(ob[0]))
+    assert int(of[2]) == int(ob[2])
+    assert (float(core.compute_inertia(data.X, data.weights, of[0]))
+            == float(core.compute_inertia(data.X, data.weights, ob[0])))
+    np.testing.assert_array_equal(
+        np.asarray(ob[4]), np.asarray(core.predict_labels(data.X, ob[0])))
+
+
+def test_bounded_prune_off_is_identical(small_blocks):
+    """prune=False evaluates everything yet maintains bounds — the
+    trajectory AND the returned tuple must match prune=True bitwise
+    (pruning only removes work whose outcome the bounds prove)."""
+    X = jnp.asarray(_kdd_shaped(n=3000, d=5, seed=4))
+    w = jnp.ones((3000,), jnp.float32)
+    c0 = core.init_random(X, w, 3000, 6, jax.random.key(2))
+    tol = jnp.asarray(0.0, jnp.float32)
+    a = core.lloyd_loop_bounded(X, w, c0, tol, max_iter=15, prune=True)
+    b = core.lloyd_loop_bounded(X, w, c0, tol, max_iter=15, prune=False)
+    np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
+    assert float(a[1]) == float(b[1])
+    np.testing.assert_array_equal(np.asarray(a[4]), np.asarray(b[4]))
+    assert int(np.asarray(b[5]["rows_skipped"]).sum()) == 0
+    assert int(np.asarray(a[5]["rows_skipped"]).sum()) > 0
+
+
+def test_bound_invariants_vs_unrolled_oracle(small_blocks):
+    """After EVERY iteration (driven one step at a time through
+    _bounded_chunk): ub_i ≥ d(x_i, c_{a_i}) and, per Yinyang group g,
+    lb_{i,g} ≤ min_{j∈g, j≠a_i} d(x_i, c_j) — checked against float64
+    numpy distances."""
+    n, d, k, G = 1200, 6, 12, 3
+    X = _kdd_shaped(n=n, d=d, seed=5)
+    Xd = jnp.asarray(X)
+    w = jnp.ones((n,), jnp.float32)
+    c0 = core.init_random(Xd, w, n, k, jax.random.key(3))
+    tol = jnp.asarray(0.0, jnp.float32)
+    _, size = core._bounded_groups(k, G)
+    gid = np.arange(k) // size
+    state = core._bounded_init_state(
+        jnp.asarray(c0), fd._row_blocks(n)[1], core._bounded_groups(k, G)[0],
+        12, jnp.dtype(jnp.float32))
+    for _ in range(12):
+        state = core._bounded_chunk(
+            Xd, w, state, tol, max_iter=12, chunk=1, kernel="xla",
+            groups=G, prune=True, bounds_dtype=jnp.float32)
+        centers = np.asarray(state[0], np.float64)
+        labels = np.asarray(state[1])[:n]
+        ub = np.asarray(state[2], np.float64)[:n]
+        lb = np.asarray(state[3], np.float64)[:n]
+        D = np.sqrt(np.maximum(
+            ((X.astype(np.float64)[:, None, :] - centers[None]) ** 2)
+            .sum(-1), 0.0))
+        d_assigned = D[np.arange(n), labels]
+        assert (ub >= d_assigned * (1 - 1e-6) - 1e-6).all()
+        for g in range(lb.shape[1]):
+            Dg = D[:, gid == g].copy()
+            own = gid[labels] == g
+            # exclude the assigned center from its own group's minimum
+            Dg[own, labels[own] - np.flatnonzero(gid == g)[0]] = np.inf
+            dmin = Dg.min(axis=1)
+            assert (lb[:, g] <= dmin * (1 + 1e-6) + 1e-6).all()
+
+
+def test_bounded_groups_rule():
+    assert core._bounded_groups(8, "auto") == (1, 8)
+    assert core._bounded_groups(100, "auto") == (10, 10)
+    assert core._bounded_groups(8, 4) == (4, 2)
+    assert core._bounded_groups(8, 100) == (8, 1)  # clipped to k
+    assert core._bounded_groups(1, "auto") == (1, 1)
+
+
+def test_bounded_auto_rule():
+    assert core._bounded_auto_wins(1 << 20, 8, 41)
+    assert not core._bounded_auto_wins(1 << 10, 8, 41)  # too small
+    assert not core._bounded_auto_wins(1 << 20, 2, 41)  # k too small
+
+
+def test_checkpoint_resume_bit_identical(tmp_path, small_blocks):
+    """Preempt the resumable bounded loop mid-run; the resumed trajectory
+    (centers, inertia, n_iter, stats) is bit-identical to uninterrupted."""
+    X = jnp.asarray(_kdd_shaped(n=2000, d=5, seed=6))
+    w = jnp.ones((2000,), jnp.float32)
+    c0 = core.init_random(X, w, 2000, 5, jax.random.key(4))
+    tol = jnp.asarray(0.0, jnp.float32)
+    ref = core.lloyd_loop_bounded(X, w, c0, tol, max_iter=20)
+    path = str(tmp_path / "lloyd.ckpt")
+
+    calls = {"n": 0}
+    orig = core._bounded_chunk
+
+    def dying(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise KeyboardInterrupt
+        return orig(*a, **k)
+
+    core._bounded_chunk = dying
+    try:
+        with pytest.raises(KeyboardInterrupt):
+            core.lloyd_bounded_resumable(X, w, c0, tol, max_iter=20,
+                                         path=path, chunk_iters=7)
+    finally:
+        core._bounded_chunk = orig
+    assert os.path.exists(path)
+    out = core.lloyd_bounded_resumable(X, w, c0, tol, max_iter=20,
+                                       path=path, chunk_iters=7)
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(ref[0]))
+    assert float(out[1]) == float(ref[1]) and int(out[2]) == int(ref[2])
+    np.testing.assert_array_equal(np.asarray(out[5]["rows_skipped"]),
+                                  np.asarray(ref[5]["rows_skipped"]))
+    assert not os.path.exists(path)  # deleted on completion
+
+
+def test_checkpoint_carry_version_mismatch_is_loud(tmp_path, small_blocks):
+    """A snapshot written under a different carry layout version must be a
+    loud error on resume, never a silently mis-shaped carry."""
+    X = jnp.asarray(_kdd_shaped(n=1500, d=4, seed=7))
+    w = jnp.ones((1500,), jnp.float32)
+    c0 = core.init_random(X, w, 1500, 4, jax.random.key(5))
+    tol = jnp.asarray(0.0, jnp.float32)
+    path = str(tmp_path / "lloyd.ckpt")
+
+    calls = {"n": 0}
+    orig = core._bounded_chunk
+
+    def dying(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise KeyboardInterrupt
+        return orig(*a, **k)
+
+    core._bounded_chunk = dying
+    try:
+        with pytest.raises(KeyboardInterrupt):
+            core.lloyd_bounded_resumable(X, w, c0, tol, max_iter=20,
+                                         path=path, chunk_iters=5)
+    finally:
+        core._bounded_chunk = orig
+    old = core.BOUNDED_CARRY_VERSION
+    core.BOUNDED_CARRY_VERSION = old + 1
+    try:
+        with pytest.raises(ValueError, match="carry_version"):
+            core.lloyd_bounded_resumable(X, w, c0, tol, max_iter=20,
+                                         path=path, chunk_iters=5)
+    finally:
+        core.BOUNDED_CARRY_VERSION = old
+
+
+# -- estimator knob ----------------------------------------------------------
+
+
+def test_estimator_bounded_matches_full(any_mesh):
+    """KMeans(algorithm='bounded') reproduces algorithm='full' exactly —
+    centers, labels, inertia, n_iter — through the whole fit (k-means||
+    init included), and exposes pruning counters."""
+    X = _kdd_shaped(n=3000, d=8, seed=8)
+    a = KMeans(n_clusters=5, random_state=0, algorithm="full").fit(X)
+    b = KMeans(n_clusters=5, random_state=0, algorithm="bounded").fit(X)
+    np.testing.assert_array_equal(a.cluster_centers_, b.cluster_centers_)
+    np.testing.assert_array_equal(a.labels_, b.labels_)
+    assert a.inertia_ == b.inertia_ and a.n_iter_ == b.n_iter_
+    assert not hasattr(a, "lloyd_pruning_")
+    p = b.lloyd_pruning_
+    assert p["rows_considered"] == b.n_iter_ * X.shape[0]
+    assert len(p["pruned_fraction_per_iter"]) == b.n_iter_
+    assert p["distances_avoided"] == p["rows_skipped"] * 5
+    # row-level bound-held counts dominate block-level skips by definition
+    assert (np.asarray(p["bound_held_fraction_per_iter"])
+            >= np.asarray(p["pruned_fraction_per_iter"]) - 1e-9).all()
+
+
+def test_estimator_algorithm_validation():
+    X = _kdd_shaped(n=200, d=4, seed=9)
+    with pytest.raises(ValueError, match="algorithm"):
+        KMeans(algorithm="bogus").fit(X)
+    # sklearn-style aliases accepted
+    KMeans(n_clusters=3, algorithm="lloyd", random_state=0,
+           init="random").fit(X)
+    KMeans(n_clusters=3, algorithm="elkan", random_state=0,
+           init="random").fit(X)
+
+
+def test_estimator_auto_dispatch(monkeypatch):
+    """algorithm='auto' consults the measured rule and routes accordingly
+    (spied via the core entry points)."""
+    X = _kdd_shaped(n=500, d=4, seed=10)
+    called = {}
+    orig_bounded = core.lloyd_loop_bounded
+    orig_fused = core.lloyd_loop_fused
+    monkeypatch.setattr(core, "lloyd_loop_bounded",
+                        lambda *a, **k: called.setdefault("bounded", True)
+                        and orig_bounded(*a, **k))
+    monkeypatch.setattr(core, "lloyd_loop_fused",
+                        lambda *a, **k: called.setdefault("full", True)
+                        and orig_fused(*a, **k))
+    KMeans(n_clusters=4, random_state=0, algorithm="auto",
+           init="random").fit(X)  # n below the auto threshold
+    assert called == {"full": True}
+    called.clear()
+    monkeypatch.setattr(core, "_bounded_auto_wins", lambda n, k, d: True)
+    KMeans(n_clusters=4, random_state=0, algorithm="auto",
+           init="random").fit(X)
+    assert called == {"bounded": True}
+
+
+def test_init_rounds_pruning_is_exact(any_mesh):
+    """The k-means|| rounds' norm-filter pruning: pruned and unpruned
+    rounds produce bit-identical candidate buffers and counts, and the
+    skip counters are observable through the init program's aux."""
+    from dask_ml_tpu.parallel.sharding import prepare_data
+
+    rng = np.random.RandomState(11)
+    X = rng.randint(-6, 6, (900, 5)).astype(np.float32)
+    data = prepare_data(X, mesh=any_mesh)
+    key = jax.random.key(0)
+    tol = jnp.asarray(0.0, jnp.float32)
+    seed_fn = lambda prune: jax.jit(  # noqa: E731
+        lambda X_, w_, l_, c_, m_, r_, k_: core._init_rounds_phase(
+            X_, w_, l_, c_, m_, r_, k_, max_rounds=5, max_cand=90, cap=16,
+            mesh=any_mesh, kernel="xla", prune=prune))
+    cand, mind0, phi0, n_rounds = core._init_seed_phase(
+        data.X, data.weights, jax.random.key(1), max_rounds=5, max_cand=90)
+    l_dev = jnp.asarray(16.0, jnp.float32)
+    out_p = seed_fn(True)(data.X, data.weights, l_dev, cand, mind0,
+                          n_rounds, key)
+    out_u = seed_fn(False)(data.X, data.weights, l_dev, cand, mind0,
+                           n_rounds, key)
+    np.testing.assert_array_equal(np.asarray(out_p[0]), np.asarray(out_u[0]))
+    assert int(out_p[1]) == int(out_u[1])
+    assert int(out_u[3]) == 0  # unpruned path reports zero skips
+    assert int(out_p[4]) > 0  # considered counter populated
+
+
+def test_measure_init_phases_reports_skip_ratio(mesh8):
+    from dask_ml_tpu.parallel.sharding import prepare_data
+    from dask_ml_tpu.utils.validation import check_random_state
+
+    X = _kdd_shaped(n=4000, d=6, seed=12)
+    data = prepare_data(X, mesh=mesh8)
+    rep = core.measure_init_phases(data.X, data.weights, 4,
+                                   check_random_state(0), mesh=mesh8)
+    assert 0.0 <= rep["round_skip_ratio"] <= 1.0

@@ -1,0 +1,117 @@
+"""TPU-native MiniBatchKMeans (cluster/minibatch.py): Sculley updates over
+the FUSED assignment kernel — the last distance-matrix consumer routed
+through ops/fused_distance.py — plus the sklearn-ish estimator contract
+and the streaming partial_fit state."""
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu import datasets
+from dask_ml_tpu.cluster import KMeans, MiniBatchKMeans
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    X, y = datasets.make_blobs(n_samples=4000, n_features=5, centers=4,
+                               cluster_std=0.6, random_state=0)
+    return np.asarray(X), np.asarray(y)
+
+
+def test_converges_near_full_kmeans(blobs):
+    X, _ = blobs
+    mb = MiniBatchKMeans(n_clusters=4, batch_size=512, max_iter=5,
+                         random_state=0).fit(X)
+    km = KMeans(n_clusters=4, random_state=0).fit(X)
+    # the streaming optimum lands within a few percent of full Lloyd on
+    # well-separated blobs
+    assert mb.inertia_ <= km.inertia_ * 1.10
+    assert mb.labels_.shape == (4000,)
+    assert mb.counts_.sum() == pytest.approx(mb.n_iter_ * 512)
+
+
+def test_assignment_routes_through_fused_family(blobs, monkeypatch):
+    """The minibatch assignment calls fused_argmin_min — no private
+    distance matrix (the PR-2 consumer contract)."""
+    import jax
+
+    from dask_ml_tpu.cluster import minibatch as mb_mod
+    from dask_ml_tpu.ops import fused_distance as fd
+
+    X, _ = blobs
+    calls = {"n": 0}
+    orig = fd.fused_argmin_min
+
+    def spy(*a, **k):
+        calls["n"] += 1
+        return orig(*a, **k)
+
+    monkeypatch.setattr(mb_mod, "fused_argmin_min", spy)
+    jax.clear_caches()  # the spy must be traced, not a cached program
+    try:
+        MiniBatchKMeans(n_clusters=4, batch_size=256, max_iter=1,
+                        random_state=0).fit(X)
+    finally:
+        jax.clear_caches()  # don't leak spy-traced programs to other tests
+    assert calls["n"] >= 1
+
+
+def test_predict_is_nearest_center(blobs):
+    from sklearn.metrics.pairwise import euclidean_distances as sk_euclidean
+
+    X, _ = blobs
+    mb = MiniBatchKMeans(n_clusters=4, batch_size=512, max_iter=3,
+                         random_state=0).fit(X)
+    labels = np.asarray(mb.predict(X))
+    d = sk_euclidean(X, mb.cluster_centers_)
+    np.testing.assert_array_equal(labels, d.argmin(axis=1))
+    np.testing.assert_allclose(np.asarray(mb.transform(X)), d,
+                               rtol=1e-3, atol=1e-3)
+    assert mb.score(X) == pytest.approx(-mb.inertia_, rel=1e-3)
+
+
+def test_partial_fit_streams_state(blobs):
+    X, _ = blobs
+    mb = MiniBatchKMeans(n_clusters=4, random_state=0)
+    mb.partial_fit(X[:1000])
+    c1 = mb.cluster_centers_.copy()
+    v1 = mb.counts_.sum()
+    mb.partial_fit(X[1000:2000])
+    assert mb.n_iter_ == 2
+    assert mb.counts_.sum() == pytest.approx(v1 + 1000)
+    assert not np.array_equal(c1, mb.cluster_centers_)  # centers moved
+    # second partial_fit must not re-init: a fresh estimator from the
+    # second batch alone lands elsewhere
+    fresh = MiniBatchKMeans(n_clusters=4, random_state=0)
+    fresh.partial_fit(X[1000:2000])
+    assert not np.array_equal(fresh.cluster_centers_, mb.cluster_centers_)
+
+
+def test_sample_weight_zero_rows_ignored(blobs):
+    X, _ = blobs
+    rng = np.random.RandomState(1)
+    outliers = rng.uniform(60, 70, size=(30, X.shape[1])).astype(np.float32)
+    Xo = np.vstack([X, outliers])
+    w = np.ones(len(Xo), dtype=np.float32)
+    w[len(X):] = 0.0
+    mb = MiniBatchKMeans(n_clusters=4, batch_size=512, max_iter=3,
+                         random_state=0).fit(Xo, sample_weight=w)
+    assert np.abs(mb.cluster_centers_).max() < 30.0
+
+
+def test_determinism_and_validation(blobs):
+    X, _ = blobs
+    a = MiniBatchKMeans(n_clusters=3, batch_size=256, max_iter=2,
+                        random_state=7).fit(X)
+    b = MiniBatchKMeans(n_clusters=3, batch_size=256, max_iter=2,
+                        random_state=7).fit(X)
+    np.testing.assert_array_equal(a.cluster_centers_, b.cluster_centers_)
+    with pytest.raises(ValueError):
+        MiniBatchKMeans(n_clusters=0).fit(X)
+    with pytest.raises(ValueError):
+        MiniBatchKMeans(batch_size=0).fit(X)
+    with pytest.raises(AttributeError, match="fit"):
+        MiniBatchKMeans().predict(X)
+
+
+def test_deprecated_partial_wrapper_still_importable():
+    from dask_ml_tpu.cluster import PartialMiniBatchKMeans  # noqa: F401
