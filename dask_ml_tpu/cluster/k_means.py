@@ -20,8 +20,8 @@ from sklearn.base import BaseEstimator, TransformerMixin
 from dask_ml_tpu.config import maybe_host
 from dask_ml_tpu.models import kmeans as core
 from dask_ml_tpu.ops.pairwise import euclidean_distances
+from dask_ml_tpu.parallel import telemetry
 from dask_ml_tpu.parallel.sharding import prepare_data, unpad_rows
-from dask_ml_tpu.utils._log import profile_phase
 from dask_ml_tpu.utils.validation import check_array, check_random_state
 
 logger = logging.getLogger(__name__)
@@ -118,26 +118,37 @@ class KMeans(TransformerMixin, BaseEstimator):
         t0 = tic()
         X = check_array(X)
         self._check_params(n_samples=int(X.shape[0]))
+        fit_span = telemetry.span(
+            "kmeans.fit", n=int(X.shape[0]), d=int(X.shape[1]),
+            k=int(self.n_clusters))
+        with fit_span as fsp:
+            return self._fit_instrumented(X, sample_weight, t0, fsp)
+
+    def _fit_instrumented(self, X, sample_weight, t0, fit_sp):
         data = prepare_data(X, sample_weight=sample_weight)
         key = check_random_state(self.random_state)
 
-        centers = core.k_init(
-            data.X,
-            data.weights,
-            data.n,
-            self.n_clusters,
-            key,
-            init=self.init,
-            oversampling_factor=self.oversampling_factor,
-            max_iter=self.init_max_iter,
-            mesh=data.mesh,
-        )
+        with telemetry.span(
+                "kmeans.init",
+                init=self.init if isinstance(self.init, str) else "array"):
+            centers = core.k_init(
+                data.X,
+                data.weights,
+                data.n,
+                self.n_clusters,
+                key,
+                init=self.init,
+                oversampling_factor=self.oversampling_factor,
+                max_iter=self.init_max_iter,
+                mesh=data.mesh,
+            )
         t_init = tic()
         logger.info("init (%s) finished in %.2fs", self.init, t_init - t0)
 
         tol = core.scaled_tolerance(data.X, data.weights, self.tol)
         bounded = self._use_bounded(data.n, data.n_features)
-        with profile_phase(logger, "kmeans-lloyd"):
+        with telemetry.span("kmeans-lloyd", logger=logger,
+                            algorithm="bounded" if bounded else "lloyd"):
             if bounded:
                 from dask_ml_tpu.parallel.precision import lloyd_bounds_dtype
 
@@ -155,12 +166,25 @@ class KMeans(TransformerMixin, BaseEstimator):
         # Recompute cost against the *final* centers so inertia_ is consistent
         # with cluster_centers_/labels_ and score(X) — the reference likewise
         # re-assigns after the loop (reference: cluster/k_means.py:504-507).
-        inertia = core.compute_inertia(data.X, data.weights, centers)
-        labels = core.predict_labels(data.X, centers)
+        with telemetry.span("kmeans.finalize"):
+            inertia = core.compute_inertia(data.X, data.weights, centers)
+            labels = core.predict_labels(data.X, centers)
+        t_lloyd_done = tic()
         logger.info(
             "Lloyd finished in %.2fs: %d iterations, inertia %.4g",
-            tic() - t_init, int(n_iter), float(inertia),
+            t_lloyd_done - t_init, int(n_iter), float(inertia),
         )
+        if telemetry.enabled():
+            # the whole Lloyd loop is ONE compiled while_loop — individual
+            # iteration walls are not host-observable, so the registry gets
+            # the iteration count plus the mean seconds/iteration per fit
+            # (a distribution ACROSS fits), and — for bounded runs below —
+            # the true per-iteration pruned-fraction histogram the loop's
+            # carried counters do expose
+            reg = telemetry.metrics()
+            reg.histogram("kmeans.lloyd.iterations").observe(int(n_iter))
+            reg.histogram("kmeans.lloyd.seconds_per_iter").observe(
+                (t_lloyd_done - t_init) / max(int(n_iter), 1))
 
         self.cluster_centers_ = np.asarray(centers)
         # labels cross the (slow) host link once per fit; with k <= 255
@@ -197,6 +221,23 @@ class KMeans(TransformerMixin, BaseEstimator):
                 "bound_held_fraction_per_iter": [
                     float(h) / denom for h in held],
             }
+            if telemetry.enabled():
+                # registry mirrors of lloyd_pruning_, same values (pinned
+                # by tests/test_telemetry.py); the per-ITERATION pruned
+                # fractions feed the histogram
+                reg = telemetry.metrics()
+                reg.counter("kmeans.lloyd.rows_skipped").inc(
+                    self.lloyd_pruning_["rows_skipped"])
+                reg.counter("kmeans.lloyd.rows_considered").inc(
+                    self.lloyd_pruning_["rows_considered"])
+                reg.counter("kmeans.lloyd.distances_avoided").inc(
+                    self.lloyd_pruning_["distances_avoided"])
+                h = reg.histogram("kmeans.lloyd.pruned_fraction")
+                for frac in self.lloyd_pruning_["pruned_fraction_per_iter"]:
+                    h.observe(frac)
+                fit_sp.set(lloyd_pruned_fraction=round(
+                    self.lloyd_pruning_["rows_skipped"]
+                    / max(self.lloyd_pruning_["rows_considered"], 1), 4))
         # phase split for benchmarks/observability: init ends at the
         # device_get barrier inside k_init; lloyd covers the fused loop +
         # final re-assignment fetch

@@ -29,6 +29,7 @@ from sklearn.base import BaseEstimator, ClusterMixin
 from dask_ml_tpu.cluster.k_means import KMeans
 from dask_ml_tpu.ops.pairwise import PAIRWISE_KERNEL_FUNCTIONS, pairwise_kernels
 from dask_ml_tpu.parallel.sharding import replicate, shard_rows, unpad_rows
+from dask_ml_tpu.parallel import telemetry
 from dask_ml_tpu.utils._log import log_array
 from dask_ml_tpu.utils.validation import check_array, check_random_state_np
 
@@ -135,16 +136,21 @@ class SpectralClustering(BaseEstimator, ClusterMixin):
         # that cannot trace (np.asarray on a tracer raises), and a fresh
         # callable per fit would leak a static jit-cache entry each time.
         params_t = tuple(sorted(params.items()))
-        if callable(self.affinity):
-            V2, S_A, Xk, ext = _nystrom_eager(
-                Xs, jnp.asarray(keep), n_valid, float(n),
-                self.affinity, params, k)
-        else:
-            V2, S_A, Xk, ext = _nystrom_program(
-                Xs, jnp.asarray(keep),
-                jnp.asarray(n_valid, jnp.int32),
-                jnp.asarray(float(n), jnp.float32),
-                metric=self.affinity, params_t=params_t, k=k)
+        # plain span (no logger=): this phase never was a profile_phase
+        # site, so it must not become a new DASK_ML_TPU_PROFILE_DIR
+        # capture site
+        with telemetry.span("spectral-nystrom",
+                            landmarks=int(l), k=int(k)):
+            if callable(self.affinity):
+                V2, S_A, Xk, ext = _nystrom_eager(
+                    Xs, jnp.asarray(keep), n_valid, float(n),
+                    self.affinity, params, k)
+            else:
+                V2, S_A, Xk, ext = _nystrom_program(
+                    Xs, jnp.asarray(keep),
+                    jnp.asarray(n_valid, jnp.int32),
+                    jnp.asarray(float(n), jnp.float32),
+                    metric=self.affinity, params_t=params_t, k=k)
         U2 = unpad_rows(V2, n_valid)  # device, original row order
 
         # persist the Nyström extension state (landmarks + degree/eigenmap

@@ -46,6 +46,13 @@ Knobs (all also overridable per-call at the API they configure):
   ≥ f32 (``precision.state_dtype``). Thread-local under
   :func:`config_context`; see ``docs/precision.md``. An explicit ``dtype``
   knob (above) wins over the policy's storage dtype where both are set.
+- ``telemetry`` — the unified observability subsystem
+  (:mod:`dask_ml_tpu.parallel.telemetry`): ``True`` records hierarchical
+  spans into the ring buffer and mirrors every instrumented counter into
+  the metrics registry; ``False`` (default) keeps all instrumented call
+  sites on a measured near-no-op path (no recorder growth, shared null
+  span/metric objects). Thread-local under :func:`config_context`; see
+  ``docs/observability.md``.
 - ``compilation_cache`` — directory for XLA's PERSISTENT compilation cache
   (``set_config(compilation_cache="~/.cache/...")``): repeat invocations
   load compiled programs from disk and start warm. Process-wide only
@@ -69,6 +76,7 @@ _DEFAULTS: dict[str, Any] = {
     "device_outputs": False,
     "pad_policy": "auto",
     "precision": "auto",
+    "telemetry": False,
     "compilation_cache": None,
 }
 
@@ -132,6 +140,17 @@ def _validate_options(names) -> None:
 def get_option(name: str):
     _validate_options([name])
     return get_config()[name]
+
+
+def _get_one(name: str):
+    """Single-key read without building the merged dict — the hot-path
+    accessor behind ``telemetry.enabled()``, which instrumented call sites
+    hit on every span/metric even with the knob off. Innermost scope wins,
+    same as :func:`get_config`."""
+    for layer in reversed(_stack()):
+        if name in layer:
+            return layer[name]
+    return _global_config[name]
 
 
 def set_config(**options) -> None:

@@ -28,7 +28,7 @@ from dask_ml_tpu.config import maybe_host
 from dask_ml_tpu.ops import linalg
 from dask_ml_tpu.parallel import mesh as mesh_lib
 from dask_ml_tpu.parallel.sharding import prepare_data, shard_rows, unpad_rows
-from dask_ml_tpu.utils._log import profile_phase
+from dask_ml_tpu.parallel import telemetry
 from dask_ml_tpu.utils.validation import check_array, check_random_state
 
 logger = logging.getLogger(__name__)
@@ -186,7 +186,8 @@ class PCA(BaseEstimator, TransformerMixin):
 
         sketch_dtype = (precision_lib.resolve().compute_for("sketch")
                         if randomized else None)
-        with profile_phase(logger, "pca-fit-program"):
+        with telemetry.span("pca-fit-program", logger=logger,
+                    solver=solver, k=int(n_components)):
             # centering + masking + factorization + sign flip + total
             # variance as one dispatch (see _fit_program)
             mean, U, S, Vt, tv = _fit_program(

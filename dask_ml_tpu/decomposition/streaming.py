@@ -101,6 +101,7 @@ def _streamed_moments_host(source, checkpoint_path=None,
     With ``checkpoint_path`` the single pass is preemption-safe: the carry
     IS the moment accumulators, so a snapshot after block b resumes at
     block b+1 with bit-identical sums (``tests/test_faults.py``)."""
+    from dask_ml_tpu.parallel import telemetry
     from dask_ml_tpu.parallel.stream import prefetched_scan
 
     d = source.out_struct[0].shape[1]
@@ -112,27 +113,30 @@ def _streamed_moments_host(source, checkpoint_path=None,
     from dask_ml_tpu.parallel.faults import scan_checkpoint_scope
 
     carry0, start_block = _moments_init(d), 0
-    with scan_checkpoint_scope(
-            checkpoint_path,
-            every=(source.n_blocks if checkpoint_every is None
-                   else int(checkpoint_every)),
-            bind={"what": "streamed_moments", "n_blocks": source.n_blocks,
-                  "d": int(d),
-                  # carry layout version: v2 added the Neumaier
-                  # compensation terms — a v1 snapshot must error loudly,
-                  # not resume into a different tree structure
-                  "carry_v": 2}) as scan_ckpt:
+    with telemetry.span("pca.streamed-moments", n_blocks=source.n_blocks,
+                        d=int(d)):
+        with scan_checkpoint_scope(
+                checkpoint_path,
+                every=(source.n_blocks if checkpoint_every is None
+                       else int(checkpoint_every)),
+                bind={"what": "streamed_moments",
+                      "n_blocks": source.n_blocks,
+                      "d": int(d),
+                      # carry layout version: v2 added the Neumaier
+                      # compensation terms — a v1 snapshot must error
+                      # loudly, not resume into a different tree structure
+                      "carry_v": 2}) as scan_ckpt:
+            if scan_ckpt is not None:
+                snap = scan_ckpt.load()
+                if snap is not None:
+                    carry, _outs, start_block, _epoch = snap
+                    carry0 = tuple(jnp.asarray(t) for t in carry)
+            carry, _ = prefetched_scan(step, carry0, source,
+                                       checkpoint=scan_ckpt,
+                                       start_block=start_block)
         if scan_ckpt is not None:
-            snap = scan_ckpt.load()
-            if snap is not None:
-                carry, _outs, start_block, _epoch = snap
-                carry0 = tuple(jnp.asarray(t) for t in carry)
-        carry, _ = prefetched_scan(step, carry0, source,
-                                   checkpoint=scan_ckpt,
-                                   start_block=start_block)
-    if scan_ckpt is not None:
-        scan_ckpt.delete()
-    return _moments_finalize(carry)
+            scan_ckpt.delete()
+        return _moments_finalize(carry)
 
 
 def streamed_moments(*, block_fn, n_blocks, checkpoint_path=None,

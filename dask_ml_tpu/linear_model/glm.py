@@ -29,7 +29,7 @@ from dask_ml_tpu.metrics import accuracy_score, r2_score
 from dask_ml_tpu.models import glm as core
 from dask_ml_tpu.parallel import mesh as mesh_lib
 from dask_ml_tpu.parallel.sharding import prepare_data, shard_rows, unpad_rows
-from dask_ml_tpu.utils._log import profile_phase
+from dask_ml_tpu.parallel import telemetry
 from dask_ml_tpu.utils.validation import check_array
 
 logger = logging.getLogger(__name__)
@@ -209,7 +209,7 @@ class _GLM(BaseEstimator):
                 jnp.asarray(mask), mesh=mesh, **kwargs,
             )
 
-        with profile_phase(logger, f"glm-{self.solver}"):
+        with telemetry.span(f"glm-{self.solver}", logger=logger):
             results = [solve_one(y_dev) for y_dev in self._solve_targets(data)]
         betas = [np.asarray(b)[:d_true] for b, _ in results]  # drop padding
         self.n_iter_ = int(max(int(n) for _, n in results))
@@ -323,7 +323,8 @@ class _GLM(BaseEstimator):
                 return add_intercept(X_b), y_b, w_b
 
         try:
-            with profile_phase(logger, "glm-admm-streamed"):
+            with telemetry.span("glm-admm-streamed", logger=logger,
+                    blocks=int(n_blocks)):
                 beta, n_iter = core.admm_streamed(
                     wrapped, int(n_blocks), d,
                     float(n_samples if sw_total is None else sw_total),
@@ -659,7 +660,7 @@ class LogisticRegression(_GLM):
             mn_kwargs = dict(
                 n_classes=K, regularizer=kwargs["regularizer"],
                 lamduh=kwargs["lamduh"], tol=kwargs.get("tol", self.tol))
-        with profile_phase(logger, f"glm-{solver_name}"):
+        with telemetry.span(f"glm-{solver_name}", logger=logger):
             if self.checkpoint:
                 # same per-problem fingerprint-suffixed snapshot scheme as
                 # the binary solvers in fit() (SURVEY §5.4): the softmax

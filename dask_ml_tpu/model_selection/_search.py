@@ -42,6 +42,7 @@ from dask_ml_tpu.model_selection import methods
 from dask_ml_tpu.model_selection._split import check_cv
 from dask_ml_tpu.model_selection._tokenize import tokenize
 from dask_ml_tpu.model_selection.methods import FIT_FAILURE
+from dask_ml_tpu.parallel import telemetry
 
 __all__ = ["GridSearchCV", "RandomizedSearchCV", "TPUBaseSearchCV"]
 
@@ -1326,6 +1327,11 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
             return test, train, float(self.cell_timeout), score_time, True
 
         def _compute_cell_deadline(ci, si):
+            with telemetry.span("search.cell", candidate=int(ci),
+                                split=int(si)):
+                return _compute_cell_deadline_inner(ci, si)
+
+        def _compute_cell_deadline_inner(ci, si):
             if not self.cell_timeout:
                 return _compute_cell(ci, si)
             box: dict = {}
@@ -1345,6 +1351,9 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
             if t.is_alive():
                 with timeout_lock:
                     timeout_counts[0] += 1
+                # registry mirror of the timeout count surfaced as
+                # n_cell_timeouts_ (same increment site)
+                telemetry.counter("search.cell_timeouts").inc()
                 return _timed_out_result(ci, si)
             if "error" in box:
                 raise box["error"]
@@ -1564,6 +1573,14 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
         for key, m in order:
             label = m["label"] or "(input)"
             lines.append(f"{m['consumers']:>9}  {label:<40} {key[:12]}")
+        # unified-telemetry view (docs/observability.md): the same
+        # spans/metrics/compile rollup telemetry_report() exports as a
+        # dict. Shown when the knob is on OR when spans were recorded —
+        # a fit run under config_context(telemetry=True) keeps its
+        # telemetry section even when the report is read outside that
+        # scope.
+        if telemetry.enabled() or telemetry.spans():
+            lines += ["", telemetry.render_report()]
         return "\n".join(lines)
 
     def visualize(self, filename: Optional[str] = "mydask",

@@ -1057,6 +1057,7 @@ def _admm_streamed_host(source, z0, x0, u0, mask, lamduh, rho, abstol,
     with a bit-identical trajectory. A snapshot found at the path resumes
     here; the file is deleted on completion (it is a resume artifact, and
     a stale one would hijack the next fit at the same path)."""
+    from dask_ml_tpu.parallel import telemetry
     from dask_ml_tpu.parallel.stream import prefetched_scan
 
     n_blocks = int(x0.shape[0])
@@ -1084,15 +1085,17 @@ def _admm_streamed_host(source, z0, x0, u0, mask, lamduh, rho, abstol,
 
     for it in range(start_epoch, max_iter):
         first = it == start_epoch
-        _, xs = prefetched_scan(
-            step, (z, x, u), source, wrap=it + 1 < max_iter,
-            checkpoint=scan_checkpoint, epoch=it,
-            start_block=start_block if first else 0,
-            outs=outs0 if first else None)
-        x = jnp.stack(xs)
-        z, u, done = _host_consensus(
-            z, x, u, mask, lamduh, rho, abstol, reltol, sw_total,
-            regularizer=regularizer)
+        with telemetry.span("glm.admm.epoch", epoch=it, blocks=n_blocks):
+            _, xs = prefetched_scan(
+                step, (z, x, u), source, wrap=it + 1 < max_iter,
+                checkpoint=scan_checkpoint, epoch=it,
+                start_block=start_block if first else 0,
+                outs=outs0 if first else None)
+            x = jnp.stack(xs)
+            with telemetry.span("glm.admm.consensus", epoch=it):
+                z, u, done = _host_consensus(
+                    z, x, u, mask, lamduh, rho, abstol, reltol, sw_total,
+                    regularizer=regularizer)
         n_iter = it + 1
         if check_done and bool(done):
             break
@@ -1198,6 +1201,8 @@ def admm_streamed(block_fn, n_blocks, d, sw_total, mask=None, *,
         # the bind dict ties the snapshot to its problem (same policy as
         # solve_checkpointed's fingerprint); max_iter is excluded so a
         # resume may extend the iteration budget
+        from dask_ml_tpu.parallel import telemetry
+
         with scan_checkpoint_scope(
                 checkpoint_path,
                 every=(int(n_blocks) if checkpoint_every is None
@@ -1209,14 +1214,23 @@ def admm_streamed(block_fn, n_blocks, d, sw_total, mask=None, *,
                                       float(abstol), float(reltol),
                                       float(inner_tol), float(sw_total),
                                       int(inner_max_iter)))}) as scan_ckpt:
-            z, n_iter, x, u, done = _admm_streamed_host(
-                block_fn, z0, x0, u0, jnp.asarray(mask, sdt), lam_d,
-                rho_d, abstol_d, reltol_d, tol_d, sw_d,
-                check_done=(float(abstol) != 0.0 or float(reltol) != 0.0),
-                family=family, regularizer=regularizer,
-                max_iter=int(max_iter),
-                inner_max_iter=int(inner_max_iter),
-                scan_checkpoint=scan_ckpt)
+            # the root span of the streamed fit's tree; sp.sync attributes
+            # the async dispatch backlog (the last epoch's still-running
+            # block solves) to the fit instead of the caller's first fetch
+            # — a barrier only when telemetry is ON (sync is a no-op on
+            # the disabled path, so pipelining is unchanged knob-off)
+            with telemetry.span("glm.admm.streamed", blocks=int(n_blocks),
+                                d=int(d), family=family) as sp:
+                z, n_iter, x, u, done = _admm_streamed_host(
+                    block_fn, z0, x0, u0, jnp.asarray(mask, sdt), lam_d,
+                    rho_d, abstol_d, reltol_d, tol_d, sw_d,
+                    check_done=(float(abstol) != 0.0
+                                or float(reltol) != 0.0),
+                    family=family, regularizer=regularizer,
+                    max_iter=int(max_iter),
+                    inner_max_iter=int(inner_max_iter),
+                    scan_checkpoint=scan_ckpt)
+                sp.sync(z)
     else:
         if checkpoint_path is not None:
             raise ValueError(

@@ -1437,7 +1437,7 @@ def measure_init_phases(X, w, n_clusters: int, key,
     import time
 
     from dask_ml_tpu.ops.fused_distance import _use_pallas
-    from dask_ml_tpu.utils._log import profile_phase
+    from dask_ml_tpu.parallel import telemetry
 
     n, d = int(X.shape[0]), int(X.shape[1])
     cfg = _init_scalable_config(n, n_clusters, oversampling_factor, max_iter)
@@ -1470,7 +1470,7 @@ def measure_init_phases(X, w, n_clusters: int, key,
     def timed(name, fn, *args):
         force(fn(*args))  # warm: compile + one run
         t0 = time.perf_counter()
-        with profile_phase(logger, f"kmeans-init/{name}"):
+        with telemetry.span(f"kmeans-init/{name}", logger=logger):
             out = force(fn(*args))
         phases[name] = time.perf_counter() - t0
         return out
@@ -1491,6 +1491,11 @@ def measure_init_phases(X, w, n_clusters: int, key,
         n_rounds=int(jax.device_get(n_rounds)), cap=cap, max_cand=max_cand,
         n_clusters=n_clusters, n_trials=cfg["n_trials"], finish_iters=100,
         fused_rounds=fused["rounds"], fused_weights=fused["weights"])
+    skip_ratio = (float(jax.device_get(r_skip))
+                  / max(float(jax.device_get(r_total)), 1.0))
+    if telemetry.enabled():
+        telemetry.metrics().gauge(
+            "kmeans.init.round_skip_ratio").set(skip_ratio)
     return {
         "seconds": phases,
         "bytes_moved": traffic,
@@ -1500,8 +1505,7 @@ def measure_init_phases(X, w, n_clusters: int, key,
         # norm-filter pruning of the rounds' incremental min-distance
         # update (see _init_rounds_phase): fraction of (row, round) pairs
         # whose distance work the reverse-triangle bound skipped
-        "round_skip_ratio": (float(jax.device_get(r_skip))
-                             / max(float(jax.device_get(r_total)), 1.0)),
+        "round_skip_ratio": skip_ratio,
     }
 
 

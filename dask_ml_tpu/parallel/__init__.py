@@ -52,6 +52,14 @@ from dask_ml_tpu.parallel.precision import (  # noqa: F401
     pdot,
     pmatmul,
 )
+from dask_ml_tpu.parallel.telemetry import (  # noqa: F401
+    MetricsRegistry,
+    export_chrome_trace,
+    render_report,
+    reset_telemetry,
+    span,
+    telemetry_report,
+)
 from dask_ml_tpu.parallel.stream import (  # noqa: F401
     HostBlockSource,
     prefetched_scan,

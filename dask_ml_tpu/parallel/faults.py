@@ -188,6 +188,8 @@ class RetryPolicy:
             except Exception as e:
                 if not self.is_transient(e):
                     raise
+                from dask_ml_tpu.parallel import telemetry
+
                 with self._lock:
                     exhausted = (
                         attempt >= self.max_retries
@@ -196,12 +198,22 @@ class RetryPolicy:
                     if exhausted:
                         self.giveups += 1
                 if exhausted:
+                    if telemetry.enabled():
+                        telemetry.metrics().counter(
+                            "faults.giveups", kind=kind).inc()
                     raise
                 d = self.backoff_delay(attempt)
                 with self._lock:
                     self.retries += 1
                     self.by_kind[kind] = self.by_kind.get(kind, 0) + 1
                     self.delay_spent += d
+                if telemetry.enabled():
+                    # registry mirrors of the policy's own counters, same
+                    # increment site (docs/observability.md); kind labels
+                    # mirror by_kind
+                    reg = telemetry.metrics()
+                    reg.counter("faults.retries", kind=kind).inc()
+                    reg.counter("faults.backoff_seconds").inc(d)
                 logger.warning(
                     "transient %s failure%s — retry %d/%d in %.3fs: %r",
                     kind, f" ({detail})" if detail else "", attempt + 1,

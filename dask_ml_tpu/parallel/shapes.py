@@ -210,10 +210,27 @@ def _on_duration(event: str, duration: float, **_kw) -> None:
         with _stats_lock:
             _stats["n_compiles"] += 1
             _stats["compile_seconds"] += float(duration)
+        _mirror_compile_event("compile.n_compiles",
+                              "compile.compile_seconds", duration)
     elif event == _TRACE_EVENT:
         with _stats_lock:
             _stats["n_traces"] += 1
             _stats["trace_seconds"] += float(duration)
+        _mirror_compile_event("compile.n_traces",
+                              "compile.trace_seconds", duration)
+
+
+def _mirror_compile_event(count_name: str, seconds_name: str,
+                          duration: float) -> None:
+    """Registry mirror of one compile/trace event (telemetry on only; the
+    compiling thread runs the listener, so the thread-local knob sees the
+    scope that triggered the compile)."""
+    from dask_ml_tpu.parallel import telemetry
+
+    if telemetry.enabled():
+        reg = telemetry.metrics()
+        reg.counter(count_name).inc()
+        reg.counter(seconds_name).inc(float(duration))
 
 
 def _install_listeners() -> None:
@@ -233,9 +250,18 @@ def _install_listeners() -> None:
 
 def note_bucket(n_valid: int, padded: int) -> None:
     """Record that ``n_valid`` true rows were staged into the ``padded``
-    bucket — the data behind ``compile_stats()['shape_buckets']``."""
+    bucket — the data behind ``compile_stats()['shape_buckets']``. Also
+    counts the hit into the telemetry registry
+    (``shapes.bucket_hits{bucket=...}``) when the knob is on: the
+    compile-stats set records only DISTINCT (bucket, n) pairs, the
+    telemetry counter every staging that landed in the bucket."""
     with _stats_lock:
         _buckets.setdefault(int(padded), set()).add(int(n_valid))
+    from dask_ml_tpu.parallel import telemetry
+
+    if telemetry.enabled():
+        telemetry.metrics().counter(
+            "shapes.bucket_hits", bucket=int(padded)).inc()
 
 
 def compile_stats() -> dict:

@@ -54,6 +54,7 @@ from typing import Callable, Optional, Sequence
 import jax
 import numpy as np
 
+from dask_ml_tpu.parallel import telemetry
 from dask_ml_tpu.parallel.faults import BlockFetchError, Preempted
 
 __all__ = ["HostBlockSource", "prefetched_scan"]
@@ -321,28 +322,38 @@ class HostBlockSource:
         numbers in ``bench.py`` stay honest across retries)."""
         if b in self._inflight:
             return
-        blk = self.host_block(b)
-        logical = sum(int(a.nbytes) for a in blk)
-        # the wire cast happens HERE, after the (exact) host read and
-        # before the transfer: wire bytes are what actually cross the link
-        blk = self._cast_wire(blk)
+        with telemetry.span("stream.transfer", block=b):
+            blk = self.host_block(b)
+            logical = sum(int(a.nbytes) for a in blk)
+            # the wire cast happens HERE, after the (exact) host read and
+            # before the transfer: wire bytes are what actually cross the
+            # link
+            blk = self._cast_wire(blk)
 
-        def put():
-            if self.fault_injector is not None:
-                self.fault_injector.on_transfer(b)
-            return tuple(jax.device_put(a, self._device) for a in blk)
+            def put():
+                if self.fault_injector is not None:
+                    self.fault_injector.on_transfer(b)
+                return tuple(jax.device_put(a, self._device) for a in blk)
 
-        if self.retry_policy is None:
-            dev = put()
-        else:
-            dev = self.retry_policy.run(put, kind="device-put",
-                                        detail=f"block {b}")
-        nbytes = sum(int(a.nbytes) for a in blk)
+            if self.retry_policy is None:
+                dev = put()
+            else:
+                dev = self.retry_policy.run(put, kind="device-put",
+                                            detail=f"block {b}")
+            nbytes = sum(int(a.nbytes) for a in blk)
         self._inflight[b] = dev
         self._inflight_bytes[b] = (nbytes, logical)
         self.bytes_streamed += nbytes
         self.logical_bytes_streamed += logical
         self.blocks_started += 1
+        if telemetry.enabled():
+            # registry mirrors of the per-source counters just above —
+            # same increment site, so within an enabled scope the two can
+            # only agree (pinned by tests/test_telemetry.py)
+            reg = telemetry.metrics()
+            reg.counter("stream.bytes_streamed").inc(nbytes)
+            reg.counter("stream.logical_bytes_streamed").inc(logical)
+            reg.counter("stream.blocks_started").inc(1)
 
     def _cast_wire(self, blk: tuple) -> tuple:
         from dask_ml_tpu.parallel import precision as precision_lib
@@ -375,6 +386,11 @@ class HostBlockSource:
                     f"block {b}/{self.n_blocks}: start() completed without "
                     "an in-flight transfer")
         self._inflight_bytes.pop(b, None)
+        # the prefetch queue-depth gauge, sampled at every take(): how many
+        # transfers remain in flight ahead of compute right now — always in
+        # [0, prefetch], and the direct precursor to a serving queue-depth
+        # gauge (ROADMAP item 1)
+        telemetry.gauge("stream.queue_depth").set(len(self._inflight))
         return dev
 
     def discard_inflight(self) -> None:
@@ -385,6 +401,7 @@ class HostBlockSource:
         next timed run's accounting. Transfers issued before a
         ``reset_stats()`` boundary (rollback entry ``None``) were never
         part of the current counters and are dropped without subtracting."""
+        mirror = telemetry.metrics() if telemetry.enabled() else None
         for b in list(self._inflight):
             entry = self._inflight_bytes.pop(b, None)
             if entry is not None:
@@ -392,6 +409,13 @@ class HostBlockSource:
                 self.bytes_streamed -= wire
                 self.logical_bytes_streamed -= logical
                 self.blocks_started -= 1
+                if mirror is not None:
+                    # keep the registry mirrors tracking the legacy
+                    # counters through the rollback too
+                    mirror.counter("stream.bytes_streamed").inc(-wire)
+                    mirror.counter(
+                        "stream.logical_bytes_streamed").inc(-logical)
+                    mirror.counter("stream.blocks_started").inc(-1)
             del self._inflight[b]
 
     def reset_stats(self) -> None:
@@ -491,23 +515,33 @@ def prefetched_scan(step, carry, source: HostBlockSource, *,
 
     if depth <= 0:
         for b in range(start_block, n):
-            blk = source.take(b)
-            _sync(blk)
-            carry, out = step(carry, b, blk)
-            _sync(out if out is not None else carry)
+            with telemetry.span("stream.block", block=b, epoch=epoch):
+                with telemetry.span("stream.take", block=b):
+                    blk = source.take(b)
+                    _sync(blk)
+                with telemetry.span("stream.compute", block=b) as sc:
+                    carry, out = step(carry, b, blk)
+                    sc.sync(out if out is not None else carry)
+                    _sync(out if out is not None else carry)
             outs.append(out)
             after_block(b, carry)
         return carry, outs
     for j in range(min(depth, n - start_block)):
         source.start(start_block + j)
     for b in range(start_block, n):
-        blk = source.take(b)
-        nxt = b + depth
-        if nxt < n:
-            source.start(nxt)
-        elif wrap and nxt - n < n:
-            source.start(nxt - n)
-        carry, out = step(carry, b, blk)
+        with telemetry.span("stream.block", block=b, epoch=epoch):
+            with telemetry.span("stream.take", block=b):
+                blk = source.take(b)
+            nxt = b + depth
+            if nxt < n:
+                source.start(nxt)
+            elif wrap and nxt - n < n:
+                source.start(nxt - n)
+            # dispatch-only under the async pipeline: the span measures
+            # host-side step dispatch, not device completion (which the
+            # NEXT block's take() overlaps with by design)
+            with telemetry.span("stream.compute", block=b):
+                carry, out = step(carry, b, blk)
         outs.append(out)
         after_block(b, carry)
     return carry, outs

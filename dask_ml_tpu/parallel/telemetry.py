@@ -1,0 +1,669 @@
+"""Unified telemetry: hierarchical spans, a metrics registry, trace export.
+
+The reference got its runtime observability for free from dask's
+distributed scheduler dashboards (SURVEY §0: dask-ml ships no runtime of
+its own); this JAX rebuild has no such dashboard, and six PRs of substrate
+work left telemetry scattered over five incompatible ad-hoc surfaces —
+``utils/_log.py::profile_phase`` wall times, ``parallel/shapes.py::
+compile_stats()``, the :class:`~dask_ml_tpu.parallel.stream.HostBlockSource`
+wire/logical byte counters, ``RetryPolicy.stats()`` / the search's
+``retry_stats_``, and KMeans' ``lloyd_pruning_``. This module is the one
+subsystem they all report through (docs/observability.md):
+
+- **Hierarchical spans** — :func:`span` is a context manager recording wall
+  time, optional device-sync time (``sp.sync(tree)`` measures the
+  ``block_until_ready`` wait), and parent/child structure into a bounded
+  ring-buffer recorder (thread-local nesting; the ring is process-wide).
+  Spans still emit ``jax.profiler.TraceAnnotation`` and honor the existing
+  ``DASK_ML_TPU_PROFILE_DIR`` outermost-capture contract, so externally
+  captured xprof traces keep seeing the same phase names —
+  ``utils/_log.py::profile_phase`` is now a thin compatibility wrapper over
+  ``span(name, logger=...)``.
+- **Metrics registry** — thread-safe named counters / gauges / histograms
+  with label support (:func:`counter` / :func:`gauge` / :func:`histogram`),
+  into which every pre-existing ad-hoc counter is mirrored at its
+  increment site: stream wire/logical bytes and blocks, the prefetch
+  queue-depth gauge sampled at each ``take()`` (the direct precursor to
+  serving queue-depth, ROADMAP item 1), retry/backoff/giveup counters from
+  :mod:`~dask_ml_tpu.parallel.faults`, search-cell timeouts, compile events
+  and shape-bucket hits from :mod:`~dask_ml_tpu.parallel.shapes`, and
+  Lloyd pruning fractions from ``models/kmeans.py``.
+- **Export** — :func:`telemetry_report` returns one unified nested dict
+  (JSON-round-trippable; :func:`render_report` is the text view wired into
+  the search's ``shared_fit_report()``), and :func:`export_chrome_trace`
+  writes Chrome trace-event JSON loadable in Perfetto /
+  ``chrome://tracing``.
+
+Everything is behind the thread-local ``telemetry`` config knob
+(:mod:`dask_ml_tpu.config`): with the knob off (the default) the
+instrumented call sites take a measured near-no-op path — a disabled
+:func:`span` yields a shared null span without touching the recorder or
+``jax.profiler``, and a disabled metric helper returns a shared null metric
+whose ``inc``/``set``/``observe`` are empty methods. ``bench.py
+--telemetry`` gates that the disabled path costs < 1 % of fit wall time
+(TELEMETRY_r01.json).
+
+Mirror semantics: metric mirrors are exact WITHIN an enabled scope — reset
+with :func:`reset_telemetry`, enable via ``config_context(telemetry=True)``,
+run the workload, and every mirrored counter equals its legacy surface
+(``tests/test_telemetry.py`` pins this under the PR-3 ``FaultInjector``).
+Compile numbers appear twice with different scopes, by design: the
+report's ``compile`` section pulls
+:func:`~dask_ml_tpu.parallel.shapes.compile_stats` live (process-lifetime,
+the legacy surface itself), while the ``compile.*`` registry counters
+count only events that fired inside an enabled scope — warm-up compiles
+before ``config_context(telemetry=True)`` (or a ``reset_telemetry``, which
+clears the registry but deliberately not ``compile_stats``) show up in the
+former and not the latter.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import math
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Optional
+
+__all__ = [
+    "span",
+    "Span",
+    "enabled",
+    "metrics",
+    "counter",
+    "gauge",
+    "histogram",
+    "spans",
+    "span_summary",
+    "reset_telemetry",
+    "telemetry_report",
+    "render_report",
+    "export_chrome_trace",
+    "MetricsRegistry",
+]
+
+PROFILE_DIR_ENV = "DASK_ML_TPU_PROFILE_DIR"
+
+#: process trace epoch — span timestamps (and the Chrome trace ``ts`` axis)
+#: are seconds since this module was imported
+_T0 = time.perf_counter()
+
+_DEFAULT_RING_CAPACITY = 8192
+
+
+_get_one = None  # bound on first use (config imports nothing from here,
+# but binding lazily keeps module import order unconstrained)
+
+
+def enabled() -> bool:
+    """Whether telemetry recording is on for THIS thread (the ``telemetry``
+    config knob: ``set_config(telemetry=True)`` process-wide,
+    ``config_context(telemetry=True)`` scoped)."""
+    global _get_one
+    if _get_one is None:
+        from dask_ml_tpu.config import _get_one as _g
+
+        _get_one = _g
+    return bool(_get_one("telemetry"))
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+
+class _NullMetric:
+    """Shared no-op metric returned by the module-level helpers when the
+    knob is off — the disabled path allocates nothing and touches no lock."""
+
+    __slots__ = ()
+
+    def inc(self, v=1) -> None:
+        pass
+
+    def set(self, v) -> None:
+        pass
+
+    def observe(self, v) -> None:
+        pass
+
+
+_NULL_METRIC = _NullMetric()
+
+
+class Counter:
+    """Monotonic-by-convention named counter (mirrors may subtract when the
+    legacy surface they shadow rolls back, e.g. ``discard_inflight``)."""
+
+    __slots__ = ("_lock", "value")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self.value = 0
+
+    def inc(self, v=1) -> None:
+        with self._lock:
+            self.value += v
+
+
+class Gauge:
+    """Last-value gauge that also tracks min/max/sample count — enough to
+    bound a sampled quantity (queue depth) without storing the series."""
+
+    __slots__ = ("_lock", "last", "min", "max", "n_samples")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self.last = None
+        self.min = None
+        self.max = None
+        self.n_samples = 0
+
+    def set(self, v) -> None:
+        v = float(v)
+        with self._lock:
+            self.last = v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            self.n_samples += 1
+
+
+class Histogram:
+    """Count/sum/min/max plus power-of-two buckets (``le_2^e`` holds
+    observations in ``(2^(e-1), 2^e]``; nonpositive values land in ``0``) —
+    fixed memory however many observations arrive."""
+
+    __slots__ = ("_lock", "count", "total", "min", "max", "buckets")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = None
+        self.buckets: dict = {}
+
+    @staticmethod
+    def bucket_of(v: float) -> str:
+        if v <= 0:
+            return "0"
+        return f"le_2^{int(math.ceil(math.log2(v)))}"
+
+    def observe(self, v) -> None:
+        v = float(v)
+        b = self.bucket_of(v)
+        with self._lock:
+            self.count += 1
+            self.total += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            self.buckets[b] = self.buckets.get(b, 0) + 1
+
+
+class MetricsRegistry:
+    """Thread-safe named counters/gauges/histograms with label support.
+
+    A metric's identity is ``(name, sorted labels)``; the snapshot renders
+    labeled metrics Prometheus-style (``name{k=v,...}``). One process-wide
+    instance (:func:`metrics`) backs the module helpers; tests may
+    construct private registries.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict = {}
+        self._gauges: dict = {}
+        self._histograms: dict = {}
+
+    @staticmethod
+    def _key(name: str, labels: dict) -> tuple:
+        return (str(name),
+                tuple(sorted((str(k), str(v)) for k, v in labels.items())))
+
+    def _get(self, table: dict, cls, name: str, labels: dict):
+        key = self._key(name, labels)
+        with self._lock:
+            m = table.get(key)
+            if m is None:
+                m = table[key] = cls(self._lock)
+        return m
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get(self._counters, Counter, name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get(self._gauges, Gauge, name, labels)
+
+    def histogram(self, name: str, **labels) -> Histogram:
+        return self._get(self._histograms, Histogram, name, labels)
+
+    @staticmethod
+    def _render_key(key: tuple) -> str:
+        name, labels = key
+        if not labels:
+            return name
+        return name + "{" + ",".join(f"{k}={v}" for k, v in labels) + "}"
+
+    def snapshot(self) -> dict:
+        """Plain-dict view of every metric — JSON-serializable, keys are
+        the rendered ``name{labels}`` strings."""
+        with self._lock:
+            counters = {self._render_key(k): c.value
+                        for k, c in sorted(self._counters.items())}
+            gauges = {
+                self._render_key(k): {
+                    "last": g.last, "min": g.min, "max": g.max,
+                    "n_samples": g.n_samples,
+                }
+                for k, g in sorted(self._gauges.items())
+            }
+            histograms = {
+                self._render_key(k): {
+                    "count": h.count,
+                    "sum": h.total,
+                    "min": h.min,
+                    "max": h.max,
+                    "mean": (h.total / h.count) if h.count else None,
+                    "buckets": dict(h.buckets),
+                }
+                for k, h in sorted(self._histograms.items())
+            }
+        return {"counters": counters, "gauges": gauges,
+                "histograms": histograms}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+
+_registry = MetricsRegistry()
+
+
+def metrics() -> MetricsRegistry:
+    """The process-wide registry (bypasses the enabled check — use for
+    multi-metric hot sites already guarded by one :func:`enabled` call, and
+    for reading)."""
+    return _registry
+
+
+def counter(name: str, **labels):
+    """Named counter, or the shared null metric when telemetry is off."""
+    if not enabled():
+        return _NULL_METRIC
+    return _registry.counter(name, **labels)
+
+
+def gauge(name: str, **labels):
+    """Named gauge, or the shared null metric when telemetry is off."""
+    if not enabled():
+        return _NULL_METRIC
+    return _registry.gauge(name, **labels)
+
+
+def histogram(name: str, **labels):
+    """Named histogram, or the shared null metric when telemetry is off."""
+    if not enabled():
+        return _NULL_METRIC
+    return _registry.histogram(name, **labels)
+
+
+# ---------------------------------------------------------------------------
+# hierarchical spans
+# ---------------------------------------------------------------------------
+
+
+class Span:
+    """One live span: mutate ``attrs`` via :meth:`set`, measure device-sync
+    waits via :meth:`sync`. Finished spans land in the ring buffer as plain
+    dicts (:func:`spans`)."""
+
+    __slots__ = ("name", "attrs", "sid", "parent_id", "depth", "tid",
+                 "thread_name", "ts", "dur", "sync_seconds")
+
+    def __init__(self, name, attrs, sid, parent_id, depth, tid, thread_name):
+        self.name = name
+        self.attrs = attrs
+        self.sid = sid
+        self.parent_id = parent_id
+        self.depth = depth
+        self.tid = tid
+        self.thread_name = thread_name
+        self.ts = 0.0
+        self.dur = 0.0
+        self.sync_seconds = 0.0
+
+    def set(self, **attrs) -> None:
+        self.attrs.update(attrs)
+
+    def sync(self, tree):
+        """``jax.block_until_ready(tree)`` with the wait time recorded as
+        this span's ``sync_seconds`` — how much of the span was the host
+        waiting on the device, vs dispatching. Returns ``tree``.
+
+        MEASUREMENT ONLY: on a disabled span this is a pass-through no-op
+        (no barrier), so call sites must never rely on it for
+        correctness-critical synchronization."""
+        import jax
+
+        t0 = time.perf_counter()
+        jax.block_until_ready(tree)
+        self.sync_seconds += time.perf_counter() - t0
+        return tree
+
+
+class _NullSpan:
+    """Shared span stand-in on the disabled path: ``set`` and ``sync`` are
+    no-ops (``sync`` does NOT block — see :meth:`Span.sync`)."""
+
+    __slots__ = ()
+
+    def set(self, **attrs) -> None:
+        pass
+
+    def sync(self, tree):
+        return tree
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _NullSpanCtx:
+    """Shared context manager for the disabled no-``logger`` path: ``with
+    span(...)`` then costs one knob read plus this singleton's trivial
+    enter/exit — no generator frame, no environ read, no allocation."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return _NULL_SPAN
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN_CTX = _NullSpanCtx()
+
+_lock = threading.Lock()
+_ring: deque = deque(maxlen=_DEFAULT_RING_CAPACITY)
+_dropped = 0
+_next_id = 0
+_tls = threading.local()
+
+
+def _stack() -> list:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+def _alloc_id() -> int:
+    global _next_id
+    with _lock:
+        _next_id += 1
+        return _next_id
+
+
+def _record(sp: Span) -> None:
+    global _dropped
+    rec = {
+        "name": sp.name,
+        "ts": sp.ts,
+        "dur": sp.dur,
+        "sync_seconds": sp.sync_seconds,
+        "tid": sp.tid,
+        "thread": sp.thread_name,
+        "id": sp.sid,
+        "parent": sp.parent_id,
+        "depth": sp.depth,
+        "attrs": dict(sp.attrs),
+    }
+    with _lock:
+        if _ring.maxlen is not None and len(_ring) == _ring.maxlen:
+            _dropped += 1
+        _ring.append(rec)
+
+
+def span(name: str, *, logger=None, **attrs):
+    """Hierarchical telemetry span around a fit phase / block / cell.
+
+    With the ``telemetry`` knob on, records wall time, thread-local
+    parent/child structure, and any ``**attrs`` into the bounded ring
+    buffer, emitting a ``jax.profiler.TraceAnnotation`` so externally
+    captured traces see the same name. With the knob off (and no
+    ``logger``) this is a measured near-no-op: one config read and a
+    shared null context manager, nothing recorded.
+
+    ``logger`` opts into the legacy ``profile_phase`` contract regardless
+    of the knob: the phase ALWAYS gets a ``TraceAnnotation`` plus a DEBUG
+    wall-time line, and when ``DASK_ML_TPU_PROFILE_DIR`` is set the
+    outermost such span per thread captures a full ``jax.profiler.trace``
+    into that directory (logged at INFO) — byte-for-byte the behavior
+    ``utils/_log.py::profile_phase`` always had, which is now a thin
+    wrapper over this. The env var is consulted only for ``logger``
+    spans: capture sites are exactly the (pre-telemetry) profile_phase
+    sites, and plain spans never pay the environ read.
+
+    The yielded :class:`Span` supports ``sp.set(key=value)`` for late
+    attributes and ``sp.sync(tree)`` to attribute device-sync wait time.
+    """
+    if logger is None and not enabled():
+        return _NULL_SPAN_CTX
+    return _span_impl(name, logger, attrs)
+
+
+@contextlib.contextmanager
+def _span_impl(name: str, logger, attrs: dict):
+    rec = enabled()
+    trace_dir = (os.environ.get(PROFILE_DIR_ENV) if logger is not None
+                 else None)
+    import jax.profiler
+
+    own_trace = bool(trace_dir) and not getattr(_tls, "trace_active", False)
+    if own_trace:
+        _tls.trace_active = True
+        jax.profiler.start_trace(trace_dir)
+    sp = _NULL_SPAN
+    stack = None
+    if rec:
+        stack = _stack()
+        parent = stack[-1] if stack else None
+        th = threading.current_thread()
+        sp = Span(
+            name=str(name), attrs=dict(attrs), sid=_alloc_id(),
+            parent_id=(parent.sid if parent is not None else None),
+            depth=(parent.depth + 1 if parent is not None else 0),
+            tid=th.ident, thread_name=th.name,
+        )
+        stack.append(sp)
+    t0 = time.perf_counter()
+    try:
+        with jax.profiler.TraceAnnotation(str(name)):
+            yield sp
+    finally:
+        dt = time.perf_counter() - t0
+        if rec:
+            if stack and stack[-1] is sp:
+                stack.pop()
+            else:  # a leaked inner generator: drop by identity, not order
+                try:
+                    stack.remove(sp)
+                except ValueError:
+                    pass
+            sp.ts = t0 - _T0
+            sp.dur = dt
+            _record(sp)
+        if own_trace:
+            _tls.trace_active = False
+            jax.profiler.stop_trace()
+            if logger is not None:
+                logger.info("phase %s: %.3fs (trace -> %s)", name, dt,
+                            trace_dir)
+        elif logger is not None:
+            logger.debug("phase %s: %.3fs", name, dt)
+
+
+def spans() -> list:
+    """Finished-span records (oldest first), each a plain dict with
+    ``name/ts/dur/sync_seconds/tid/thread/id/parent/depth/attrs``."""
+    with _lock:
+        return list(_ring)
+
+
+def span_summary() -> dict:
+    """Per-name aggregate over the recorded spans: count, total/max wall
+    seconds, total device-sync seconds."""
+    out: dict = {}
+    for r in spans():
+        s = out.setdefault(r["name"], {
+            "count": 0, "total_seconds": 0.0, "max_seconds": 0.0,
+            "sync_seconds": 0.0,
+        })
+        s["count"] += 1
+        s["total_seconds"] += r["dur"]
+        s["max_seconds"] = max(s["max_seconds"], r["dur"])
+        s["sync_seconds"] += r["sync_seconds"]
+    for s in out.values():
+        for k in ("total_seconds", "max_seconds", "sync_seconds"):
+            s[k] = round(s[k], 6)
+    return out
+
+
+def reset_telemetry(ring_capacity: Optional[int] = None) -> None:
+    """Clear the span ring buffer and the metrics registry (compile stats
+    are :func:`~dask_ml_tpu.parallel.shapes.reset_compile_stats`'s to
+    reset — they pre-date this module and other consumers read them).
+    ``ring_capacity`` optionally resizes the ring."""
+    global _ring, _dropped
+    with _lock:
+        cap = _ring.maxlen if ring_capacity is None else int(ring_capacity)
+        if cap is not None and cap < 1:
+            raise ValueError(f"ring_capacity must be >= 1, got {cap}")
+        _ring = deque(maxlen=cap)
+        _dropped = 0
+    _registry.reset()
+
+
+# ---------------------------------------------------------------------------
+# export
+# ---------------------------------------------------------------------------
+
+
+def telemetry_report() -> dict:
+    """The one unified observability dict: span aggregates, every registry
+    metric, and the live compile stats (pulled from
+    :func:`~dask_ml_tpu.parallel.shapes.compile_stats` — the report IS that
+    legacy surface, so the two can never disagree). JSON-round-trippable:
+    ``json.loads(json.dumps(telemetry_report()))`` reproduces it exactly.
+    """
+    from dask_ml_tpu.parallel.shapes import compile_stats
+
+    compile_ = dict(compile_stats())
+    # json object keys are strings; stringify the bucket sizes here so the
+    # report round-trips through json unchanged
+    compile_["shape_buckets"] = {
+        str(k): v for k, v in compile_["shape_buckets"].items()}
+    with _lock:
+        n_recorded, n_dropped, cap = len(_ring), _dropped, _ring.maxlen
+    return {
+        "enabled": enabled(),
+        "spans": {
+            "by_name": span_summary(),
+            "n_recorded": n_recorded,
+            "n_dropped": n_dropped,
+            "ring_capacity": cap,
+        },
+        "metrics": _registry.snapshot(),
+        "compile": compile_,
+    }
+
+
+def render_report(max_rows: int = 12) -> str:
+    """Text rendering of :func:`telemetry_report` (the view
+    ``shared_fit_report()`` appends when telemetry is enabled)."""
+    rep = telemetry_report()
+    sp = rep["spans"]
+    lines = [
+        f"telemetry: {sp['n_recorded']} spans recorded"
+        + (f" ({sp['n_dropped']} dropped)" if sp["n_dropped"] else ""),
+    ]
+    by_name = sorted(sp["by_name"].items(),
+                     key=lambda kv: -kv[1]["total_seconds"])
+    if by_name:
+        lines.append(f"  {'total_s':>9}  {'count':>6}  {'sync_s':>8}  span")
+        for name, s in by_name[:max_rows]:
+            lines.append(f"  {s['total_seconds']:>9.3f}  {s['count']:>6}"
+                         f"  {s['sync_seconds']:>8.3f}  {name}")
+    m = rep["metrics"]
+    for name, v in list(m["counters"].items())[:max_rows]:
+        lines.append(f"  counter {name} = {v}")
+    for name, g in list(m["gauges"].items())[:max_rows]:
+        lines.append(f"  gauge {name}: last={g['last']} min={g['min']} "
+                     f"max={g['max']} n={g['n_samples']}")
+    for name, h in list(m["histograms"].items())[:max_rows]:
+        mean = "n/a" if h["mean"] is None else f"{h['mean']:.4g}"
+        lines.append(f"  histogram {name}: count={h['count']} mean={mean} "
+                     f"min={h['min']} max={h['max']}")
+    c = rep["compile"]
+    lines.append(f"  compile: {c['n_compiles']} compiles "
+                 f"({c['compile_seconds']:.2f}s), {c['n_traces']} traces, "
+                 f"{len(c['shape_buckets'])} shape buckets")
+    return "\n".join(lines)
+
+
+def _json_safe(v: Any):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return str(v)
+
+
+def export_chrome_trace(path: str) -> str:
+    """Write the recorded spans as Chrome trace-event JSON (the
+    ``traceEvents`` array format), loadable in Perfetto
+    (https://ui.perfetto.dev) or ``chrome://tracing``.
+
+    Every finished span becomes one complete (``"ph": "X"``) event —
+    nesting on a track follows ts/dur containment, which matches the
+    recorded parent/child structure because spans on one thread strictly
+    nest. ``args`` carries the span attrs, the span/parent ids, and the
+    measured device-sync seconds. Returns ``path``.
+    """
+    recs = spans()
+    pid = os.getpid()
+    events: list = [{
+        "ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+        "args": {"name": "dask_ml_tpu"},
+    }]
+    seen_tids: set = set()
+    for r in recs:
+        if r["tid"] not in seen_tids:
+            seen_tids.add(r["tid"])
+            events.append({
+                "ph": "M", "pid": pid, "tid": r["tid"],
+                "name": "thread_name", "args": {"name": r["thread"]},
+            })
+        args = {k: _json_safe(v) for k, v in r["attrs"].items()}
+        args["span_id"] = r["id"]
+        if r["parent"] is not None:
+            args["parent_span_id"] = r["parent"]
+        if r["sync_seconds"]:
+            args["sync_seconds"] = round(r["sync_seconds"], 6)
+        events.append({
+            "name": r["name"],
+            "cat": "dask_ml_tpu",
+            "ph": "X",
+            "pid": pid,
+            "tid": r["tid"],
+            "ts": round(r["ts"] * 1e6, 3),
+            "dur": round(r["dur"] * 1e6, 3),
+            "args": args,
+        })
+    path = os.fspath(path)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return path

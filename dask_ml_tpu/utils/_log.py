@@ -15,15 +15,18 @@ Profiling is opt-in two ways:
 - Setting ``DASK_ML_TPU_PROFILE_DIR=/some/dir`` makes the *outermost*
   :func:`profile_phase` capture a full ``jax.profiler.trace`` into that
   directory (viewable in TensorBoard / xprof) with zero code changes.
+
+:func:`profile_phase` is now a DEPRECATED thin wrapper over the unified
+telemetry subsystem's :func:`~dask_ml_tpu.parallel.telemetry.span`
+(``span(name, logger=logger)`` — same TraceAnnotation, same DEBUG/INFO log
+lines, same env-var outermost-capture contract, plus ring-buffer recording
+and metrics when the ``telemetry`` config knob is on). New code should call
+``span`` directly; see docs/observability.md for the migration table.
 """
 
 from __future__ import annotations
 
-import contextlib
 import logging
-import os
-import threading
-import time
 
 __all__ = ["format_bytes", "log_array", "profile_phase"]
 
@@ -68,7 +71,16 @@ def log_array(logger: logging.Logger, name: str, x,
         size = 1
         for s in shape:
             size *= int(s)
-        nbytes = size * getattr(dtype, "itemsize", 4)
+        # resolve the true itemsize through np.dtype: dtype may be a scalar
+        # TYPE (jnp.bfloat16) with no .itemsize attribute, and the old
+        # 4-byte guess reported bf16 arrays at 2x their actual size
+        try:
+            import numpy as np
+
+            itemsize = int(np.dtype(dtype).itemsize)
+        except TypeError:
+            itemsize = int(getattr(dtype, "itemsize", 4))
+        nbytes = size * itemsize
     logger.log(
         level, "%s: shape=%s dtype=%s %s on %s",
         name, shape, dtype,
@@ -77,34 +89,20 @@ def log_array(logger: logging.Logger, name: str, x,
     )
 
 
-_trace_state = threading.local()
-
-
-@contextlib.contextmanager
 def profile_phase(logger: logging.Logger, name: str):
-    """Name a fit phase for profiling and log its wall time at DEBUG.
+    """DEPRECATED alias for
+    :func:`dask_ml_tpu.parallel.telemetry.span(name, logger=logger)
+    <dask_ml_tpu.parallel.telemetry.span>` — kept so pre-telemetry call
+    sites and user code keep working unchanged.
 
-    Inside the scope the phase appears as a ``TraceAnnotation`` in any
-    active profiler capture; when ``DASK_ML_TPU_PROFILE_DIR`` is set the
-    outermost phase in each thread also starts/stops a full
-    ``jax.profiler.trace`` capture into that directory.
+    The contract is byte-for-byte the old one: the phase appears as a
+    ``TraceAnnotation`` in any active profiler capture, wall time logs at
+    DEBUG, and when ``DASK_ML_TPU_PROFILE_DIR`` is set the outermost phase
+    in each thread starts/stops a full ``jax.profiler.trace`` capture into
+    that directory (logged at INFO). Additionally — new with the telemetry
+    subsystem — the phase records a span when the ``telemetry`` config
+    knob is on.
     """
-    import jax.profiler
+    from dask_ml_tpu.parallel.telemetry import span
 
-    trace_dir = os.environ.get(PROFILE_DIR_ENV)
-    own_trace = bool(trace_dir) and not getattr(_trace_state, "active", False)
-    if own_trace:
-        _trace_state.active = True
-        jax.profiler.start_trace(trace_dir)
-    t0 = time.perf_counter()
-    try:
-        with jax.profiler.TraceAnnotation(name):
-            yield
-    finally:
-        dt = time.perf_counter() - t0
-        if own_trace:
-            jax.profiler.stop_trace()
-            _trace_state.active = False
-            logger.info("phase %s: %.3fs (trace -> %s)", name, dt, trace_dir)
-        else:
-            logger.debug("phase %s: %.3fs", name, dt)
+    return span(name, logger=logger)

@@ -65,6 +65,13 @@ SECTIONS = [
      "summation, and the solver-state f32 floor — see docs/precision.md "
      "for the policy semantics, the accuracy-gate tolerances, and what "
      "'auto' picks on each backend."),
+    ("dask_ml_tpu.parallel.telemetry", "Telemetry",
+     "The unified observability subsystem: hierarchical spans (ring-buffer "
+     "recorded, TraceAnnotation-emitting), the thread-safe metrics "
+     "registry every legacy counter mirrors into, the JSON-round-trippable "
+     "telemetry_report(), and Perfetto/Chrome trace export — all behind "
+     "the thread-local `telemetry` config knob whose disabled path is a "
+     "measured near-no-op; see docs/observability.md."),
     ("dask_ml_tpu.parallel.faults", "Fault tolerance",
      "Retry/backoff for transient host-I/O and device-transfer failures, "
      "preemption-safe checkpoint/drain/resume for the streamed tier, and "

@@ -24,7 +24,7 @@ def test_defaults():
     cfg = get_config()
     assert cfg == {"dtype": None, "mesh": None, "device_outputs": False,
                    "pad_policy": "auto", "precision": "auto",
-                   "compilation_cache": None}
+                   "telemetry": False, "compilation_cache": None}
 
 
 def test_device_outputs_scopes_transform_results():

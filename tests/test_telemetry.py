@@ -1,0 +1,389 @@
+"""Unified telemetry (docs/observability.md): span nesting and thread
+isolation, registry-counter exactness against the legacy surfaces they
+mirror (under the PR-3 FaultInjector), queue-depth gauge bounds, JSON
+round-trip of the report, Chrome-trace export, and the disabled knob's
+no-recorder-growth contract.
+"""
+
+import json
+import logging
+import threading
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu import config
+from dask_ml_tpu.parallel import telemetry
+from dask_ml_tpu.parallel.faults import FaultInjector, RetryPolicy
+from dask_ml_tpu.parallel.stream import HostBlockSource, prefetched_scan
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.reset_telemetry()
+    yield
+    telemetry.reset_telemetry()
+
+
+def _streamed_blocks(n=512, d=4, n_blocks=4, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    w = np.ones(n, np.float32)
+    return X, w, n_blocks
+
+
+def _consume(source, prefetch=None):
+    """Drive a prefetched_scan over the source with a trivial jitted step."""
+    import jax
+
+    @jax.jit
+    def _sum(blk):
+        return blk[0].sum()
+
+    def step(carry, b, blk):
+        return carry + float(np.asarray(_sum(blk))), None
+
+    return prefetched_scan(step, 0.0, source, prefetch=prefetch)
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_records_parent_child():
+    with config.config_context(telemetry=True):
+        with telemetry.span("outer", phase="fit") as so:
+            with telemetry.span("inner", block=3) as si:
+                assert si.parent_id == so.sid
+    recs = telemetry.spans()
+    assert [r["name"] for r in recs] == ["inner", "outer"]  # finish order
+    inner, outer = recs
+    assert inner["parent"] == outer["id"]
+    assert inner["depth"] == 1 and outer["depth"] == 0
+    assert outer["attrs"] == {"phase": "fit"}
+    assert inner["attrs"] == {"block": 3}
+    assert inner["dur"] <= outer["dur"]
+
+
+def test_span_set_and_sync_attrs():
+    import jax.numpy as jnp
+
+    with config.config_context(telemetry=True):
+        with telemetry.span("phase") as sp:
+            sp.set(n=128)
+            sp.sync(jnp.ones(8) * 2)
+    [rec] = telemetry.spans()
+    assert rec["attrs"]["n"] == 128
+    assert rec["sync_seconds"] >= 0.0
+
+
+def test_span_thread_isolation():
+    """Concurrent spans in two threads never parent across threads (the
+    span stack is thread-local; the ring is shared)."""
+    barrier = threading.Barrier(2)
+    config.set_config(telemetry=True)
+    try:
+        def work(tag):
+            barrier.wait()
+            with telemetry.span(f"outer-{tag}"):
+                with telemetry.span(f"inner-{tag}"):
+                    pass
+
+        threads = [threading.Thread(target=work, args=(t,), name=f"w{t}")
+                   for t in ("a", "b")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        config.set_config(telemetry=False)
+    recs = {r["name"]: r for r in telemetry.spans()}
+    assert set(recs) == {"outer-a", "inner-a", "outer-b", "inner-b"}
+    for tag in ("a", "b"):
+        assert recs[f"outer-{tag}"]["parent"] is None
+        assert recs[f"inner-{tag}"]["parent"] == recs[f"outer-{tag}"]["id"]
+        assert recs[f"inner-{tag}"]["tid"] == recs[f"outer-{tag}"]["tid"]
+    assert recs["inner-a"]["tid"] != recs["inner-b"]["tid"]
+
+
+def test_ring_buffer_bounded_and_drop_counted():
+    telemetry.reset_telemetry(ring_capacity=4)
+    with config.config_context(telemetry=True):
+        for i in range(10):
+            with telemetry.span("s", i=i):
+                pass
+        rep = telemetry.telemetry_report()
+    assert rep["spans"]["n_recorded"] == 4
+    assert rep["spans"]["n_dropped"] == 6
+    assert [r["attrs"]["i"] for r in telemetry.spans()] == [6, 7, 8, 9]
+
+
+# ---------------------------------------------------------------------------
+# disabled knob: near-no-op, zero recorder growth
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_knob_leaves_no_telemetry_growth():
+    assert config.get_config()["telemetry"] is False
+    with telemetry.span("phase", a=1) as sp:
+        sp.set(b=2)
+        sp.sync(np.zeros(3))
+    telemetry.counter("c").inc(5)
+    telemetry.gauge("g").set(1)
+    telemetry.histogram("h").observe(2)
+    assert telemetry.spans() == []
+    snap = telemetry.metrics().snapshot()
+    assert snap == {"counters": {}, "gauges": {}, "histograms": {}}
+
+
+def test_disabled_span_and_metrics_are_shared_nulls():
+    """The disabled fast path hands back SHARED singletons — the
+    allocation-visible contract the <1% bench overhead gate rests on."""
+    with telemetry.span("a") as s1:
+        pass
+    with telemetry.span("b", k=1) as s2:
+        pass
+    assert s1 is s2
+    assert telemetry.counter("x") is telemetry.counter("y", l="z")
+    assert telemetry.counter("x") is telemetry.gauge("x")
+
+
+def test_disabled_streamed_run_records_nothing(mesh8):
+    X, w, nb = _streamed_blocks()
+    src = HostBlockSource((X, w), nb)
+    _consume(src)
+    assert telemetry.spans() == []
+    assert telemetry.metrics().snapshot()["counters"] == {}
+    assert src.bytes_streamed > 0  # the legacy surface still works
+
+
+# ---------------------------------------------------------------------------
+# registry mirrors: exact against the legacy surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_stream_counters_match_source_exactly(mesh8):
+    X, w, nb = _streamed_blocks()
+    src = HostBlockSource((X, w), nb)
+    with config.config_context(telemetry=True):
+        _consume(src)
+        c = telemetry.metrics().snapshot()["counters"]
+    assert c["stream.bytes_streamed"] == src.bytes_streamed
+    assert c["stream.logical_bytes_streamed"] == src.logical_bytes_streamed
+    assert c["stream.blocks_started"] == src.blocks_started == nb
+
+
+def test_retry_counters_exact_under_fault_injector(mesh8):
+    """Injected retries produce EXACTLY matching registry values: the
+    mirror sits at the same increment site as RetryPolicy's own counters."""
+    X, w, nb = _streamed_blocks()
+    policy = RetryPolicy(max_retries=3, base_delay=0.001)
+    inj = FaultInjector().fail_load(1, times=2).fail_transfer(2, times=1)
+    src = HostBlockSource((X, w), nb, retry_policy=policy,
+                          fault_injector=inj)
+    with config.config_context(telemetry=True):
+        _consume(src)
+        c = telemetry.metrics().snapshot()["counters"]
+    stats = policy.stats()
+    assert stats["retries"] == 3  # the injected plan, exactly
+    assert c["faults.retries{kind=block-load}"] == stats["by_kind"][
+        "block-load"] == 2
+    assert c["faults.retries{kind=device-put}"] == stats["by_kind"][
+        "device-put"] == 1
+    assert c["faults.backoff_seconds"] == pytest.approx(
+        stats["delay_spent_seconds"], abs=1e-3)
+    # per-source byte counters stay exact across the retries too
+    # (per-block-once, the PR-3 contract) — and so must the mirrors
+    assert c["stream.bytes_streamed"] == src.bytes_streamed == X.nbytes + \
+        w.nbytes
+
+
+def test_discard_inflight_rolls_mirrors_back(mesh8):
+    X, w, nb = _streamed_blocks()
+    src = HostBlockSource((X, w), nb)
+    with config.config_context(telemetry=True):
+        src.start(0)
+        src.start(1)
+        src.take(0)
+        src.discard_inflight()  # block 1 was issued but never consumed
+        c = telemetry.metrics().snapshot()["counters"]
+    assert c["stream.bytes_streamed"] == src.bytes_streamed
+    assert c["stream.blocks_started"] == src.blocks_started == 1
+
+
+@pytest.mark.parametrize("prefetch", [0, 2])
+def test_queue_depth_gauge_bounds(mesh8, prefetch):
+    X, w, nb = _streamed_blocks()
+    src = HostBlockSource((X, w), nb, prefetch=prefetch)
+    with config.config_context(telemetry=True):
+        _consume(src, prefetch=prefetch)
+        g = telemetry.metrics().snapshot()["gauges"]["stream.queue_depth"]
+    assert g["n_samples"] == nb  # sampled at every take()
+    assert 0 <= g["min"] <= g["max"] <= prefetch
+
+
+def test_lloyd_pruning_mirrors_match_estimator(mesh8):
+    from dask_ml_tpu.cluster import KMeans
+
+    X = np.random.RandomState(0).randn(1024, 8).astype(np.float32)
+    with config.config_context(telemetry=True):
+        km = KMeans(n_clusters=4, algorithm="bounded", max_iter=15,
+                    random_state=0).fit(X)
+        snap = telemetry.metrics().snapshot()
+    c, h = snap["counters"], snap["histograms"]
+    assert c["kmeans.lloyd.rows_skipped"] == km.lloyd_pruning_[
+        "rows_skipped"]
+    assert c["kmeans.lloyd.rows_considered"] == km.lloyd_pruning_[
+        "rows_considered"]
+    assert c["kmeans.lloyd.distances_avoided"] == km.lloyd_pruning_[
+        "distances_avoided"]
+    per_iter = km.lloyd_pruning_["pruned_fraction_per_iter"]
+    hist = h["kmeans.lloyd.pruned_fraction"]
+    assert hist["count"] == len(per_iter)
+    assert hist["sum"] == pytest.approx(sum(per_iter))
+    assert h["kmeans.lloyd.iterations"]["count"] == 1
+    assert h["kmeans.lloyd.iterations"]["max"] == km.n_iter_
+
+
+def test_compile_mirror_matches_track_compiles(mesh8):
+    """Compile events land in the registry with the same counts the
+    shapes.py listener records (mirrored inside the same callback)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dask_ml_tpu.parallel.shapes import track_compiles
+
+    # a never-before-seen program shape forces at least one real compile
+    fresh = jax.jit(lambda x: (x * 3 + 1).sum() * 7)
+    with config.config_context(telemetry=True):
+        with track_compiles() as t:
+            fresh(jnp.ones((37, 3)))
+        c = telemetry.metrics().snapshot()["counters"]
+    assert t["n_compiles"] >= 1
+    assert c["compile.n_compiles"] == t["n_compiles"]
+    assert c["compile.n_traces"] == t["n_traces"]
+
+
+def test_bucket_hit_counter(mesh8):
+    from dask_ml_tpu.parallel.sharding import prepare_data
+
+    X = np.zeros((100, 4), np.float32)
+    with config.config_context(telemetry=True):
+        prepare_data(X)
+        prepare_data(X)
+        c = telemetry.metrics().snapshot()["counters"]
+    hits = {k: v for k, v in c.items() if k.startswith("shapes.bucket_hits")}
+    assert hits and sum(hits.values()) >= 2
+
+
+# ---------------------------------------------------------------------------
+# report + export
+# ---------------------------------------------------------------------------
+
+
+def test_report_round_trips_through_json(mesh8):
+    X, w, nb = _streamed_blocks()
+    with config.config_context(telemetry=True):
+        _consume(HostBlockSource((X, w), nb))
+        rep = telemetry.telemetry_report()
+    assert json.loads(json.dumps(rep)) == rep
+    assert rep["enabled"] in (True, False)
+    assert rep["metrics"]["counters"]["stream.blocks_started"] == nb
+    assert rep["spans"]["n_recorded"] > 0
+    # the report IS the compile_stats surface (pulled live)
+    from dask_ml_tpu.parallel.shapes import compile_stats
+
+    cs = compile_stats()
+    for key in ("n_compiles", "n_traces"):
+        assert rep["compile"][key] <= cs[key]  # only grows between calls
+
+
+def test_render_report_text(mesh8):
+    with config.config_context(telemetry=True):
+        with telemetry.span("phase-one"):
+            pass
+        telemetry.counter("demo.count").inc(2)
+        text = telemetry.render_report()
+    assert "phase-one" in text
+    assert "demo.count" in text
+    assert "compile:" in text
+
+
+def test_export_chrome_trace_loads_in_perfetto_format(tmp_path, mesh8):
+    X, w, nb = _streamed_blocks()
+    with config.config_context(telemetry=True):
+        _consume(HostBlockSource((X, w), nb))
+    out = tmp_path / "trace.json"
+    telemetry.export_chrome_trace(out)
+    payload = json.load(open(out))
+    events = payload["traceEvents"]
+    assert events, "empty trace"
+    xs = [e for e in events if e.get("ph") == "X"]
+    assert xs, "no complete events"
+    for e in xs:
+        assert {"name", "pid", "tid", "ts", "dur", "args"} <= set(e)
+        assert e["dur"] >= 0
+    # hierarchy survives: every child's parent_span_id is a span_id
+    ids = {e["args"]["span_id"] for e in xs}
+    parents = {e["args"]["parent_span_id"] for e in xs
+               if "parent_span_id" in e["args"]}
+    assert parents and parents <= ids
+    # metadata rows for Perfetto track naming
+    assert any(e.get("name") == "process_name" for e in events)
+    assert any(e.get("name") == "thread_name" for e in events)
+
+
+def test_search_cell_spans_and_report_section(mesh8):
+    from dask_ml_tpu.cluster import KMeans
+    from dask_ml_tpu.model_selection import GridSearchCV
+
+    X = np.random.RandomState(0).randn(512, 6).astype(np.float32)
+    with config.config_context(telemetry=True):
+        gs = GridSearchCV(
+            KMeans(init="random", max_iter=5, random_state=0),
+            {"n_clusters": [2, 3]}, cv=2, refit=False, iid=False,
+        ).fit(X)
+        cells = [r for r in telemetry.spans() if r["name"] == "search.cell"]
+        report = gs.shared_fit_report()
+    assert len(cells) == 4  # 2 candidates x 2 splits
+    assert {(r["attrs"]["candidate"], r["attrs"]["split"])
+            for r in cells} == {(c, s) for c in (0, 1) for s in (0, 1)}
+    assert "telemetry:" in report
+
+
+# ---------------------------------------------------------------------------
+# profile_phase compatibility + log_array satellite
+# ---------------------------------------------------------------------------
+
+
+def test_profile_phase_is_span_alias(caplog):
+    from dask_ml_tpu.utils import profile_phase
+
+    logger = logging.getLogger("test_pp_alias")
+    with config.config_context(telemetry=True):
+        with caplog.at_level(logging.DEBUG, logger="test_pp_alias"):
+            with profile_phase(logger, "alias-phase"):
+                pass
+    # legacy contract: DEBUG wall-time line ...
+    assert any("alias-phase" in r.getMessage() for r in caplog.records)
+    # ... plus, new, a recorded span
+    assert [r["name"] for r in telemetry.spans()] == ["alias-phase"]
+
+
+def test_log_array_bf16_itemsize_fallback(caplog):
+    """nbytes-less duck arrays report dtype-true sizes: bf16 is 2 bytes,
+    not the old 4-byte guess (which doubled the reported size)."""
+    import jax.numpy as jnp
+
+    from dask_ml_tpu.utils import log_array
+
+    class FakeArr:
+        shape = (4, 4)
+        dtype = jnp.bfloat16  # scalar TYPE: no .itemsize attribute
+
+    logger = logging.getLogger("test_log_bf16")
+    with caplog.at_level(logging.INFO, logger="test_log_bf16"):
+        log_array(logger, "Xbf16", FakeArr())
+    [rec] = caplog.records
+    assert "32 B" in rec.getMessage()  # 16 items x 2 bytes, not 64 B
