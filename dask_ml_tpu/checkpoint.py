@@ -30,9 +30,11 @@ truncation-tolerant reader, so a kill mid-write never corrupts a restart.
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import os
 import pickle
+import struct
 import tempfile
 import threading
 from typing import Any, Optional
@@ -42,9 +44,25 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 
+class CheckpointCorruptError(RuntimeError):
+    """A snapshot file exists but fails its integrity check (torn write,
+    truncation, bit rot). Raised LOUDLY instead of resuming garbage — the
+    same discipline as :class:`CellJournal`'s torn-frame drop, except a
+    snapshot has no earlier intact frames to fall back to, so corruption is
+    an error the operator must see (delete the file to restart clean)."""
+
+
 # ---------------------------------------------------------------------------
 # atomic pytree snapshots
 # ---------------------------------------------------------------------------
+
+#: framed snapshot header: magic + 8-byte payload length + sha256 digest.
+#: The frame is what turns "atomic rename" into an end-to-end guarantee —
+#: rename protects against a kill mid-save, the checksum protects against
+#: everything else (a torn copy, a truncated transfer off shared storage,
+#: silent media corruption): any byte missing or flipped fails the digest
+#: and raises :class:`CheckpointCorruptError` instead of unpickling noise.
+_SNAPSHOT_MAGIC = b"DMLTCKPT1\n"
 
 
 def _to_host(tree):
@@ -70,12 +88,16 @@ def save_pytree(path: str, tree: Any, meta: Optional[dict] = None) -> None:
     previous snapshot intact.
     """
     payload = {"tree": _to_host(tree), "meta": meta or {}}
+    body = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+    header = (_SNAPSHOT_MAGIC + struct.pack(">Q", len(body))
+              + hashlib.sha256(body).digest())
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".ckpt.tmp")
     try:
         with os.fdopen(fd, "wb") as f:
-            pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+            f.write(header)
+            f.write(body)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
@@ -90,11 +112,55 @@ def save_pytree(path: str, tree: Any, meta: Optional[dict] = None) -> None:
 
 def load_pytree(path: str):
     """Load a :func:`save_pytree` snapshot → ``(tree, meta)``, or ``None``
-    if the file does not exist."""
+    if the file does not exist.
+
+    Integrity is verified end to end: the framed header's length + sha256
+    must match the payload exactly, so a snapshot truncated at ANY byte
+    offset — or with any byte altered — raises
+    :class:`CheckpointCorruptError` instead of resuming garbage (swept in
+    ``tests/test_checkpoint.py``). Pre-frame legacy snapshots (no magic)
+    still load, with unpickling failures wrapped in the same loud error.
+    """
     if not os.path.exists(path):
         return None
     with open(path, "rb") as f:
-        payload = pickle.load(f)
+        head = f.read(len(_SNAPSHOT_MAGIC))
+        if head == _SNAPSHOT_MAGIC:
+            rest = f.read()
+            if len(rest) < 8 + 32:
+                raise CheckpointCorruptError(
+                    f"checkpoint {path}: truncated header "
+                    f"({len(head) + len(rest)} bytes) — the snapshot is "
+                    "torn; delete it to restart from scratch")
+            (length,) = struct.unpack(">Q", rest[:8])
+            digest, body = rest[8:40], rest[40:]
+            if len(body) != length:
+                raise CheckpointCorruptError(
+                    f"checkpoint {path}: payload is {len(body)} bytes but "
+                    f"the header recorded {length} — the snapshot is "
+                    "truncated; delete it to restart from scratch")
+            if hashlib.sha256(body).digest() != digest:
+                raise CheckpointCorruptError(
+                    f"checkpoint {path}: payload checksum mismatch — the "
+                    "snapshot is corrupt; delete it to restart from "
+                    "scratch")
+            payload = pickle.loads(body)
+        else:
+            # legacy (pre-frame) snapshot: no digest to verify, but failures
+            # still surface loudly instead of as bare unpickling noise
+            try:
+                f.seek(0)
+                payload = pickle.load(f)
+            except Exception as e:
+                raise CheckpointCorruptError(
+                    f"checkpoint {path}: unreadable legacy snapshot "
+                    f"({type(e).__name__}: {e}); delete it to restart from "
+                    "scratch") from e
+    if not (isinstance(payload, dict) and "tree" in payload
+            and "meta" in payload):
+        raise CheckpointCorruptError(
+            f"checkpoint {path}: payload is not a snapshot (corrupt or "
+            "foreign file); delete it to restart from scratch")
     logger.info("checkpoint loaded: %s (meta=%s)", path, payload["meta"])
     return payload["tree"], payload["meta"]
 

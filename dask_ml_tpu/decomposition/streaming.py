@@ -93,7 +93,7 @@ def _moments_step(carry, blk, *, transform):
 
 
 def _streamed_moments_host(source, checkpoint_path=None,
-                           checkpoint_every=None):
+                           checkpoint_every=None, elastic=None):
     """Host-driven accumulation over a ``HostBlockSource``: block b+1's
     transfer overlaps block b's Gram matmul (depth = ``source.prefetch``;
     0 = the strict serial overlap-off baseline).
@@ -122,10 +122,24 @@ def _streamed_moments_host(source, checkpoint_path=None,
                 bind={"what": "streamed_moments",
                       "n_blocks": source.n_blocks,
                       "d": int(d),
+                      # an elastic snapshot has no moments carry (the
+                      # published blocks ARE the progress) — resuming it
+                      # through the single-host carry path must be a loud
+                      # bind error
+                      "elastic": elastic is not None,
                       # carry layout version: v2 added the Neumaier
                       # compensation terms — a v1 snapshot must error
                       # loudly, not resume into a different tree structure
                       "carry_v": 2}) as scan_ckpt:
+            if elastic is not None:
+                # the multi-host sharded pass: per-block moments published
+                # to the shared workdir, survivors rebalance a lost host's
+                # blocks, every host folds in canonical block-id order
+                # (parallel/elastic.py; docs/robustness.md)
+                from dask_ml_tpu.parallel.elastic import elastic_moments_host
+
+                return elastic_moments_host(elastic, source,
+                                            scan_checkpoint=scan_ckpt)
             if scan_ckpt is not None:
                 snap = scan_ckpt.load()
                 if snap is not None:
@@ -140,7 +154,7 @@ def _streamed_moments_host(source, checkpoint_path=None,
 
 
 def streamed_moments(*, block_fn, n_blocks, checkpoint_path=None,
-                     checkpoint_every=None):
+                     checkpoint_every=None, elastic=None):
     """One pass over all blocks → ``(sw, sums, gram)``:
     Σw, Σ w·x (d,), Σ w·xxᵀ (d, d) — f32 accumulation, Neumaier-compensated
     across blocks (low-precision blocks upcast on device; see
@@ -153,7 +167,16 @@ def streamed_moments(*, block_fn, n_blocks, checkpoint_path=None,
     ``checkpoint_path``/``checkpoint_every`` (host-source mode only) make
     the pass preemption-safe — snapshots every k blocks, SIGTERM-driven
     graceful drain, resume from the last complete block; see
-    ``docs/robustness.md``."""
+    ``docs/robustness.md``.
+
+    ``elastic`` (an :class:`~dask_ml_tpu.parallel.elastic.ElasticRun`,
+    host-source mode only) shards the pass over a fleet of processes:
+    each host computes and publishes its shard's per-block moments,
+    survivors rebalance a lost host's blocks, and every host folds the
+    published moments in canonical block-id order — elastic results are
+    bit-identical across rosters/deaths/resumes and match this
+    single-host path to Neumaier accuracy (``docs/robustness.md``
+    "Elastic epochs")."""
     from dask_ml_tpu.parallel.stream import HostBlockSource
 
     if isinstance(block_fn, HostBlockSource):
@@ -162,11 +185,16 @@ def streamed_moments(*, block_fn, n_blocks, checkpoint_path=None,
                 f"n_blocks={n_blocks} does not match the HostBlockSource's "
                 f"{block_fn.n_blocks} blocks")
         return _streamed_moments_host(block_fn, checkpoint_path,
-                                      checkpoint_every)
+                                      checkpoint_every, elastic=elastic)
     if checkpoint_path is not None:
         raise ValueError(
             "checkpoint_path= requires a HostBlockSource: a traced "
             "block_fn runs the whole pass as one compiled scan")
+    if elastic is not None:
+        raise ValueError(
+            "elastic= requires a HostBlockSource: the elastic data plane "
+            "shards host-resident block INGESTION across processes — a "
+            "traced block_fn has no host blocks to shard")
     return _streamed_moments_device(block_fn=block_fn, n_blocks=int(n_blocks))
 
 
@@ -187,7 +215,8 @@ def _pca_from_moments(sw, s, G):
 
 
 def pca_fit_blocks(block_fn, n_blocks, n_components, pca=None,
-                   checkpoint_path=None, checkpoint_every=None):
+                   checkpoint_path=None, checkpoint_every=None,
+                   elastic=None):
     """Fit a :class:`dask_ml_tpu.decomposition.PCA` from streamed blocks.
 
     Returns a fitted PCA estimator (components_, explained_variance_ and
@@ -195,13 +224,15 @@ def pca_fit_blocks(block_fn, n_blocks, n_components, pca=None,
     ``transform``/``inverse_transform`` exactly like an in-memory fit.
     ``pca`` optionally supplies a pre-configured estimator to fill in.
     ``checkpoint_path``/``checkpoint_every`` (host-source mode) make the
-    moment pass preemption-safe — see :func:`streamed_moments`.
+    moment pass preemption-safe, and ``elastic`` shards it over a fleet
+    with survivor rebalancing — see :func:`streamed_moments`.
     """
     from dask_ml_tpu.decomposition import PCA
 
     sw, s, G = streamed_moments(block_fn=block_fn, n_blocks=int(n_blocks),
                                 checkpoint_path=checkpoint_path,
-                                checkpoint_every=checkpoint_every)
+                                checkpoint_every=checkpoint_every,
+                                elastic=elastic)
     mean, evals, comps = _pca_from_moments(sw, s, G)
     mean, evals, comps, sw = jax.device_get((mean, evals, comps, sw))
 

@@ -243,7 +243,7 @@ class _GLM(BaseEstimator):
     # -- larger-than-HBM block streaming ----------------------------------
 
     def fit_blocks(self, block_fn, n_blocks, n_samples, n_features,
-                   classes=None, sw_total=None):
+                   classes=None, sw_total=None, elastic=None):
         """Fit from streamed row blocks — data larger than device memory.
 
         ``block_fn(b) -> (X_b, y_b, w_b)`` is a TRACED function producing
@@ -276,6 +276,14 @@ class _GLM(BaseEstimator):
         complete block with a bit-identical trajectory. Pair the source
         with a :class:`~dask_ml_tpu.parallel.faults.RetryPolicy` to also
         survive transient loader/transfer failures (docs/robustness.md).
+
+        ``elastic`` (an :class:`~dask_ml_tpu.parallel.elastic.ElasticRun`,
+        HostBlockSource mode only) spans the fit over a fleet of
+        processes with seeded epoch shuffling and survivor rebalancing
+        on host loss — every participating process calls ``fit_blocks``
+        with its own source over the SAME global block space and the
+        shared run; results are bit-identical to the single-host fit
+        (docs/robustness.md "Elastic epochs").
         """
         if self.solver != "admm":
             raise ValueError(
@@ -328,7 +336,8 @@ class _GLM(BaseEstimator):
                 beta, n_iter = core.admm_streamed(
                     wrapped, int(n_blocks), d,
                     float(n_samples if sw_total is None else sw_total),
-                    jnp.asarray(mask), family=self.family, **ck, **kwargs)
+                    jnp.asarray(mask), family=self.family, elastic=elastic,
+                    **ck, **kwargs)
         finally:
             if wrapped is not block_fn and isinstance(wrapped,
                                                       HostBlockSource):

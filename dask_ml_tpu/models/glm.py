@@ -1110,7 +1110,7 @@ def admm_streamed(block_fn, n_blocks, d, sw_total, mask=None, *,
                   max_iter=250, abstol=1e-4, reltol=1e-2, inner_max_iter=20,
                   inner_tol=1e-8, state=None, return_state=False,
                   dtype=jnp.float32, checkpoint_path=None,
-                  checkpoint_every=None):
+                  checkpoint_every=None, elastic=None):
     """Consensus ADMM over data LARGER THAN DEVICE MEMORY.
 
     The sharded :func:`admm` holds all of X in HBM; here each outer
@@ -1165,6 +1165,17 @@ def admm_streamed(block_fn, n_blocks, d, sw_total, mask=None, *,
     ``checkpoint_path`` — its whole epoch is one compiled program, so
     chunk it through ``state=``/``return_state`` instead (the
     ``solve_checkpointed`` pattern).
+
+    ``elastic`` (an :class:`~dask_ml_tpu.parallel.elastic.ElasticRun`,
+    host-source mode only) spans the epoch over a FLEET of processes:
+    this host consumes its shard of the run's seeded block permutation,
+    publishes per-block results to the shared workdir, and survivors
+    rebalance a lost host's unconsumed blocks mid-epoch — the final
+    (z, x, u) trajectory is bit-identical to the uninterrupted
+    single-host run whatever the roster did (``parallel/elastic.py``;
+    ``docs/robustness.md`` "Elastic epochs"). Composes with
+    ``checkpoint_path`` (resume replays the snapshot's own shuffled
+    block slice).
     """
     from dask_ml_tpu.parallel.stream import HostBlockSource
 
@@ -1210,6 +1221,11 @@ def admm_streamed(block_fn, n_blocks, d, sw_total, mask=None, *,
                 bind={"what": "admm_streamed", "n_blocks": int(n_blocks),
                       "d": int(d), "family": family,
                       "regularizer": regularizer,
+                      # elastic snapshots store POSITIONS into a shuffled
+                      # block sequence; resuming one as a canonical
+                      # range(n_blocks) scan (or vice versa) must be a
+                      # loud bind error, never a silent reorder
+                      "elastic": elastic is not None,
                       "params": repr((float(lamduh), float(rho),
                                       float(abstol), float(reltol),
                                       float(inner_tol), float(sw_total),
@@ -1221,15 +1237,25 @@ def admm_streamed(block_fn, n_blocks, d, sw_total, mask=None, *,
             # the disabled path, so pipelining is unchanged knob-off)
             with telemetry.span("glm.admm.streamed", blocks=int(n_blocks),
                                 d=int(d), family=family) as sp:
-                z, n_iter, x, u, done = _admm_streamed_host(
-                    block_fn, z0, x0, u0, jnp.asarray(mask, sdt), lam_d,
-                    rho_d, abstol_d, reltol_d, tol_d, sw_d,
+                host_kw = dict(
                     check_done=(float(abstol) != 0.0
                                 or float(reltol) != 0.0),
                     family=family, regularizer=regularizer,
                     max_iter=int(max_iter),
                     inner_max_iter=int(inner_max_iter),
                     scan_checkpoint=scan_ckpt)
+                if elastic is not None:
+                    from dask_ml_tpu.parallel.elastic import \
+                        elastic_admm_host
+                    z, n_iter, x, u, done = elastic_admm_host(
+                        elastic, block_fn, z0, x0, u0,
+                        jnp.asarray(mask, sdt), lam_d, rho_d, abstol_d,
+                        reltol_d, tol_d, sw_d, **host_kw)
+                else:
+                    z, n_iter, x, u, done = _admm_streamed_host(
+                        block_fn, z0, x0, u0, jnp.asarray(mask, sdt),
+                        lam_d, rho_d, abstol_d, reltol_d, tol_d, sw_d,
+                        **host_kw)
                 sp.sync(z)
     else:
         if checkpoint_path is not None:
@@ -1238,6 +1264,12 @@ def admm_streamed(block_fn, n_blocks, d, sw_total, mask=None, *,
                 "block_fn runs each epoch as one compiled program, so "
                 "preemption-safe chunking goes through state=/return_state "
                 "instead (see checkpoint.solve_checkpointed)")
+        if elastic is not None:
+            raise ValueError(
+                "elastic= requires a HostBlockSource: the elastic data "
+                "plane shards host-resident block INGESTION across "
+                "processes — a traced block_fn has no host blocks to "
+                "shard (parallel/elastic.py)")
         z, n_iter, x, u, done = _admm_streamed_impl(
             z0, x0, u0, jnp.asarray(mask, sdt), *scalars,
             block_fn=block_fn, n_blocks=int(n_blocks), family=family,

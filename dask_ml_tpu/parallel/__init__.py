@@ -64,6 +64,10 @@ from dask_ml_tpu.parallel.stream import (  # noqa: F401
     HostBlockSource,
     prefetched_scan,
 )
+from dask_ml_tpu.parallel.elastic import (  # noqa: F401
+    BlockPlan,
+    ElasticRun,
+)
 
 # runtime (multi-host bootstrap) is imported lazily by users that need it:
 #   from dask_ml_tpu.parallel import runtime; runtime.initialize(...)

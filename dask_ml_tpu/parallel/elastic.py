@@ -1,0 +1,766 @@
+"""Elastic multi-host data plane: sharded ingestion, seeded epoch
+shuffling, and survivor rebalancing on host loss.
+
+The streamed >HBM tier (``parallel/stream.py`` + the streamed solvers) is
+single-host: one process owns the whole block space and the whole epoch.
+This module spans that stream across a FLEET of processes and makes it
+survive losing part of it — the capability dask-ml inherited for free from
+the ``dask.distributed`` scheduler (worker-loss resilience + data
+distribution), rebuilt on the substrate this repo actually owns:
+
+- :class:`BlockPlan` — a deterministic, seeded cross-epoch block
+  permutation plus the contiguous shard split that assigns each host its
+  slice of an epoch. Every host computes the same plan from the same seed,
+  so there is no scheduler process and nothing to elect: coordination is
+  arithmetic.
+- :class:`ElasticRun` — the per-process runtime handle: file-based
+  heartbeats + tombstones for liveness (the processes share a filesystem,
+  not a ``jax.distributed`` runtime — rebalancing must work exactly when
+  collectives are the thing that died), and atomic per-block result
+  publication through :func:`dask_ml_tpu.checkpoint.save_pytree` (torn
+  writes impossible: temp-file + rename + sha256 frame).
+- the rebalance protocol — when a host is lost (heartbeats stale / killed)
+  or drained (SIGTERM via
+  :class:`~dask_ml_tpu.parallel.faults.GracefulDrain`, which leaves a
+  tombstone so survivors skip the timeout), its missing blocks are
+  re-dealt round-robin to the survivors, deterministically, each survivor
+  computing only its own share. A false-positive death (a host that was
+  merely slow) costs duplicate compute, never correctness: block results
+  are pure functions of (epoch-start state, block data), and publication
+  is idempotent.
+
+The bit-identity theorem the tests pin: because every per-block program
+depends only on the epoch-start carry and the block's contents, and the
+cross-block combine folds results in canonical block-id order, the final
+trajectory is IDENTICAL — bit for bit — no matter how many hosts
+participated, which of them died, or how the epoch was shuffled. An
+elastic run that loses a host mid-epoch finishes with exactly the bytes
+of the uninterrupted single-host run (``bench.py --faults --elastic``
+gates this; ``tests/test_elastic.py`` pins it per consumer).
+
+Consumers thread through the existing facades:
+``models/glm.py::admm_streamed(..., elastic=run)`` and
+``decomposition/streaming.py::streamed_moments`` /
+``pca_fit_blocks(..., elastic=run)``; the scan side rides the shard-aware
+``prefetched_scan(blocks=...)`` coordinates, so PR-3's
+:class:`~dask_ml_tpu.parallel.faults.ScanCheckpoint` contract composes —
+resume mid-shuffled-epoch replays the snapshot's own block sequence
+(``meta['blocks']``) and stays bit-identical.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from dask_ml_tpu.parallel import telemetry
+from dask_ml_tpu.parallel.faults import Preempted
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["BlockPlan", "ElasticRun", "SimulatedHostDeath"]
+
+
+class SimulatedHostDeath(RuntimeError):
+    """An injected host death fired (``FaultInjector.die_at``): this
+    process is simulating SIGKILL / machine loss — no drain, no snapshot,
+    no tombstone; its heartbeats simply stop. In-process tests catch this
+    where a real dead host would just be gone; the ``bench.py --faults
+    --elastic`` drill worker turns it into ``os._exit``."""
+
+    def __init__(self, message: str, rank: int = 0):
+        super().__init__(message)
+        self.rank = int(rank)
+
+
+# ---------------------------------------------------------------------------
+# the deterministic plan: seeded epoch permutation + shard split + re-deal
+# ---------------------------------------------------------------------------
+
+
+class BlockPlan:
+    """Deterministic, seeded cross-epoch block permutation + shard split.
+
+    ``epoch_order(e)`` is a permutation of ``range(n_blocks)`` drawn from
+    ``np.random.RandomState([seed, e])`` — a pure function of (seed,
+    epoch), so every host (and every resume) derives the identical order
+    with no communication; ``shuffle=False`` keeps block-id order (the
+    plan still shards). Cross-epoch reshuffling is what the massive-data
+    epoch-streaming regime wants (PAPERS.md, arxiv 1605.02989: each epoch
+    visits blocks in a fresh order) — and because the streamed consumers'
+    results are permutation-invariant (per-block programs depend only on
+    the epoch-start carry), the shuffle changes I/O order, never bytes.
+
+    ``shard(order, rank, roster)`` deals ``order`` contiguously over the
+    sorted ``roster`` (even split, remainder to the front — the same rule
+    as ``runtime.process_rows``); :meth:`redeal` deals a missing-block
+    list round-robin over the sorted survivors. Both are pure, so every
+    host computes every other host's assignment without messages.
+    """
+
+    def __init__(self, n_blocks: int, *, seed: int = 0,
+                 shuffle: bool = True):
+        if int(n_blocks) < 1:
+            raise ValueError("n_blocks must be a positive integer")
+        self.n_blocks = int(n_blocks)
+        self.seed = int(seed)
+        self.shuffle = bool(shuffle)
+
+    def epoch_order(self, epoch: int) -> list:
+        if not self.shuffle:
+            return list(range(self.n_blocks))
+        rs = np.random.RandomState(
+            np.array([self.seed & 0xFFFFFFFF, int(epoch) & 0xFFFFFFFF],
+                     dtype=np.uint32))
+        return [int(b) for b in rs.permutation(self.n_blocks)]
+
+    @staticmethod
+    def shard(order: Sequence[int], rank: int, roster) -> list:
+        """``rank``'s contiguous slice of ``order`` among the sorted
+        ``roster`` (even split, remainder to the front ranks)."""
+        roster = sorted(roster)
+        i = roster.index(rank)
+        base, rem = divmod(len(order), len(roster))
+        start = i * base + min(i, rem)
+        stop = start + base + (1 if i < rem else 0)
+        return [int(b) for b in order[start:stop]]
+
+    @staticmethod
+    def redeal(missing: Sequence[int], survivors) -> dict:
+        """Deal the ``missing`` blocks (in their given, epoch-position
+        order) round-robin over the sorted ``survivors`` →
+        ``{block: new_owner_rank}``. Pure, so every survivor derives the
+        same re-deal from the same observed state."""
+        survivors = sorted(survivors)
+        return {int(b): survivors[j % len(survivors)]
+                for j, b in enumerate(missing)}
+
+
+# ---------------------------------------------------------------------------
+# per-process runtime handle: liveness + atomic publication
+# ---------------------------------------------------------------------------
+
+
+class ElasticRun:
+    """Per-process handle on one multi-host elastic fit.
+
+    ``workdir`` is the shared-filesystem coordination directory (every
+    participating process passes the same path): ``hb/`` holds heartbeat
+    files (freshness by mtime), ``dead/`` tombstones (left by graceful
+    leavers and by the deterministic test hook :meth:`mark_dead`), and
+    ``blocks/`` the published per-block results — each written through
+    :func:`~dask_ml_tpu.checkpoint.save_pytree`, so publication is atomic
+    AND checksummed (a torn publish is impossible; a corrupt one raises
+    loudly instead of poisoning a survivor).
+
+    ``rank``/``world`` default to the
+    :func:`~dask_ml_tpu.parallel.runtime.process_rank` /
+    :func:`~dask_ml_tpu.parallel.runtime.process_count` resolution
+    (explicit > ``DASK_ML_TPU_PROCESS_ID`` env > jax.distributed >
+    single-process). ``shuffle_seed``/``shuffle`` configure the
+    :class:`BlockPlan` the consuming drivers build. A host whose
+    heartbeat is older than ``heartbeat_timeout`` seconds (or that left a
+    tombstone) is considered lost; survivors re-deal its missing blocks.
+    ``drain`` (a :class:`~dask_ml_tpu.parallel.faults.GracefulDrain`) is
+    polled while waiting on peers: a requested drain leaves a tombstone
+    (so survivors skip the timeout) and raises
+    :class:`~dask_ml_tpu.parallel.faults.Preempted`.
+
+    Counters ``hosts_lost`` / ``blocks_rebalanced`` mirror into the
+    telemetry registry (``elastic.host_lost`` /
+    ``elastic.blocks_rebalanced``) at their increment sites —
+    docs/observability.md discipline, pinned in
+    ``tests/test_telemetry.py``.
+    """
+
+    def __init__(self, workdir: str, *, rank: Optional[int] = None,
+                 world: Optional[int] = None, shuffle_seed: int = 0,
+                 shuffle: bool = True, heartbeat_timeout: float = 10.0,
+                 poll_interval: float = 0.05, fault_injector=None,
+                 drain=None):
+        from dask_ml_tpu.parallel import runtime
+
+        self.rank = runtime.process_rank() if rank is None else int(rank)
+        self.world = runtime.process_count() if world is None else int(world)
+        if not 0 <= self.rank < self.world:
+            raise ValueError(
+                f"rank {self.rank} out of range [0, {self.world})")
+        self.workdir = str(workdir)
+        self.shuffle_seed = int(shuffle_seed)
+        self.shuffle = bool(shuffle)
+        self.heartbeat_timeout = float(heartbeat_timeout)
+        self.poll_interval = float(poll_interval)
+        self.fault_injector = fault_injector
+        self.drain = drain
+        self.hosts_lost = 0
+        self.blocks_rebalanced = 0
+        self._known_dead: set = set()
+        #: ranks ever COUNTED as lost by this handle: `_known_dead` resets
+        #: per problem namespace (a restarted peer may rejoin the next
+        #: fit), but one physical death must not bump ``hosts_lost`` and
+        #: its registry mirror once per fit on a reused run handle
+        self._ever_lost: set = set()
+        self._t0 = time.time()
+        #: problem namespace: every fit binds its coordination tree
+        #: (heartbeats, tombstones, published blocks) to a fingerprint of
+        #: the problem via :meth:`bind_problem`, so a reused workdir can
+        #: never fold a DIFFERENT fit's published results — or its stale
+        #: tombstones — into this one. Direct API use (tests, custom
+        #: drivers) runs in the "shared" namespace until bound.
+        self._ns = "shared"
+        #: this epoch's published trees, by (epoch, block): what this host
+        #: computed (or already read) need not round-trip through disk
+        #: again in collect_epoch's final assembly. Cleared per epoch.
+        self._cache: dict = {}
+        self._ensure_dirs()
+        self.beat()
+
+    def _dir(self, sub: str) -> str:
+        return os.path.join(self.workdir, self._ns, sub)
+
+    def _ensure_dirs(self) -> None:
+        for sub in ("hb", "dead", "blocks"):
+            os.makedirs(self._dir(sub), exist_ok=True)
+
+    def bind_problem(self, kind: str, **bind) -> str:
+        """Scope this run to a problem fingerprint: the coordination tree
+        moves to ``workdir/<digest>/``, where the digest covers ``kind``
+        plus the driver's bind payload (block count, width, family,
+        hyperparameters, shuffle seed). Two different problems sharing a
+        workdir therefore occupy DISJOINT namespaces — fit 2 can never
+        read fit 1's published blocks as its own (the same discipline as
+        :class:`~dask_ml_tpu.parallel.faults.ScanCheckpoint`'s bind, by
+        construction instead of by check). Re-running the SAME problem
+        reuses its published blocks — that is the resume path. The
+        drivers call this at fit start; every host of a fleet derives
+        the identical digest, so it never needs coordinating."""
+        import hashlib
+        import json as json_lib
+
+        payload = json_lib.dumps({"kind": kind, **bind}, sort_keys=True,
+                                 default=repr)
+        ns = hashlib.sha256(payload.encode()).hexdigest()[:16]
+        if ns != self._ns:
+            self._ns = ns
+            self._known_dead = set()  # loss views are per-namespace
+            self._t0 = time.time()
+            self._cache.clear()
+            self._ensure_dirs()
+            # the human-readable record of what this namespace is; all
+            # writers hold identical content, so the replace race is moot
+            # (tmp is per-writer — in-process multi-host tests share a pid,
+            # so the thread id must disambiguate)
+            import threading
+            desc = os.path.join(self.workdir, ns, "problem.json")
+            tmp = f"{desc}.{os.getpid()}.{threading.get_ident()}.tmp"
+            with open(tmp, "w") as f:
+                f.write(payload)
+            os.replace(tmp, desc)
+            self.beat()
+        return ns
+
+    # -- liveness ----------------------------------------------------------
+
+    def _hb_path(self, rank: int) -> str:
+        return os.path.join(self._dir("hb"), f"host{int(rank)}")
+
+    def _tomb_path(self, rank: int) -> str:
+        return os.path.join(self._dir("dead"), f"host{int(rank)}")
+
+    def beat(self) -> None:
+        """Refresh this process's heartbeat (mtime is the signal; the
+        write is atomic so readers never see a torn file)."""
+        path = self._hb_path(self.rank)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            f.write(f"{time.time():.6f}\n")
+        os.replace(tmp, path)
+
+    def mark_dead(self, rank: int) -> None:
+        """Leave a tombstone for ``rank`` — the deterministic test hook
+        (and the graceful leaver's own exit courtesy): survivors observe
+        the death immediately instead of waiting out the heartbeat
+        timeout."""
+        with open(self._tomb_path(rank), "w") as f:
+            f.write(f"{time.time():.6f}\n")
+
+    def lost_hosts(self) -> set:
+        """Ranks currently considered lost: tombstoned, or heartbeat
+        stale by more than ``heartbeat_timeout`` (a never-seen heartbeat
+        ages from this run's start, so a worker that never launched is
+        eventually declared dead too). Cumulative — a host observed dead
+        stays dead for this run (rejoin is a restart's concern, not a
+        wait loop's). Newly observed deaths bump ``hosts_lost`` and its
+        registry mirror."""
+        lost = set(self._known_dead)
+        now = time.time()
+        for r in range(self.world):
+            if r == self.rank or r in lost:
+                continue
+            if os.path.exists(self._tomb_path(r)):
+                lost.add(r)
+                continue
+            try:
+                age = now - os.path.getmtime(self._hb_path(r))
+            except OSError:
+                age = now - self._t0
+                fresh_hb = False
+            else:
+                fresh_hb = True
+            if age > self.heartbeat_timeout:
+                lost.add(r)
+            elif fresh_hb and r in self._ever_lost:
+                # an actual heartbeat from a previously-counted rank: it
+                # restarted and rejoined — a later death is a NEW loss
+                self._ever_lost.discard(r)
+        new = lost - self._known_dead
+        if new:
+            self._known_dead |= new
+            logger.warning("elastic: host(s) %s lost (rank %d observing)",
+                           sorted(new), self.rank)
+            counted = new - self._ever_lost
+            if counted:
+                self._ever_lost |= counted
+                self.hosts_lost += len(counted)
+                if telemetry.enabled():
+                    # registry mirror at the increment site (same
+                    # discipline as stream.py's byte counters)
+                    telemetry.metrics().counter(
+                        "elastic.host_lost").inc(len(counted))
+        return lost
+
+    def alive_hosts(self) -> list:
+        """Sorted ranks not currently lost (always includes self)."""
+        lost = self.lost_hosts()
+        return [r for r in range(self.world)
+                if r == self.rank or r not in lost]
+
+    # -- publication -------------------------------------------------------
+
+    def _block_path(self, epoch: int, block: int) -> str:
+        # one subdirectory per epoch: collect_epoch polls published()
+        # every poll_interval, and block files are retained for the run's
+        # lifetime — a flat dir would make each poll list EVERY past
+        # epoch's files (O(epochs²·n_blocks) listdir work over a fit)
+        return os.path.join(self._dir("blocks"), f"e{int(epoch):04d}",
+                            f"b{int(block):05d}.ckpt")
+
+    def publish(self, epoch: int, block: int, tree) -> None:
+        """Atomically publish ``block``'s result for ``epoch``. Idempotent
+        by construction: results are pure functions of (epoch-start
+        state, block data), so concurrent publishers write identical
+        bytes and the rename race is harmless. The tree (drivers pass
+        host arrays) is also kept in the per-epoch cache so this host's
+        own results need no disk round-trip at epoch assembly."""
+        from dask_ml_tpu.checkpoint import save_pytree
+
+        save_pytree(self._block_path(epoch, block), tree,
+                    meta={"kind": "elastic_block", "epoch": int(epoch),
+                          "block": int(block), "by": self.rank})
+        self._cache[(int(epoch), int(block))] = tree
+
+    def published(self, epoch: int) -> set:
+        """Block ids with a visible published result for ``epoch``."""
+        out = set()
+        try:
+            names = os.listdir(
+                os.path.join(self._dir("blocks"), f"e{int(epoch):04d}"))
+        except OSError:
+            return out
+        for name in names:
+            if name.startswith("b") and name.endswith(".ckpt"):
+                out.add(int(name[1:-len(".ckpt")]))
+        return out
+
+    def read_block(self, epoch: int, block: int):
+        """A published block result (corruption raises
+        :class:`~dask_ml_tpu.checkpoint.CheckpointCorruptError` — a
+        survivor never resumes from a torn publish)."""
+        from dask_ml_tpu.checkpoint import load_pytree
+
+        snap = load_pytree(self._block_path(epoch, block))
+        if snap is None:
+            raise FileNotFoundError(
+                f"elastic block e{epoch} b{block} is not published")
+        return snap[0]
+
+    # -- failure hooks -----------------------------------------------------
+
+    def maybe_die(self, block: int, epoch: int) -> None:
+        """Poll the injector's host-death plan (``die_at``): fires AFTER
+        ``block`` published, simulating SIGKILL — no tombstone, no
+        snapshot; survivors must detect the silence."""
+        if (self.fault_injector is not None
+                and self.fault_injector.should_die(block, epoch)):
+            raise SimulatedHostDeath(
+                f"injected host death after block {block} of epoch "
+                f"{epoch} (rank {self.rank})", rank=self.rank)
+
+    def check_drain(self) -> None:
+        """While waiting on peers: a requested drain means leave NOW —
+        our shard is published, so we tombstone (survivors skip the
+        heartbeat timeout) and raise
+        :class:`~dask_ml_tpu.parallel.faults.Preempted`."""
+        if self.drain is not None and self.drain.requested:
+            self.mark_dead(self.rank)
+            raise Preempted(
+                f"graceful drain: rank {self.rank} leaving the elastic "
+                "run; its published blocks stand and survivors rebalance "
+                "the rest")
+
+    def leaving(self):
+        """Context manager for the drivers' compute: a
+        :class:`~dask_ml_tpu.parallel.faults.Preempted` escaping it (the
+        drain fired mid-scan, after snapshotting) leaves this rank's
+        tombstone on the way out, so survivors observe the graceful exit
+        immediately instead of waiting out the heartbeat timeout — the
+        SIGTERM half of the rebalance contract (``die_at`` deaths leave
+        nothing; survivors must detect the silence)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _scope():
+            try:
+                yield
+            except Preempted:
+                self.mark_dead(self.rank)
+                raise
+
+        return _scope()
+
+    # -- the rebalance protocol -------------------------------------------
+
+    def collect_epoch(self, plan: BlockPlan, epoch: int,
+                      order: Sequence[int], owner: dict,
+                      compute_publish: Callable[[list], None]) -> dict:
+        """Wait until every block of ``epoch`` is published, re-dealing
+        lost hosts' missing blocks to survivors (this process computes
+        only its own share of each re-deal). Returns ``{block: tree}``
+        for the whole epoch.
+
+        ``owner`` maps block → rank under the current assignment view;
+        re-deals update it in place. Views may transiently diverge across
+        hosts (deaths are observed at different times) — that costs
+        duplicate compute at worst, never a gap: dead owners are re-dealt
+        on observation, publication is idempotent, and when epoch-start
+        views CROSS (a death near the epoch boundary can leave a block
+        that every live host believes some OTHER live host owns — so it
+        is neither anyone's ``mine`` nor an orphan in any view), the
+        no-progress fallback below re-deals every still-missing block
+        over the current survivors after ``heartbeat_timeout`` seconds
+        without a new publication, restoring liveness at the price of
+        duplicate compute."""
+        last_progress = time.time()
+        n_have = -1
+        while True:
+            have = self.published(epoch)
+            if len(have) != n_have:
+                n_have = len(have)
+                last_progress = time.time()
+            missing = [b for b in order if b not in have]
+            if not missing:
+                out = {b: self._cache.get((int(epoch), int(b)))
+                       for b in order}
+                for b in order:
+                    if out[b] is None:
+                        out[b] = self.read_block(epoch, b)
+                self._cache.clear()  # per-epoch: the assembly consumed it
+                return out
+            self.beat()
+            self.check_drain()
+            # blocks assigned to SELF but still unpublished (a resume
+            # whose snapshot sequence predates a roster change can leave
+            # some): nobody else will compute them — do it now. Strictly
+            # local, so it cannot race another host's view.
+            stale_mine = [b for b in missing
+                          if owner.get(b) == self.rank]
+            if stale_mine:
+                compute_publish(stale_mine)
+                continue
+            lost = self.lost_hosts()
+            orphans = [b for b in missing
+                       if owner.get(b) in lost or owner.get(b) is None]
+            if not orphans and (time.time() - last_progress
+                                > self.heartbeat_timeout):
+                # crossed-views liveness fallback (see docstring): every
+                # live owner has had a full timeout to publish and
+                # nothing landed — stop trusting the assignment view and
+                # re-deal the lot
+                logger.warning(
+                    "elastic: rank %d saw no progress on %d missing "
+                    "block(s) of epoch %d for %.1fs — re-dealing them "
+                    "over the current survivors", self.rank, len(missing),
+                    epoch, self.heartbeat_timeout)
+                orphans = list(missing)
+                last_progress = time.time()
+            if orphans:
+                survivors = [r for r in range(self.world) if r not in lost]
+                owner.update(BlockPlan.redeal(orphans, survivors))
+                grab = [b for b in orphans if owner[b] == self.rank]
+                if grab:
+                    logger.warning(
+                        "elastic: rank %d rebalancing %d orphaned "
+                        "block(s) of epoch %d: %s", self.rank, len(grab),
+                        epoch, grab)
+                    with telemetry.span("elastic.rebalance", epoch=epoch,
+                                        blocks=len(grab)):
+                        compute_publish(grab)
+                    self.blocks_rebalanced += len(grab)
+                    if telemetry.enabled():
+                        telemetry.metrics().counter(
+                            "elastic.blocks_rebalanced").inc(len(grab))
+                    continue
+            time.sleep(self.poll_interval)
+
+
+# ---------------------------------------------------------------------------
+# consumer drivers (invoked by the solver facades)
+# ---------------------------------------------------------------------------
+
+
+def _epoch_assignment(run: ElasticRun, order) -> dict:
+    """The epoch-start assignment view: ``order`` dealt contiguously over
+    the hosts alive right now → ``{block: rank}``."""
+    alive = run.alive_hosts()
+    owner = {}
+    for r in alive:
+        for b in BlockPlan.shard(order, r, alive):
+            owner[b] = r
+    return owner
+
+
+def elastic_admm_host(run: ElasticRun, source, z0, x0, u0, mask, lamduh,
+                      rho, abstol, reltol, inner_tol, sw_total, *,
+                      check_done, family, regularizer, max_iter,
+                      inner_max_iter, scan_checkpoint=None):
+    """The elastic multi-host analogue of
+    ``models/glm.py::_admm_streamed_host``: each epoch, this host consumes
+    its shard of the seeded block permutation through the shard-aware
+    ``prefetched_scan``, publishes each per-block primal update as it
+    completes, then waits/rebalances until the whole epoch is published
+    and runs the consensus locally (deterministic, so every host derives
+    the same (z, u, done) without a collective).
+
+    Bit-identity: per-block prox results depend only on the epoch-start
+    (z, x, u) and the block's rows, the primal stack is assembled in
+    block-id order, and the consensus program is shared with the
+    single-host path — so the trajectory equals the uninterrupted
+    single-host run byte for byte, whatever the roster did
+    (``tests/test_elastic.py``).
+
+    The full consensus state stays replicated per host (O(B·d) — the same
+    memory class as the single-host streamed solver); only block COMPUTE
+    and block INGESTION are sharded. Published block files are retained
+    for the run's lifetime (a few d-vectors per epoch at streamed scale);
+    the drill's workdir is a tempdir.
+    """
+    import jax.numpy as jnp
+
+    from dask_ml_tpu.models import glm as glm_core
+    from dask_ml_tpu.parallel.stream import prefetched_scan
+
+    n_blocks = int(x0.shape[0])
+    plan = BlockPlan(n_blocks, seed=run.shuffle_seed, shuffle=run.shuffle)
+    # scope the workdir to THIS problem: a reused directory can never
+    # serve another fit's published blocks as this one's
+    run.bind_problem(
+        "admm_streamed", n_blocks=n_blocks, d=int(z0.shape[0]),
+        family=family, regularizer=regularizer,
+        params=repr((float(lamduh), float(rho), float(abstol),
+                     float(reltol), float(inner_tol), float(sw_total),
+                     int(inner_max_iter))),
+        shuffle_seed=run.shuffle_seed, shuffle=run.shuffle)
+    if source.host_rank is None:
+        # per-host wire-byte attribution (stream.bytes{host=}) without
+        # extra caller wiring
+        source.host_rank = run.rank
+    if run.fault_injector is None:
+        run.fault_injector = getattr(source, "fault_injector", None)
+    if run.drain is None and scan_checkpoint is not None:
+        run.drain = scan_checkpoint.drain
+
+    b32 = [jnp.asarray(b, jnp.int32) for b in range(n_blocks)]
+    z, x, u = z0, x0, u0
+    done = jnp.asarray(False)
+    n_iter = 0
+
+    start_epoch, resume = 0, None
+    if scan_checkpoint is not None:
+        snap = scan_checkpoint.load()
+        if snap is not None:
+            carry, outs0, pos0, ep0 = snap
+            z, x, u = (jnp.asarray(t) for t in carry)
+            seq0 = (scan_checkpoint.last_meta or {}).get("blocks")
+            resume = (list(outs0), int(pos0), list(seq0 or []))
+            start_epoch = ep0
+            n_iter = ep0
+
+    for it in range(start_epoch, max_iter):
+        with run.leaving(), telemetry.span("elastic.epoch", epoch=it,
+                                           rank=run.rank,
+                                           blocks=n_blocks):
+            # a drain requested since the last epoch means leave at the
+            # boundary (tombstone + Preempted) — same point the wait loop
+            # checks, so a drained host never starts work it won't finish
+            run.check_drain()
+            order = plan.epoch_order(it)
+            owner = _epoch_assignment(run, order)
+            z_e, x_e, u_e = z, x, u  # the epoch-start carry
+
+            def step(carry, b, blk):
+                x_b = glm_core._host_block_prox(
+                    blk, b32[b], z_e, x_e, u_e, rho, inner_tol, sw_total,
+                    family=family, inner_max_iter=inner_max_iter,
+                    transform=source.transform)
+                # publish forces the block's compute (device→host) — the
+                # robustness tax that makes this host's completed work
+                # survive its own death
+                run.publish(it, b, np.asarray(x_b))
+                run.beat()
+                run.maybe_die(b, it)
+                return carry, x_b
+
+            def compute_publish(blocks_seq, start_pos=0, outs=None):
+                prefetched_scan(step, (z_e, x_e, u_e), source,
+                                blocks=blocks_seq,
+                                checkpoint=scan_checkpoint, epoch=it,
+                                start_block=start_pos, outs=outs)
+
+            if resume is not None and it == start_epoch and resume[2]:
+                # replay the snapshot's OWN block sequence from its saved
+                # position — the roster (and therefore the fresh shard
+                # split) may have changed since the snapshot
+                outs0, pos0, seq0 = resume
+                compute_publish(seq0, start_pos=pos0, outs=outs0)
+            else:
+                mine = [b for b in order if owner.get(b) == run.rank]
+                compute_publish(mine)
+
+            results = run.collect_epoch(plan, it, order, owner,
+                                        compute_publish)
+            x = jnp.asarray(
+                np.stack([np.asarray(results[b])
+                          for b in range(n_blocks)]))
+            with telemetry.span("elastic.consensus", epoch=it):
+                z, u, done = glm_core._host_consensus(
+                    z, x, u, mask, lamduh, rho, abstol, reltol, sw_total,
+                    regularizer=regularizer)
+        n_iter = it + 1
+        if check_done and bool(done):
+            # deterministic consensus → every surviving host computes the
+            # same done flag and exits the same epoch together
+            break
+    source.discard_inflight()
+    if scan_checkpoint is not None:
+        scan_checkpoint.delete()
+    return z, jnp.asarray(n_iter, jnp.int32), x, u, done
+
+
+def elastic_moments_host(run: ElasticRun, source, scan_checkpoint=None):
+    """Elastic multi-host moment pass (the
+    ``streamed_moments``/``pca_fit_blocks`` driver): one epoch of the
+    seeded permutation, sharded over hosts; each block's moments are
+    computed INDEPENDENTLY (from zeros) and published, and every host
+    folds the published per-block moments in canonical block-id order
+    with Neumaier compensation — one jitted scan, so the combine is
+    deterministic and roster-independent.
+
+    Per-block independence is what buys elasticity here: a running
+    accumulator dies with its host, an independent block moment does not.
+    The price is a different (but fixed) summation tree than the
+    single-host running chain — elastic results are bit-identical across
+    rosters/deaths/resumes (pinned), and match the non-elastic path to
+    Neumaier accuracy (O(eps), not O(n_blocks·eps)).
+
+    Resume needs no carry: the published block files ARE the progress, so
+    a restarted host just computes whatever of its shard is missing
+    (``scan_checkpoint`` still provides the drain + snapshot plumbing the
+    preempt path raises through)."""
+    import jax.numpy as jnp
+
+    from dask_ml_tpu.decomposition import streaming as sm
+    from dask_ml_tpu.parallel.stream import prefetched_scan
+
+    n_blocks = source.n_blocks
+    d = int(source.out_struct[0].shape[1])
+    plan = BlockPlan(n_blocks, seed=run.shuffle_seed, shuffle=run.shuffle)
+    run.bind_problem("streamed_moments", n_blocks=n_blocks, d=d,
+                     shuffle_seed=run.shuffle_seed, shuffle=run.shuffle)
+    if source.host_rank is None:
+        source.host_rank = run.rank
+    if run.fault_injector is None:
+        run.fault_injector = getattr(source, "fault_injector", None)
+    if run.drain is None and scan_checkpoint is not None:
+        run.drain = scan_checkpoint.drain
+
+    with run.leaving(), telemetry.span("elastic.moments", rank=run.rank,
+                                       blocks=n_blocks, d=d):
+        run.check_drain()
+        order = plan.epoch_order(0)
+        owner = _epoch_assignment(run, order)
+
+        def step(carry, b, blk):
+            m = sm._moments_step(sm._moments_init(d), blk,
+                                 transform=source.transform)
+            sw_b, s_b, G_b = sm._moments_finalize(m)
+            run.publish(0, b, (np.asarray(sw_b), np.asarray(s_b),
+                               np.asarray(G_b)))
+            run.beat()
+            run.maybe_die(b, 0)
+            return carry, None
+
+        def compute_publish(blocks_seq):
+            prefetched_scan(step, None, source, blocks=blocks_seq,
+                            checkpoint=scan_checkpoint, epoch=0)
+
+        have = run.published(0)
+        mine = [b for b in order
+                if owner.get(b) == run.rank and b not in have]
+        compute_publish(mine)
+        results = run.collect_epoch(plan, 0, order, owner, compute_publish)
+
+        sws = jnp.asarray(np.stack(
+            [np.asarray(results[b][0]) for b in range(n_blocks)]))
+        ss = jnp.asarray(np.stack(
+            [np.asarray(results[b][1]) for b in range(n_blocks)]))
+        Gs = jnp.asarray(np.stack(
+            [np.asarray(results[b][2]) for b in range(n_blocks)]))
+        sw, s, G = _fold_moments(sws, ss, Gs)
+    source.discard_inflight()
+    if scan_checkpoint is not None:
+        scan_checkpoint.delete()
+    return sw, s, G
+
+
+def _fold_moments(sws, ss, Gs):
+    """Canonical block-id-order Neumaier fold of per-block moments — one
+    compiled scan, shared by every host, so the combine can only agree."""
+    import jax
+    import jax.numpy as jnp
+
+    from dask_ml_tpu.parallel import precision
+
+    @jax.jit
+    def fold(sws, ss, Gs):
+        d = ss.shape[1]
+        init = (jnp.asarray(0.0, jnp.float32),
+                jnp.zeros((d,), jnp.float32), jnp.zeros((d,), jnp.float32),
+                jnp.zeros((d, d), jnp.float32),
+                jnp.zeros((d, d), jnp.float32))
+
+        def body(carry, inp):
+            sw, s, cs, G, cG = carry
+            sw_b, s_b, G_b = inp
+            sw = sw + sw_b
+            s, cs = precision.neumaier_add(s, cs, s_b)
+            G, cG = precision.neumaier_add(G, cG, G_b)
+            return (sw, s, cs, G, cG), None
+
+        (sw, s, cs, G, cG), _ = jax.lax.scan(body, init, (sws, ss, Gs))
+        return sw, s + cs, G + cG
+
+    return fold(sws, ss, Gs)

@@ -251,6 +251,17 @@ class GracefulDrain:
     :class:`FaultInjector` and tests use, identical to a real signal from
     the scan's point of view.
 
+    Multi-process/nested use (the elastic epoch loop runs its own drain
+    scope inside ``admm_streamed``'s): entering the SAME drain again is a
+    no-op that bumps a depth counter — handlers install once and restore
+    only when the outermost scope exits, so re-entry never saves its own
+    handler as "previous" and leaks the trap. Entering a DISTINCT drain
+    while another is installed chains: the inner handler sets its own flag
+    and forwards the signal to the previously-installed handler, so every
+    active drain scope observes one SIGTERM (the outer scope still drains
+    after the inner one finishes). Pinned by the re-entrancy tests in
+    ``tests/test_faults.py``.
+
     Handler installation is skipped off the main thread (``signal.signal``
     only works there); the drain still works via ``request()``.
     """
@@ -259,10 +270,23 @@ class GracefulDrain:
         self._signals = tuple(signals)
         self._event = threading.Event()
         self._prev: dict = {}
+        self._depth = 0
         self.installed = False
 
     def request(self, *_args) -> None:
         self._event.set()
+
+    def _on_signal(self, signum, frame) -> None:
+        """Installed handler: set this drain's flag, then forward to the
+        previously-installed handler IF that handler is another drain's —
+        one signal reaches every active drain scope. Foreign handlers
+        (``default_int_handler``, application traps) are NOT forwarded to:
+        the drain's whole contract is that the signal means "finish the
+        block and snapshot", not "raise KeyboardInterrupt mid-solve"."""
+        self._event.set()
+        prev = self._prev.get(signum)
+        if isinstance(getattr(prev, "__self__", None), GracefulDrain):
+            prev(signum, frame)
 
     @property
     def requested(self) -> bool:
@@ -272,9 +296,18 @@ class GracefulDrain:
         self._event.clear()
 
     def __enter__(self) -> "GracefulDrain":
+        self._depth += 1
+        if self._depth > 1:
+            # re-entered (nested scope on the same drain): handlers are
+            # already installed; saving the current handler again would
+            # record OURSELVES as "previous" and leak the trap on exit
+            return self
         try:
             for s in self._signals:
-                self._prev[s] = signal.signal(s, self.request)
+                prev = signal.signal(s, self._on_signal)
+                if prev == self._on_signal:  # pragma: no cover - paranoia
+                    prev = signal.SIG_DFL
+                self._prev[s] = prev
             self.installed = True
         except ValueError:  # not the main thread: request()-only mode
             self._prev.clear()
@@ -282,6 +315,9 @@ class GracefulDrain:
         return self
 
     def __exit__(self, *exc) -> None:
+        self._depth = max(self._depth - 1, 0)
+        if self._depth > 0:
+            return None
         for s, prev in self._prev.items():
             signal.signal(s, prev)
         self._prev.clear()
@@ -327,10 +363,18 @@ class ScanCheckpoint:
         self.bind = dict(bind or {})
         self._since = 0
         self.saves = 0
+        #: full metadata of the last loaded snapshot — elastic resumes read
+        #: the in-progress epoch's shuffled block sequence (``"blocks"``)
+        #: from here, since the 4-tuple return predates shard-aware scans
+        self.last_meta: Optional[dict] = None
 
     def load(self):
         """→ ``(carry, outs, next_block, epoch)`` or ``None`` when no
-        snapshot exists. Raises on a snapshot from a different problem."""
+        snapshot exists (``next_block`` is a POSITION in the scanned block
+        sequence — identical to the block id for the default
+        ``range(n_blocks)`` scan; an explicit sequence is stored under
+        ``last_meta['blocks']``). Raises on a snapshot from a different
+        problem."""
         from dask_ml_tpu.checkpoint import load_pytree
 
         snap = load_pytree(self.path)
@@ -348,26 +392,34 @@ class ScanCheckpoint:
                     f"checkpoint {self.path} was written for a different "
                     f"problem ({k}={stored.get(k)!r}, this run has {v!r}); "
                     "delete it or use a distinct path per fit")
+        self.last_meta = dict(meta)
         return (tree["carry"], list(tree["outs"]),
                 int(meta["next_block"]), int(meta["epoch"]))
 
     def save(self, carry, outs, next_block: int, epoch: int,
-             reason: str = "interval") -> None:
+             reason: str = "interval", blocks=None) -> None:
         from dask_ml_tpu.checkpoint import save_pytree
 
-        save_pytree(
-            self.path, {"carry": carry, "outs": list(outs)},
-            meta={"kind": self.KIND, "next_block": int(next_block),
-                  "epoch": int(epoch), "bind": self.bind, "reason": reason})
+        meta = {"kind": self.KIND, "next_block": int(next_block),
+                "epoch": int(epoch), "bind": self.bind, "reason": reason}
+        if blocks is not None:
+            # shard-aware scan: the explicit (shuffled) block-id sequence
+            # this epoch consumes, so a resume replays the SAME permutation
+            # slice even if the roster has since changed
+            meta["blocks"] = [int(b) for b in blocks]
+        save_pytree(self.path, {"carry": carry, "outs": list(outs)},
+                    meta=meta)
         self._since = 0
         self.saves += 1
 
-    def tick(self, carry, outs, next_block: int, epoch: int) -> bool:
+    def tick(self, carry, outs, next_block: int, epoch: int,
+             blocks=None) -> bool:
         """Interval bookkeeping: called once per completed block; saves when
         ``every`` blocks have completed since the last save."""
         self._since += 1
         if self._since >= self.every:
-            self.save(carry, outs, next_block, epoch, reason="interval")
+            self.save(carry, outs, next_block, epoch, reason="interval",
+                      blocks=blocks)
             return True
         return False
 
@@ -428,9 +480,11 @@ class FaultInjector:
         self._transfer_fail: dict = {}   # block -> times_left
         self._load_delay: dict = {}      # block -> [times_left, seconds]
         self._preempt: set = set()       # {(epoch, block)}
+        self._die: set = set()           # {(epoch, block)}
         self._p_load = 0.0
         self._p_exc = InjectedLoaderError
-        self.injected = {"load": 0, "transfer": 0, "delay": 0, "preempt": 0}
+        self.injected = {"load": 0, "transfer": 0, "delay": 0, "preempt": 0,
+                         "die": 0}
 
     # -- planning ----------------------------------------------------------
 
@@ -458,6 +512,19 @@ class FaultInjector:
         ``epoch`` completes — identical to a SIGTERM landing there, minus
         the race: the drill is exact."""
         self._preempt.add((int(epoch), int(block)))
+        return self
+
+    def die_at(self, block: int, *, epoch: int = 0) -> "FaultInjector":
+        """Simulate the HOST dying (SIGKILL / machine loss — no drain, no
+        snapshot, heartbeats just stop) after block ``block`` of epoch
+        ``epoch`` completes. Unlike :meth:`preempt_at` nothing is saved:
+        this is the failure mode the elastic rebalance protocol exists for
+        (``parallel/elastic.py``), and the drill's stand-in for kill -9.
+        The elastic layer polls :meth:`should_die` after each published
+        block and raises
+        :class:`~dask_ml_tpu.parallel.elastic.SimulatedHostDeath`; the
+        bench worker turns that into ``os._exit``."""
+        self._die.add((int(epoch), int(block)))
         return self
 
     def random_load_failures(self, p: float,
@@ -510,5 +577,14 @@ class FaultInjector:
             if key in self._preempt:
                 self._preempt.discard(key)  # one-shot: resume runs clean
                 self.injected["preempt"] += 1
+                return True
+        return False
+
+    def should_die(self, block: int, epoch: int) -> bool:
+        with self._lock:
+            key = (int(epoch), int(block))
+            if key in self._die:
+                self._die.discard(key)
+                self.injected["die"] += 1
                 return True
         return False

@@ -76,6 +76,68 @@ def is_initialized() -> bool:
     return _initialized
 
 
+# ---------------------------------------------------------------------------
+# process-rank plumbing (used by the elastic data plane)
+# ---------------------------------------------------------------------------
+
+# The elastic ingestion layer (parallel/elastic.py) coordinates HOSTS, not
+# devices: its processes share a filesystem, not a jax.distributed runtime,
+# so rank/world must be knowable without collectives existing. Resolution
+# order: explicit set_process_info() > DASK_ML_TPU_PROCESS_ID /
+# DASK_ML_TPU_NUM_PROCESSES env (how the bench drill launches workers) >
+# the jax.distributed runtime when this process joined one > single-process
+# defaults (0 of 1).
+
+_process_info: "Optional[tuple[int, int]]" = None
+
+
+def set_process_info(rank: Optional[int], count: Optional[int]) -> None:
+    """Pin this process's (rank, world-size) for the elastic data plane.
+    Pass ``None, None`` to clear back to env/runtime resolution."""
+    global _process_info
+    if rank is None and count is None:
+        _process_info = None
+        return
+    rank, count = int(rank), int(count)
+    if not 0 <= rank < count:
+        raise ValueError(f"process rank {rank} out of range [0, {count})")
+    _process_info = (rank, count)
+
+
+def _env_process_info() -> "Optional[tuple[int, int]]":
+    import os
+
+    r = os.environ.get("DASK_ML_TPU_PROCESS_ID")
+    n = os.environ.get("DASK_ML_TPU_NUM_PROCESSES")
+    if r is None or n is None:
+        return None
+    return int(r), int(n)
+
+
+def process_rank() -> int:
+    """This process's host rank (see resolution order above)."""
+    if _process_info is not None:
+        return _process_info[0]
+    env = _env_process_info()
+    if env is not None:
+        return env[0]
+    if _initialized:
+        return jax.process_index()
+    return 0
+
+
+def process_count() -> int:
+    """The number of participating host processes."""
+    if _process_info is not None:
+        return _process_info[1]
+    env = _env_process_info()
+    if env is not None:
+        return env[1]
+    if _initialized:
+        return jax.process_count()
+    return 1
+
+
 def global_mesh(axis_names=(mesh_lib.DATA_AXIS,), shape=None) -> "jax.sharding.Mesh":
     """A mesh over every device on every participating host.
 

@@ -171,7 +171,7 @@ class HostBlockSource:
                  prefetch: int = 2, device=None,
                  retry_policy=None, fault_injector=None,
                  pad_tail: Optional[bool] = None,
-                 storage_dtype="policy"):
+                 storage_dtype="policy", host_rank: Optional[int] = None):
         if (arrays is None) == (loader is None):
             raise ValueError(
                 "pass exactly one of `arrays` (host array tuple) or "
@@ -213,6 +213,11 @@ class HostBlockSource:
         self.storage_dtype = storage_dtype
         self.retry_policy = retry_policy
         self.fault_injector = fault_injector
+        # which host (elastic process rank) this source streams for; when
+        # set, transfer bytes additionally mirror into the per-host
+        # `stream.bytes{host=}` registry counter (docs/observability.md) so
+        # a multi-host fit's bandwidth breaks down by process
+        self.host_rank = None if host_rank is None else int(host_rank)
         self._inflight: dict = {}
         self._inflight_bytes: dict = {}
         self.bytes_streamed = 0
@@ -354,6 +359,12 @@ class HostBlockSource:
             reg.counter("stream.bytes_streamed").inc(nbytes)
             reg.counter("stream.logical_bytes_streamed").inc(logical)
             reg.counter("stream.blocks_started").inc(1)
+            if self.host_rank is not None:
+                # per-host wire bytes for the elastic data plane: one
+                # labeled counter per process rank, so a multi-host fit's
+                # bandwidth breaks down by host (docs/observability.md)
+                reg.counter("stream.bytes",
+                            host=str(self.host_rank)).inc(nbytes)
 
     def _cast_wire(self, blk: tuple) -> tuple:
         from dask_ml_tpu.parallel import precision as precision_lib
@@ -416,6 +427,10 @@ class HostBlockSource:
                     mirror.counter(
                         "stream.logical_bytes_streamed").inc(-logical)
                     mirror.counter("stream.blocks_started").inc(-1)
+                    if self.host_rank is not None:
+                        mirror.counter(
+                            "stream.bytes",
+                            host=str(self.host_rank)).inc(-wire)
             del self._inflight[b]
 
     def reset_stats(self) -> None:
@@ -451,7 +466,8 @@ class HostBlockSource:
 def prefetched_scan(step, carry, source: HostBlockSource, *,
                     prefetch: Optional[int] = None, wrap: bool = False,
                     checkpoint=None, epoch: int = 0, start_block: int = 0,
-                    outs: Optional[list] = None):
+                    outs: Optional[list] = None,
+                    blocks: Optional[Sequence[int]] = None):
     """Host-driven ``lax.scan`` over a :class:`HostBlockSource`.
 
     ``step(carry, b, block) -> (carry, out)`` must dispatch jitted work and
@@ -485,16 +501,42 @@ def prefetched_scan(step, carry, source: HostBlockSource, *,
     provides: the scan replays from the first incomplete block with a
     bit-identical trajectory (the per-block programs are deterministic
     functions of the carry and block contents).
+
+    ``blocks`` makes the scan SHARD-AWARE (the elastic data plane,
+    ``parallel/elastic.py``): an explicit sequence of block ids to consume
+    — this host's slice of a seeded epoch permutation — instead of the
+    default ``range(n_blocks)``. ``start_block`` is then a POSITION in
+    that sequence (the two coincide for the default scan), snapshots store
+    the sequence itself under ``meta['blocks']`` so a resume replays the
+    SAME permutation slice even if the roster has since changed, and
+    ``step`` still receives the GLOBAL block id. ``wrap`` is rejected with
+    an explicit sequence: the next epoch draws its own permutation, so
+    there is no "block after the last" to prime.
     """
     n = source.n_blocks
     depth = source.prefetch if prefetch is None else int(prefetch)
     outs = [] if outs is None else list(outs)
     start_block = int(start_block)
     injector = getattr(source, "fault_injector", None)
+    if blocks is None:
+        seq = range(n)
+        saved_seq = None
+    else:
+        if wrap:
+            raise ValueError(
+                "wrap=True cannot combine with an explicit blocks= "
+                "sequence: the lookahead would need the NEXT epoch's "
+                "permutation, which only the elastic driver knows — prime "
+                "it there instead")
+        seq = [int(b) for b in blocks]
+        saved_seq = seq
+    n_seq = len(seq)
 
-    def after_block(b, carry):
+    def after_block(pos, b, carry):
         """Post-block bookkeeping: may snapshot; raises Preempted on a
-        drain request or an injected preemption."""
+        drain request or an injected preemption. ``pos`` is the position
+        in the scanned sequence (= the resume coordinate), ``b`` the
+        global block id (= the injection-plan key)."""
         preempt = injector is not None and injector.should_preempt(b, epoch)
         if checkpoint is None:
             if preempt:
@@ -506,15 +548,17 @@ def prefetched_scan(step, carry, source: HostBlockSource, *,
         drain = checkpoint.drain
         if preempt or (drain is not None and drain.requested):
             source.discard_inflight()
-            checkpoint.save(carry, outs, b + 1, epoch, reason="preempt")
+            checkpoint.save(carry, outs, pos + 1, epoch, reason="preempt",
+                            blocks=saved_seq)
             raise Preempted(
-                f"graceful drain: snapshot at block {b + 1}/{n} of epoch "
-                f"{epoch} saved to {checkpoint.path}; re-run with the same "
-                "checkpoint path to resume", path=checkpoint.path)
-        checkpoint.tick(carry, outs, b + 1, epoch)
+                f"graceful drain: snapshot at block {pos + 1}/{n_seq} of "
+                f"epoch {epoch} saved to {checkpoint.path}; re-run with "
+                "the same checkpoint path to resume", path=checkpoint.path)
+        checkpoint.tick(carry, outs, pos + 1, epoch, blocks=saved_seq)
 
     if depth <= 0:
-        for b in range(start_block, n):
+        for pos in range(start_block, n_seq):
+            b = seq[pos]
             with telemetry.span("stream.block", block=b, epoch=epoch):
                 with telemetry.span("stream.take", block=b):
                     blk = source.take(b)
@@ -524,24 +568,25 @@ def prefetched_scan(step, carry, source: HostBlockSource, *,
                     sc.sync(out if out is not None else carry)
                     _sync(out if out is not None else carry)
             outs.append(out)
-            after_block(b, carry)
+            after_block(pos, b, carry)
         return carry, outs
-    for j in range(min(depth, n - start_block)):
-        source.start(start_block + j)
-    for b in range(start_block, n):
+    for j in range(min(depth, n_seq - start_block)):
+        source.start(seq[start_block + j])
+    for pos in range(start_block, n_seq):
+        b = seq[pos]
         with telemetry.span("stream.block", block=b, epoch=epoch):
             with telemetry.span("stream.take", block=b):
                 blk = source.take(b)
-            nxt = b + depth
-            if nxt < n:
-                source.start(nxt)
-            elif wrap and nxt - n < n:
-                source.start(nxt - n)
+            nxt = pos + depth
+            if nxt < n_seq:
+                source.start(seq[nxt])
+            elif wrap and nxt - n_seq < n_seq:
+                source.start(seq[nxt - n_seq])
             # dispatch-only under the async pipeline: the span measures
             # host-side step dispatch, not device completion (which the
             # NEXT block's take() overlaps with by design)
             with telemetry.span("stream.compute", block=b):
                 carry, out = step(carry, b, blk)
         outs.append(out)
-        after_block(b, carry)
+        after_block(pos, b, carry)
     return carry, outs

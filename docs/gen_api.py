@@ -77,6 +77,14 @@ SECTIONS = [
      "preemption-safe checkpoint/drain/resume for the streamed tier, and "
      "the deterministic fault-injection harness — see docs/robustness.md "
      "for the contract and the CI drill."),
+    ("dask_ml_tpu.parallel.elastic", "Elastic data plane",
+     "Multi-host sharded ingestion for the streamed tier: the seeded "
+     "cross-epoch BlockPlan permutation (coordination is arithmetic — no "
+     "scheduler process), heartbeat/tombstone liveness, atomic per-block "
+     "publication, and survivor rebalancing on host loss with a "
+     "bit-identical final trajectory — see docs/robustness.md \"Elastic "
+     "epochs\" and the `bench.py --faults --elastic` kill-one-host "
+     "drill."),
     ("dask_ml_tpu.datasets", "Datasets",
      "Device-generated, mesh-sharded synthetic datasets."),
     ("dask_ml_tpu", "Top level",

@@ -188,6 +188,61 @@ def test_save_pytree_atomic_overwrite(tmp_path):
     assert ckpt.load_pytree(str(tmp_path / "missing.ckpt")) is None
 
 
+def test_save_pytree_truncation_sweep_raises_loudly(tmp_path):
+    """The CellJournal discipline applied to snapshots: a snapshot
+    truncated at EVERY byte offset must raise CheckpointCorruptError —
+    never unpickle garbage, never resume silently (the framed
+    magic + length + sha256 header makes any missing byte detectable).
+    A journal drops its torn tail frame; a snapshot has no earlier frame
+    to fall back to, so corruption is a loud error."""
+    path = str(tmp_path / "snap.ckpt")
+    ckpt.save_pytree(path, {"a": np.arange(5), "b": "x"}, meta={"k": 1})
+    blob = open(path, "rb").read()
+    for cut in range(len(blob)):
+        with open(path, "wb") as f:
+            f.write(blob[:cut])
+        with pytest.raises(ckpt.CheckpointCorruptError):
+            ckpt.load_pytree(path)
+    # the intact file still loads after the sweep
+    with open(path, "wb") as f:
+        f.write(blob)
+    tree, meta = ckpt.load_pytree(path)
+    assert meta["k"] == 1 and tree["b"] == "x"
+
+
+def test_save_pytree_bitflip_fails_checksum(tmp_path):
+    """Truncation is not the only torn-write mode: any flipped payload
+    byte fails the sha256 digest."""
+    path = str(tmp_path / "snap.ckpt")
+    ckpt.save_pytree(path, {"a": np.arange(64)}, meta={})
+    blob = bytearray(open(path, "rb").read())
+    blob[-1] ^= 0xFF  # corrupt the last payload byte
+    with open(path, "wb") as f:
+        f.write(bytes(blob))
+    with pytest.raises(ckpt.CheckpointCorruptError):
+        ckpt.load_pytree(path)
+
+
+def test_load_pytree_reads_legacy_unframed_snapshot(tmp_path):
+    """Pre-frame snapshots (bare pickle, no magic) written by earlier
+    releases still load; an unreadable one raises the same loud error
+    instead of bare unpickling noise."""
+    path = str(tmp_path / "legacy.ckpt")
+    with open(path, "wb") as f:
+        pickle.dump({"tree": {"a": 1}, "meta": {"old": True}}, f)
+    tree, meta = ckpt.load_pytree(path)
+    assert tree == {"a": 1} and meta == {"old": True}
+    with open(path, "wb") as f:
+        f.write(b"\x80\x04not really a pickle")
+    with pytest.raises(ckpt.CheckpointCorruptError):
+        ckpt.load_pytree(path)
+    # a readable pickle that is not a snapshot payload is also corrupt
+    with open(path, "wb") as f:
+        pickle.dump([1, 2, 3], f)
+    with pytest.raises(ckpt.CheckpointCorruptError):
+        ckpt.load_pytree(path)
+
+
 # ---------------------------------------------------------------------------
 # search checkpointing: kill-and-resume with identical cv_results_
 # ---------------------------------------------------------------------------

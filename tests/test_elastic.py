@@ -1,0 +1,530 @@
+"""Elastic multi-host data plane (dask_ml_tpu/parallel/elastic.py).
+
+The acceptance pins:
+
+- the BlockPlan is pure arithmetic — every host derives the same seeded
+  epoch permutation, shard split, and re-deal with no communication;
+- an elastic fit's (z, x, u) / moments trajectory is BIT-IDENTICAL no
+  matter how many hosts participated, which of them died or drained, or
+  how the epoch was shuffled — including a kill mid-epoch with survivor
+  rebalancing and a checkpoint resume mid-shuffled-epoch;
+- host loss is observed through heartbeats/tombstones and costs only
+  duplicate compute, never correctness (publication is idempotent).
+
+Multi-host runs are simulated with threads sharing a workdir (the
+coordination layer is the FILESYSTEM, so threads exercise exactly the
+code real processes run); ``bench.py --faults --elastic`` drives the same
+protocol across real OS processes with a kill -9.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dask_ml_tpu.checkpoint import CheckpointCorruptError, load_pytree
+from dask_ml_tpu.models import glm as glm_core
+from dask_ml_tpu.parallel.elastic import (BlockPlan, ElasticRun,
+                                          SimulatedHostDeath)
+from dask_ml_tpu.parallel.faults import FaultInjector, GracefulDrain, Preempted
+from dask_ml_tpu.parallel.stream import HostBlockSource, prefetched_scan
+
+# one problem shape for every solver-level test: the jitted per-block
+# programs compile once for the whole module
+N, D, BLOCKS, OUTER = 512, 5, 4, 3
+SEED = 7
+
+
+def _problem():
+    rng = np.random.RandomState(0)
+    X = rng.randn(N, D).astype(np.float32)
+    beta = rng.randn(D).astype(np.float32)
+    y = (X @ beta + 0.3 * rng.randn(N) > 0).astype(np.float32)
+    return X, y, np.ones(N, np.float32)
+
+
+def _fit(source, elastic=None, **extra):
+    kw = dict(family="logistic", regularizer="l2", lamduh=1.0,
+              max_iter=OUTER, abstol=0.0, reltol=0.0, return_state=True)
+    kw.update(extra)
+    z, n_iter, (z2, x, u), done = glm_core.admm_streamed(
+        source, BLOCKS, D, float(N), elastic=elastic, **kw)
+    return np.asarray(z), np.asarray(x), np.asarray(u)
+
+
+def _assert_state_equal(a, b):
+    for left, right in zip(a, b):
+        np.testing.assert_array_equal(left, right)
+
+
+# ---------------------------------------------------------------------------
+# BlockPlan: the no-communication coordination arithmetic
+# ---------------------------------------------------------------------------
+
+
+def test_epoch_order_is_deterministic_seeded_permutation():
+    plan = BlockPlan(16, seed=3)
+    o0 = plan.epoch_order(0)
+    assert o0 == BlockPlan(16, seed=3).epoch_order(0)  # pure in (seed, e)
+    assert sorted(o0) == list(range(16))
+    assert plan.epoch_order(1) != o0        # epochs reshuffle
+    assert BlockPlan(16, seed=4).epoch_order(0) != o0   # seeds differ
+    assert BlockPlan(16, seed=3, shuffle=False).epoch_order(5) == list(
+        range(16))
+
+
+def test_shard_is_a_contiguous_partition_with_remainder_to_front():
+    order = BlockPlan(10, seed=0).epoch_order(0)
+    for roster in ([0, 1, 2], [0, 2, 5], [1]):
+        shards = [BlockPlan.shard(order, r, roster) for r in roster]
+        # partition: disjoint cover of the order, in order
+        assert sum(shards, []) == order
+        sizes = [len(s) for s in shards]
+        # even split, remainder to the front ranks of the SORTED roster
+        assert max(sizes) - min(sizes) <= 1
+        assert sizes == sorted(sizes, reverse=True)
+
+
+def test_redeal_round_robin_and_purity():
+    missing = [7, 3, 9, 1, 4]
+    deal = BlockPlan.redeal(missing, [2, 0])
+    assert deal == {7: 0, 3: 2, 9: 0, 1: 2, 4: 0}
+    assert BlockPlan.redeal(missing, [0, 2]) == deal  # order-insensitive
+    assert BlockPlan(1).n_blocks == 1
+    with pytest.raises(ValueError):
+        BlockPlan(0)
+
+
+# ---------------------------------------------------------------------------
+# liveness: heartbeats, tombstones, cumulative loss observation
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_staleness_and_tombstones(tmp_path):
+    r0 = ElasticRun(tmp_path, rank=0, world=3, heartbeat_timeout=0.2,
+                    poll_interval=0.01)
+    r1 = ElasticRun(tmp_path, rank=1, world=3, heartbeat_timeout=0.2,
+                    poll_interval=0.01)
+    assert r0.lost_hosts() in (set(), {2})  # rank 2 never launched
+    import time
+
+    time.sleep(0.3)
+    r1.beat()                       # rank 1 stays fresh, rank 2 goes stale
+    assert r0.lost_hosts() == {2}
+    assert r0.hosts_lost == 1
+    r0.mark_dead(1)                 # tombstone observed immediately
+    assert r0.lost_hosts() == {1, 2}
+    assert r0.alive_hosts() == [0]
+    # cumulative: a late heartbeat does not resurrect an observed death
+    r1.beat()
+    assert r0.lost_hosts() == {1, 2}
+    assert r0.hosts_lost == 2
+
+
+def test_host_loss_counted_once_across_problem_rebinds(tmp_path):
+    # one physical death, observed again after bind_problem resets the
+    # per-namespace loss view: the COUNT (and its registry mirror) must
+    # not inflate — a rank only re-counts after a provable rejoin (an
+    # actual fresh heartbeat), then a second real death
+    import time
+
+    r0 = ElasticRun(tmp_path, rank=0, world=2, heartbeat_timeout=0.2,
+                    poll_interval=0.01)
+    r1 = ElasticRun(tmp_path, rank=1, world=2, heartbeat_timeout=0.2,
+                    poll_interval=0.01)
+    time.sleep(0.3)                       # rank 1 goes silent
+    assert r0.lost_hosts() == {1}
+    assert r0.hosts_lost == 1
+    r0.bind_problem("fit2", n=1)          # next fit, same handle
+    time.sleep(0.3)
+    assert r0.lost_hosts() == {1}         # still dead in the new namespace
+    assert r0.hosts_lost == 1             # ... but not re-counted
+    # rank 1 restarts and joins fit3 BEFORE rank 0 observes anything
+    # there: a provable rejoin (fresh heartbeat) re-arms the counter
+    r0.bind_problem("fit3", n=1)
+    r1b = ElasticRun(tmp_path, rank=1, world=2, heartbeat_timeout=0.2,
+                     poll_interval=0.01)
+    r1b.bind_problem("fit3", n=1)
+    assert r0.lost_hosts() == set()
+    time.sleep(0.3)                       # ... and dies again
+    assert r0.lost_hosts() == {1}
+    assert r0.hosts_lost == 2             # a NEW physical loss counts
+
+
+def test_die_at_injector_is_one_shot_and_counted():
+    inj = FaultInjector().die_at(block=3, epoch=1)
+    assert not inj.should_die(3, 0)
+    assert inj.should_die(3, 1)
+    assert not inj.should_die(3, 1)  # consumed
+    assert inj.injected["die"] == 1
+
+
+def test_corrupt_published_block_raises_loudly(tmp_path):
+    run = ElasticRun(tmp_path, rank=0, world=1)
+    run.publish(0, 2, np.arange(4.0))
+    assert run.published(0) == {2}
+    path = run._block_path(0, 2)
+    blob = open(path, "rb").read()
+    with open(path, "wb") as f:        # torn copy: half the file
+        f.write(blob[: len(blob) // 2])
+    with pytest.raises(CheckpointCorruptError):
+        run.read_block(0, 2)
+
+
+# ---------------------------------------------------------------------------
+# shard-aware prefetched_scan coordinates
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("prefetch", [0, 2])
+def test_prefetched_scan_explicit_block_sequence(prefetch):
+    X, y, w = _problem()
+    src = HostBlockSource((X, y, w), BLOCKS, prefetch=prefetch)
+    seen = []
+
+    def step(carry, b, blk):
+        seen.append(b)
+        return carry, np.asarray(blk[0]).sum()
+
+    seq = [2, 0, 3]
+    _, outs = prefetched_scan(step, None, src, blocks=seq)
+    assert seen == seq                     # global ids, in sequence order
+    per_block = X.reshape(BLOCKS, -1, D)
+    np.testing.assert_allclose(
+        outs, [per_block[b].sum() for b in seq], rtol=1e-6)
+
+
+def test_prefetched_scan_rejects_wrap_with_explicit_blocks():
+    X, y, w = _problem()
+    src = HostBlockSource((X, y, w), BLOCKS)
+    with pytest.raises(ValueError, match="wrap=True cannot combine"):
+        prefetched_scan(lambda c, b, blk: (c, None), None, src,
+                        wrap=True, blocks=[0, 1])
+
+
+def test_elastic_rejects_traced_block_fn():
+    def traced(b):  # pragma: no cover - never called
+        return None
+
+    with pytest.raises(ValueError, match="elastic= requires a Host"):
+        glm_core.admm_streamed(traced, BLOCKS, D, float(N),
+                               elastic=object())
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: single-host elastic == non-elastic, any roster, any death
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_world1_matches_nonelastic_bit_identical(tmp_path):
+    X, y, w = _problem()
+    base = _fit(HostBlockSource((X, y, w), BLOCKS))
+    run = ElasticRun(tmp_path, rank=0, world=1, shuffle_seed=SEED)
+    got = _fit(HostBlockSource((X, y, w), BLOCKS), elastic=run)
+    _assert_state_equal(base, got)
+    # the whole epoch was this host's shard — nothing was rebalanced
+    assert run.hosts_lost == 0 and run.blocks_rebalanced == 0
+
+
+def _host_thread(results, rank, wd, source, injector=None, drain=None,
+                 timeout=60.0):
+    def go():
+        run = ElasticRun(wd, rank=rank, world=2, shuffle_seed=SEED,
+                         heartbeat_timeout=timeout, poll_interval=0.02,
+                         fault_injector=injector, drain=drain)
+        try:
+            results[rank] = (_fit(source, elastic=run), run)
+        except (SimulatedHostDeath, Preempted) as e:
+            results[rank] = (e, run)
+
+    t = threading.Thread(target=go, daemon=True)
+    t.start()
+    return t
+
+
+def test_two_hosts_both_alive_match_single_host(tmp_path):
+    X, y, w = _problem()
+    base = _fit(HostBlockSource((X, y, w), BLOCKS))
+    results = {}
+    ts = [_host_thread(results, r, tmp_path, HostBlockSource((X, y, w),
+                                                             BLOCKS))
+          for r in (0, 1)]
+    for t in ts:
+        t.join(timeout=120)
+        assert not t.is_alive(), "elastic fit deadlocked"
+    for r in (0, 1):
+        state, run = results[r]
+        assert not isinstance(state, Exception)
+        # deterministic consensus: every host derives the same trajectory
+        _assert_state_equal(base, state)
+        assert run.hosts_lost == 0
+
+
+def test_kill_one_host_mid_epoch_survivor_rebalances_bit_identical(
+        tmp_path):
+    """The tentpole drill: host 1 is killed (no drain, no tombstone —
+    heartbeats just stop) after publishing ONE block of the shuffled
+    epoch 0; host 0 finishes its shard, detects the silence via the
+    heartbeat timeout, re-deals the orphaned blocks to itself, and
+    completes all epochs with a trajectory bit-identical to the
+    uninterrupted single-host run."""
+    X, y, w = _problem()
+    base = _fit(HostBlockSource((X, y, w), BLOCKS))
+    order = BlockPlan(BLOCKS, seed=SEED).epoch_order(0)
+    shard1 = BlockPlan.shard(order, 1, [0, 1])
+    assert len(shard1) >= 2  # the kill must orphan at least one block
+    inj = FaultInjector().die_at(block=shard1[0], epoch=0)
+
+    results = {}
+    t1 = _host_thread(results, 1, tmp_path,
+                      HostBlockSource((X, y, w), BLOCKS), injector=inj,
+                      timeout=2.0)
+    t0 = _host_thread(results, 0, tmp_path,
+                      HostBlockSource((X, y, w), BLOCKS), timeout=2.0)
+    for t in (t1, t0):
+        t.join(timeout=180)
+        assert not t.is_alive(), "elastic fit deadlocked"
+
+    dead, run1 = results[1]
+    assert isinstance(dead, SimulatedHostDeath) and dead.rank == 1
+    state, run0 = results[0]
+    assert not isinstance(state, Exception)
+    _assert_state_equal(base, state)
+    assert run0.hosts_lost == 1
+    assert run0.blocks_rebalanced >= len(shard1) - 1
+    # the dead host's published block was NOT recomputed as a rebalance
+    assert run0.blocks_rebalanced < BLOCKS
+
+
+def test_graceful_drain_leaves_tombstone_and_survivor_takes_over(
+        tmp_path):
+    """SIGTERM half of the contract: host 1's drain is requested, so it
+    leaves at the next epoch boundary — tombstoning and raising
+    Preempted — and host 0 observes the tombstone IMMEDIATELY (no
+    heartbeat timeout: it is set to 600 s here, so a timeout-path
+    detection would hang the test) and finishes every epoch alone."""
+    X, y, w = _problem()
+    base = _fit(HostBlockSource((X, y, w), BLOCKS))
+    drain = GracefulDrain()
+    drain.request()  # deterministic: requested before the run starts
+
+    results = {}
+    t1 = _host_thread(results, 1, tmp_path,
+                      HostBlockSource((X, y, w), BLOCKS), drain=drain,
+                      timeout=600.0)
+    t0 = _host_thread(results, 0, tmp_path,
+                      HostBlockSource((X, y, w), BLOCKS), timeout=600.0)
+    for t in (t1, t0):
+        t.join(timeout=180)
+        assert not t.is_alive(), "elastic fit deadlocked"
+
+    left, run1 = results[1]
+    assert isinstance(left, Preempted)
+    assert os.path.exists(run1._tomb_path(1))
+    state, run0 = results[0]
+    assert not isinstance(state, Exception)
+    _assert_state_equal(base, state)
+    assert run0.hosts_lost == 1
+
+
+def test_resume_mid_shuffled_epoch_bit_identical(tmp_path):
+    """The seeded shuffle composes with the PR-3 ScanCheckpoint contract:
+    a preemption mid-shuffled-epoch snapshots the POSITION in the
+    epoch's permutation plus the permutation itself (meta['blocks']),
+    and the resumed run replays exactly that slice — final (z, x, u)
+    bit-identical to the uninterrupted run."""
+    X, y, w = _problem()
+    base = _fit(HostBlockSource((X, y, w), BLOCKS))
+    wd, ckpt = tmp_path / "wd", str(tmp_path / "admm.ckpt")
+    order = BlockPlan(BLOCKS, seed=SEED).epoch_order(1)
+    inj = FaultInjector().preempt_at(block=order[1], epoch=1)
+
+    run = ElasticRun(wd, rank=0, world=1, shuffle_seed=SEED)
+    with pytest.raises(Preempted):
+        _fit(HostBlockSource((X, y, w), BLOCKS, fault_injector=inj),
+             elastic=run, checkpoint_path=ckpt, checkpoint_every=1)
+
+    tree, meta = load_pytree(ckpt)
+    assert meta["epoch"] == 1
+    assert meta["next_block"] == 2          # position, not block id
+    assert meta["blocks"] == order          # the epoch's own permutation
+
+    run2 = ElasticRun(wd, rank=0, world=1, shuffle_seed=SEED)
+    got = _fit(HostBlockSource((X, y, w), BLOCKS), elastic=run2,
+               checkpoint_path=ckpt)
+    _assert_state_equal(base, got)
+    assert not os.path.exists(ckpt)  # resume artifact deleted on completion
+
+
+def test_crossed_owner_views_recover_via_no_progress_redeal(tmp_path):
+    """Liveness under DIVERGED epoch-start views: a block this host
+    believes a live peer owns — while that peer believes the reverse —
+    is neither host's ``mine`` and no one's orphan, so without the
+    no-progress fallback both would wait forever. After a publication-
+    free heartbeat_timeout the waiter re-deals every missing block over
+    the survivors and computes its share itself."""
+    import time
+
+    run = ElasticRun(tmp_path, rank=0, world=2, heartbeat_timeout=0.3,
+                     poll_interval=0.02)
+    peer = ElasticRun(tmp_path, rank=1, world=2, heartbeat_timeout=0.3,
+                      poll_interval=0.02)
+    plan = BlockPlan(4, seed=0)
+    order = plan.epoch_order(0)
+    # rank 0's (wrong) view: the LIVE peer owns everything
+    owner = {b: 1 for b in order}
+    computed = []
+
+    def compute_publish(blocks):
+        computed.extend(blocks)
+        for b in blocks:
+            run.publish(0, b, np.asarray([float(b)]))
+
+    stop = threading.Event()
+
+    def keep_peer_alive():  # the peer is healthy, just never publishing
+        while not stop.is_set():
+            peer.beat()
+            time.sleep(0.02)
+
+    t = threading.Thread(target=keep_peer_alive, daemon=True)
+    t.start()
+    try:
+        results = run.collect_epoch(plan, 0, order, owner, compute_publish)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert set(results) == set(order)
+    # the fallback re-dealt over BOTH survivors; rank 0 computed only its
+    # round-robin share and then (after another silent timeout) the rest
+    assert run.hosts_lost == 0  # the peer was never declared dead
+    assert sorted(computed) == sorted(order)
+
+
+def test_workdir_reuse_isolates_different_problems(tmp_path):
+    """A reused workdir must never serve one fit's published blocks as
+    another's: each problem binds its own namespace, so a second fit
+    with a different hyperparameter cannot read the first fit's blocks
+    (same-problem reuse IS the resume path and stays shared)."""
+    X, y, w = _problem()
+    run = ElasticRun(tmp_path, rank=0, world=1, shuffle_seed=SEED)
+    _fit(HostBlockSource((X, y, w), BLOCKS), elastic=run)
+    ns1 = run._ns
+    # same run handle, different problem (lamduh): fresh namespace,
+    # results identical to the non-elastic fit of THAT problem
+    base2 = _fit(HostBlockSource((X, y, w), BLOCKS), lamduh=2.0)
+    got2 = _fit(HostBlockSource((X, y, w), BLOCKS), elastic=run,
+                lamduh=2.0)
+    assert run._ns != ns1
+    _assert_state_equal(base2, got2)
+    # and the moments pass shares the directory without collision too
+    from dask_ml_tpu.decomposition.streaming import streamed_moments
+
+    plain = streamed_moments(block_fn=HostBlockSource((X, w), BLOCKS),
+                             n_blocks=BLOCKS)
+    m = streamed_moments(block_fn=HostBlockSource((X, w), BLOCKS),
+                         n_blocks=BLOCKS, elastic=run)
+    for a, b in zip(plain, m):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-4)
+
+
+def test_elastic_snapshot_rejected_by_nonelastic_resume(tmp_path):
+    """An elastic snapshot stores POSITIONS into a shuffled block
+    sequence; resuming it without ``elastic=`` would reinterpret them as
+    canonical block ids and silently reorder the epoch — the checkpoint
+    bind makes that a loud error in both directions."""
+    X, y, w = _problem()
+    ckpt = str(tmp_path / "admm.ckpt")
+    order = BlockPlan(BLOCKS, seed=SEED).epoch_order(1)
+    inj = FaultInjector().preempt_at(block=order[1], epoch=1)
+    run = ElasticRun(tmp_path / "wd", rank=0, world=1, shuffle_seed=SEED)
+    with pytest.raises(Preempted):
+        _fit(HostBlockSource((X, y, w), BLOCKS, fault_injector=inj),
+             elastic=run, checkpoint_path=ckpt, checkpoint_every=1)
+    with pytest.raises(ValueError, match="different problem"):
+        _fit(HostBlockSource((X, y, w), BLOCKS), checkpoint_path=ckpt)
+
+
+# ---------------------------------------------------------------------------
+# elastic moments / PCA: roster-invariant deterministic fold
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_moments_roster_invariant_and_matches_plain(tmp_path):
+    from dask_ml_tpu.decomposition.streaming import streamed_moments
+
+    X, _, w = _problem()
+    plain = streamed_moments(block_fn=HostBlockSource((X, w), BLOCKS),
+                             n_blocks=BLOCKS)
+    run1 = ElasticRun(tmp_path / "w1", rank=0, world=1, shuffle_seed=SEED)
+    m1 = streamed_moments(block_fn=HostBlockSource((X, w), BLOCKS),
+                          n_blocks=BLOCKS, elastic=run1)
+    # world=2 where host 1 never launches: it is alive at assignment time
+    # (its never-seen heartbeat ages from run start), so host 0 computes
+    # its own shard, then watches the silence cross the timeout and
+    # rebalances the dead host's whole shard — a maximal-loss epoch
+    run2 = ElasticRun(tmp_path / "w2", rank=0, world=2, shuffle_seed=SEED,
+                      heartbeat_timeout=1.0, poll_interval=0.01)
+    m2 = streamed_moments(block_fn=HostBlockSource((X, w), BLOCKS),
+                          n_blocks=BLOCKS, elastic=run2)
+    # rosters/deaths change WHO computes, never the bytes: the fold is
+    # one canonical block-id-order scan shared by every host
+    for a, b in zip(m1, m2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert run2.blocks_rebalanced > 0
+    # and the elastic fold matches the single-host running chain to
+    # Neumaier accuracy (different but fixed summation tree)
+    for a, b in zip(plain, m1):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-4)
+
+
+def test_elastic_pca_fit_blocks(tmp_path):
+    from dask_ml_tpu.decomposition.streaming import pca_fit_blocks
+
+    X, _, w = _problem()
+    run = ElasticRun(tmp_path, rank=0, world=1, shuffle_seed=SEED)
+    est = pca_fit_blocks(HostBlockSource((X, w), BLOCKS), BLOCKS, 2,
+                         elastic=run)
+    plain = pca_fit_blocks(HostBlockSource((X, w), BLOCKS), BLOCKS, 2)
+    np.testing.assert_allclose(est.components_, plain.components_,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(est.explained_variance_,
+                               plain.explained_variance_, rtol=1e-4)
+
+
+def test_elastic_moments_rejects_traced_block_fn(tmp_path):
+    from dask_ml_tpu.decomposition.streaming import streamed_moments
+
+    with pytest.raises(ValueError, match="elastic= requires a Host"):
+        streamed_moments(block_fn=lambda b: None, n_blocks=2,
+                         elastic=object())
+
+
+# ---------------------------------------------------------------------------
+# facade: the estimator-level entry point
+# ---------------------------------------------------------------------------
+
+
+def test_facade_fit_blocks_elastic_matches_plain(tmp_path):
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    X, y, w = _problem()
+
+    def fit(elastic=None):
+        est = LogisticRegression(
+            solver="admm", C=1.0, max_iter=OUTER,
+            solver_kwargs={"abstol": 0.0, "reltol": 0.0})
+        est.fit_blocks(HostBlockSource((X, y, w), BLOCKS), BLOCKS, N, D,
+                       classes=[0, 1], elastic=elastic)
+        return est
+
+    base = fit()
+    run = ElasticRun(tmp_path, rank=0, world=1, shuffle_seed=SEED)
+    got = fit(elastic=run)
+    np.testing.assert_array_equal(base.coef_, got.coef_)
+    np.testing.assert_array_equal(base.intercept_, got.intercept_)

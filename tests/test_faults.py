@@ -261,6 +261,71 @@ def test_graceful_drain_traps_and_restores_signal_handlers():
     assert not drain.requested
 
 
+def test_graceful_drain_reentrant_same_drain_installs_once():
+    """Multi-process discipline: the elastic epoch loop re-enters the SAME
+    drain inside ``admm_streamed``'s scope — the inner entry must not
+    save the already-installed handler as "previous" (that would leak the
+    trap on exit), and handlers restore only when the OUTERMOST scope
+    exits."""
+    drain = GracefulDrain(signals=(signal.SIGTERM,))
+    prev = signal.getsignal(signal.SIGTERM)
+    with drain:
+        if not drain.installed:
+            pytest.skip("signal handlers unavailable off the main thread")
+        installed = signal.getsignal(signal.SIGTERM)
+        with drain:  # nested scope on the same drain: no re-install
+            assert signal.getsignal(signal.SIGTERM) is installed
+            assert drain._prev[signal.SIGTERM] is prev  # not ourselves
+            signal.raise_signal(signal.SIGTERM)
+            assert drain.requested
+        # inner exit keeps the trap: the outer scope is still draining
+        assert signal.getsignal(signal.SIGTERM) is installed
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_graceful_drain_distinct_drains_chain_one_signal_reaches_both():
+    """Two DIFFERENT drains nested (an elastic run's drain inside an
+    application-level one): the inner handler forwards the signal to the
+    previously-installed drain handler, so one SIGTERM marks every
+    active scope — the outer still drains after the inner finishes."""
+    outer, inner = (GracefulDrain(signals=(signal.SIGTERM,)),
+                    GracefulDrain(signals=(signal.SIGTERM,)))
+    prev = signal.getsignal(signal.SIGTERM)
+    with outer:
+        if not outer.installed:
+            pytest.skip("signal handlers unavailable off the main thread")
+        with inner:
+            signal.raise_signal(signal.SIGTERM)
+            assert inner.requested and outer.requested
+        # inner exited: the outer handler is re-installed and still live
+        outer.clear()
+        signal.raise_signal(signal.SIGTERM)
+        assert outer.requested
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_graceful_drain_does_not_forward_to_foreign_handlers():
+    """The drain's contract is "finish the block and snapshot", not
+    "raise KeyboardInterrupt mid-solve": a foreign previous handler
+    (e.g. default_int_handler) is restored on exit but never INVOKED by
+    the drain's own trap."""
+    fired = []
+    prev = signal.signal(signal.SIGTERM, lambda *_: fired.append(1))
+    try:
+        drain = GracefulDrain(signals=(signal.SIGTERM,))
+        with drain:
+            if not drain.installed:
+                pytest.skip("signal handlers unavailable off the main "
+                            "thread")
+            signal.raise_signal(signal.SIGTERM)
+            assert drain.requested
+            assert fired == []  # foreign handler NOT forwarded to
+        signal.raise_signal(signal.SIGTERM)
+        assert fired == [1]  # restored after exit
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
 def test_prefetched_scan_drain_flag_snapshots_and_raises(tmp_path):
     X, y, w = _problem(n=64)
     src = HostBlockSource((X, y, w), 4)

@@ -72,6 +72,77 @@ WORKER = textwrap.dedent("""
     centers = np.asarray(centers)
     assert np.isfinite(centers).all()
     print("KMC", " ".join(f"{v:.5f}" for v in centers.ravel()), flush=True)
+
+    # --- consensus ADMM: THE per-shard-state program (VERDICT: the one
+    # layout multi-process semantics could genuinely break — x/u stacked
+    # (n_shards, d), each shard owning its consensus subproblem) --------
+    mask = jnp.ones((d,), jnp.float32)
+    beta00 = jnp.zeros((d,), jnp.float32)
+    akw = dict(family="logistic", regularizer="l2", lamduh=1.0, rho=1.0,
+               abstol=0.0, reltol=0.0)
+    z6, _ = core.admm(X, y, w, beta00, mask, mesh, max_iter=6, **akw)
+    z3, _, st, _ = core.admm(X, y, w, beta00, mask, mesh, max_iter=3,
+                             return_state=True, **akw)
+    zr, _, _, _ = core.admm(X, y, w, beta00, mask, mesh, max_iter=3,
+                            state=st, return_state=True, **akw)
+    # the checkpoint/resume contract holds across the process boundary:
+    # chunked 3+3 == one-shot 6, bit for bit
+    assert np.array_equal(np.asarray(zr), np.asarray(z6)), \\
+        "binary ADMM chunked resume diverged from the one-shot run"
+    print("ADMMB", " ".join(f"{v:.6e}" for v in np.asarray(zr)),
+          flush=True)
+
+    # multinomial consensus ADMM: (d, K) per-shard primal/dual state
+    K = 3
+    yk = np.argmax(Xg @ np.random.RandomState(1).randn(d, K),
+                   axis=1).astype(np.float32)
+    y3 = jax.make_array_from_process_local_data(sh1, yk[start:stop], (n,))
+    B00 = jnp.zeros((d, K), jnp.float32)
+    mkw = dict(n_classes=K, regularizer="l2", lamduh=0.5, rho=1.0,
+               abstol=0.0, reltol=0.0)
+    B4, _ = core.admm_multinomial(X, y3, w, B00, mask, mesh, max_iter=4,
+                                  **mkw)
+    _, _, stK, _ = core.admm_multinomial(X, y3, w, B00, mask, mesh,
+                                         max_iter=2, return_state=True,
+                                         **mkw)
+    BR, _, _, _ = core.admm_multinomial(X, y3, w, B00, mask, mesh,
+                                        max_iter=2, state=stK,
+                                        return_state=True, **mkw)
+    assert np.array_equal(np.asarray(BR), np.asarray(B4)), \\
+        "multinomial ADMM chunked resume diverged from the one-shot run"
+    print("ADMMK", " ".join(f"{v:.6e}" for v in np.asarray(BR).ravel()),
+          flush=True)
+
+    # --- both tsqr branches of the condition guard ----------------------
+    from jax.sharding import PartitionSpec
+    from dask_ml_tpu.ops import linalg as la
+    rep = NamedSharding(mesh, PartitionSpec())
+    gram = jax.jit(lambda Q: Q.T @ Q, out_shardings=rep)
+    recon = jax.jit(lambda Q, R, A: jnp.max(jnp.abs(Q @ R - A)),
+                    out_shardings=rep)
+    eye = np.eye(d, dtype=np.float32)
+
+    # well-conditioned: per-shard rows (16) >= d and cond(X) ~ O(1), so
+    # CholeskyQR2 passes its orthogonality guard (fast path)
+    Q1, R1 = la.tsqr(X, mesh)
+    assert np.abs(np.asarray(gram(Q1)) - eye).max() < 1e-4
+    assert float(recon(Q1, R1, X)) < 1e-4
+    print("TSQR1", " ".join(f"{v:.6e}" for v in
+                            np.abs(np.asarray(R1)).ravel()), flush=True)
+
+    # ill-conditioned: column scaling drives cond(X) ~ 1e6 >> 1/sqrt(eps),
+    # the Gram-squared factor fails the guard, and the Householder branch
+    # must produce the (orthogonal) result
+    Xb_g = (Xg * np.logspace(0, -6, d)).astype(np.float32)
+    Xb = jax.make_array_from_process_local_data(sharding, Xb_g[start:stop],
+                                                (n, d))
+    Q2, R2 = la.tsqr(Xb, mesh)
+    assert np.abs(np.asarray(gram(Q2)) - eye).max() < 1e-3, \\
+        "ill-conditioned tsqr lost orthogonality: the Householder " \\
+        "fallback did not engage"
+    assert float(recon(Q2, R2, Xb)) < 1e-5
+    print("TSQR2", " ".join(f"{v:.6e}" for v in
+                            np.abs(np.asarray(R2)).ravel()), flush=True)
     print(f"proc {pid}: ok", flush=True)
 """)
 
@@ -172,3 +243,55 @@ def test_two_process_runtime(tmp_path):
     got_c = np.array([float(v) for v in kmcs[0].split()[1:]]).reshape(3, 5)
     np.testing.assert_allclose(got_c, np.asarray(c_oracle),
                                rtol=1e-4, atol=1e-5)
+
+    # --- the per-shard-state programs: consensus ADMM (binary +
+    # multinomial) and both tsqr branches. The workers already pinned the
+    # chunked return_state resume == one-shot bit-identity and the
+    # orthogonality/reconstruction quality; here: both controllers agree
+    # exactly (SPMD consistency), and the trajectories match a
+    # single-process 4-device mesh oracle — ADMM's stacked x/u state is
+    # shard-count-bound, so the oracle must replicate the worker's
+    # 4-shard layout, not the conftest 8-device default.
+    def _lines(tag):
+        got = [ln for out in outs for ln in out.splitlines()
+               if ln.startswith(tag + " ")]
+        assert len(got) == 2 and got[0] == got[1], f"{tag} diverged"
+        return np.array([float(v) for v in got[0].split()[1:]])
+
+    import jax
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    mesh4 = Mesh(np.array(jax.devices()[:4]), ("data",))
+    Xs4 = jax.device_put(jnp.asarray(Xg), NamedSharding(mesh4,
+                                                        P("data", None)))
+    ys4 = jax.device_put(jnp.asarray(yg), NamedSharding(mesh4, P("data")))
+    w4 = jax.device_put(jnp.ones((64,), jnp.float32),
+                        NamedSharding(mesh4, P("data")))
+    mask = jnp.ones((5,), jnp.float32)
+    akw = dict(family="logistic", regularizer="l2", lamduh=1.0, rho=1.0,
+               abstol=0.0, reltol=0.0)
+    z_oracle, _ = core.admm(Xs4, ys4, w4, jnp.zeros((5,), jnp.float32),
+                            mask, mesh4, max_iter=6, **akw)
+    np.testing.assert_allclose(_lines("ADMMB"), np.asarray(z_oracle),
+                               rtol=1e-3, atol=1e-5)
+
+    yk = np.argmax(Xg @ np.random.RandomState(1).randn(5, 3),
+                   axis=1).astype(np.float32)
+    yk4 = jax.device_put(jnp.asarray(yk), NamedSharding(mesh4, P("data")))
+    B_oracle, _ = core.admm_multinomial(
+        Xs4, yk4, w4, jnp.zeros((5, 3), jnp.float32), mask, mesh4,
+        n_classes=3, regularizer="l2", lamduh=0.5, rho=1.0, abstol=0.0,
+        reltol=0.0, max_iter=4)
+    np.testing.assert_allclose(_lines("ADMMK"),
+                               np.asarray(B_oracle).ravel(),
+                               rtol=1e-3, atol=1e-5)
+
+    # R is sign-unnormalized on the fallback branch, so compare |R|
+    # against a plain host QR of the same matrix
+    _, R_np = np.linalg.qr(Xg, mode="reduced")
+    np.testing.assert_allclose(_lines("TSQR1"),
+                               np.abs(R_np).ravel(), rtol=1e-3, atol=1e-4)
+    _, Rb_np = np.linalg.qr(Xg * np.logspace(0, -6, 5), mode="reduced")
+    np.testing.assert_allclose(_lines("TSQR2"),
+                               np.abs(Rb_np).ravel(), rtol=1e-2, atol=1e-6)

@@ -212,6 +212,71 @@ def test_discard_inflight_rolls_mirrors_back(mesh8):
     assert c["stream.blocks_started"] == src.blocks_started == 1
 
 
+def test_per_host_stream_bytes_mirror_and_rollback(mesh8):
+    """The elastic data plane's per-host wire-byte attribution: a source
+    with ``host_rank`` set mirrors its transfer bytes into the labeled
+    ``stream.bytes{host=}`` counter at the same increment site as the
+    unlabeled counter — exact equality, including the discard rollback."""
+    X, w, nb = _streamed_blocks()
+    src = HostBlockSource((X, w), nb, host_rank=3)
+    with config.config_context(telemetry=True):
+        _consume(src)
+        c = telemetry.metrics().snapshot()["counters"]
+        assert c["stream.bytes{host=3}"] == src.bytes_streamed
+        src.start(0)
+        src.discard_inflight()  # issued, never consumed: rolled back
+        c = telemetry.metrics().snapshot()["counters"]
+    assert c["stream.bytes{host=3}"] == src.bytes_streamed
+    assert c["stream.bytes{host=3}"] == c["stream.bytes_streamed"]
+
+
+def test_elastic_host_lost_and_rebalance_mirrors(tmp_path):
+    """``elastic.host_lost`` / ``elastic.blocks_rebalanced`` mirror the
+    ElasticRun counters at their increment sites: a lost host observed
+    through the heartbeat timeout and its blocks re-dealt through
+    collect_epoch produce exactly-matching registry values."""
+    import time
+
+    from dask_ml_tpu.parallel.elastic import BlockPlan, ElasticRun
+
+    with config.config_context(telemetry=True):
+        run = ElasticRun(tmp_path, rank=0, world=2, heartbeat_timeout=0.05,
+                         poll_interval=0.01)
+        plan = BlockPlan(4, seed=0)
+        order = plan.epoch_order(0)
+        owner = {b: r for r, blocks in
+                 ((r, BlockPlan.shard(order, r, [0, 1])) for r in (0, 1))
+                 for b in blocks}
+
+        def compute_publish(blocks):
+            for b in blocks:
+                run.publish(0, b, np.arange(float(b), float(b) + 3))
+
+        compute_publish([b for b in order if owner[b] == 0])
+        time.sleep(0.1)  # host 1 never beat: its silence crosses the line
+        results = run.collect_epoch(plan, 0, order, owner, compute_publish)
+        c = telemetry.metrics().snapshot()["counters"]
+    assert set(results) == set(order)
+    assert run.hosts_lost == 1 and run.blocks_rebalanced == 2
+    assert c["elastic.host_lost"] == run.hosts_lost
+    assert c["elastic.blocks_rebalanced"] == run.blocks_rebalanced
+
+
+def test_elastic_mirrors_silent_when_disabled(tmp_path):
+    """Knob off: the ElasticRun counters still count, the registry
+    records nothing (the disabled-path contract every mirror follows)."""
+    import time
+
+    from dask_ml_tpu.parallel.elastic import ElasticRun
+
+    run = ElasticRun(tmp_path, rank=0, world=2, heartbeat_timeout=0.01,
+                     poll_interval=0.01)
+    time.sleep(0.05)
+    assert run.lost_hosts() == {1}
+    assert run.hosts_lost == 1
+    assert telemetry.metrics().snapshot()["counters"] == {}
+
+
 @pytest.mark.parametrize("prefetch", [0, 2])
 def test_queue_depth_gauge_bounds(mesh8, prefetch):
     X, w, nb = _streamed_blocks()
