@@ -252,17 +252,21 @@ class KMeans(TransformerMixin, BaseEstimator):
     def predict(self, X):
         """Nearest-center labels (reference: cluster/k_means.py:196-216).
         Host-path transfers travel as uint8 when k <= 255 (4x less
-        host-link traffic; int32 restored host-side)."""
+        host-link traffic; int32 restored host-side). The host path
+        slices padding off AFTER the fetch, so a repeat predict whose n
+        lands in a warm shape bucket compiles nothing (the serving-path
+        contract, docs/serving.md)."""
         self._check_fitted()
         X = check_array(X)
         data = prepare_data(X)
         labels = core.predict_labels(data.X, jnp.asarray(self.cluster_centers_))
         from dask_ml_tpu.config import get_config
 
-        if not get_config()["device_outputs"] and self.n_clusters <= 255:
-            return np.asarray(
-                unpad_rows(labels.astype(jnp.uint8), data.n)
-            ).astype(np.int32)
+        if not get_config()["device_outputs"]:
+            if self.n_clusters <= 255:
+                return np.asarray(
+                    labels.astype(jnp.uint8))[:data.n].astype(np.int32)
+            return np.asarray(labels)[:data.n]
         return maybe_host(unpad_rows(labels, data.n))
 
     def transform(self, X):

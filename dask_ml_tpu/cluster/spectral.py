@@ -176,6 +176,42 @@ class SpectralClustering(BaseEstimator, ClusterMixin):
         self.fit(X)
         return self.labels_
 
+    def _kernel_params(self) -> dict:
+        params = dict(self.kernel_params or {})
+        params["gamma"] = self.gamma
+        params["degree"] = self.degree
+        params["coef0"] = self.coef0
+        return params
+
+    def _assign_staged(self, Xs):
+        """Nearest-center labels for STAGED (padded, row-sharded) rows as
+        the ONE jitted Nyström-extension + fused-assignment program —
+        returns PADDED device labels; callers slice to the true row count
+        host-side. Shared by :meth:`predict` and the serving loop's batch
+        runners (:mod:`dask_ml_tpu.parallel.serving`), so served labels
+        are structurally bit-identical to direct calls. Only valid for
+        the jax-native configuration (string-kernel affinity + native
+        KMeans assigner)."""
+        km = self.assign_labels_
+        if callable(self.affinity) or not isinstance(km, KMeans):
+            raise ValueError(
+                "staged assignment requires a string-kernel affinity and "
+                "the native KMeans assigner")
+        from dask_ml_tpu.parallel.mesh import default_mesh
+
+        Xk = jnp.asarray(self._landmarks_)
+        ainv_colsum, d1_si, map_k = (
+            jnp.asarray(e) for e in self._extension_)
+        scale = jnp.asarray(
+            np.sqrt(int(self.n_components) / self._n_fit_rows_),
+            jnp.float32)
+        return _nystrom_assign_program(
+            Xs, Xk, ainv_colsum, d1_si, map_k, scale,
+            jnp.asarray(km.cluster_centers_),
+            metric=self.affinity,
+            params_t=tuple(sorted(self._kernel_params().items())),
+            mesh=default_mesh())
+
     def predict(self, X):
         """Labels for NEW rows via the Nyström landmark-assignment path:
         kernel strip against the fitted landmarks, the same Eq. 16
@@ -187,33 +223,25 @@ class SpectralClustering(BaseEstimator, ClusterMixin):
         if not hasattr(self, "assign_labels_"):
             raise AttributeError("Model not fitted; call fit first")
         X = check_array(X)
-        Xs, n_valid = shard_rows(X)
-        Xk = jnp.asarray(self._landmarks_)
-        ainv_colsum, d1_si, map_k = (
-            jnp.asarray(e) for e in self._extension_)
-        l = int(self.n_components)
-        scale = jnp.asarray(
-            np.sqrt(l / self._n_fit_rows_), jnp.float32)
+        from dask_ml_tpu.parallel import precision as precision_lib
 
-        params = dict(self.kernel_params or {})
-        params["gamma"] = self.gamma
-        params["degree"] = self.degree
-        params["coef0"] = self.coef0
-
+        Xs, n_valid = shard_rows(
+            X, dtype=precision_lib.staging_wire_dtype())
         km = self.assign_labels_
         if isinstance(km, KMeans) and not callable(self.affinity):
-            from dask_ml_tpu.parallel.mesh import default_mesh
-
-            labels = _nystrom_assign_program(
-                Xs, Xk, ainv_colsum, d1_si, map_k, scale,
-                jnp.asarray(km.cluster_centers_),
-                metric=self.affinity,
-                params_t=tuple(sorted(params.items())),
-                mesh=default_mesh())
+            # one program per shape bucket + host-side unpad: a repeat
+            # predict in a warm bucket compiles nothing (docs/serving.md)
             return np.asarray(
-                unpad_rows(labels, n_valid)).astype(np.int32)
+                self._assign_staged(Xs))[:n_valid].astype(np.int32)
         # callable metrics run their kernel strip eagerly (same reasoning
         # as _nystrom_eager); foreign estimators assign on host
+        params = self._kernel_params()
+        ainv_colsum, d1_si, map_k = (
+            jnp.asarray(e) for e in self._extension_)
+        scale = jnp.asarray(
+            np.sqrt(int(self.n_components) / self._n_fit_rows_),
+            jnp.float32)
+        Xk = jnp.asarray(self._landmarks_)
         if callable(self.affinity):
             C = jnp.asarray(self.affinity(Xs, replicate(Xk), **params))
         else:

@@ -44,6 +44,20 @@ def _project(Xs, mean, components):
     return (Xs - mean) @ components.T
 
 
+@partial(jax.jit, static_argnames=("whiten",))
+def transform_program(Xs, mean, components, explained_variance, *,
+                      whiten: bool):
+    """The WHOLE transform (center, project, optional whitening) as one
+    jitted program over staged rows — one executable per shape bucket,
+    shared by the direct :meth:`PCA.transform` path and the serving loop's
+    batch runners (:mod:`dask_ml_tpu.parallel.serving`), so served
+    results are structurally bit-identical to direct calls."""
+    out = _project(Xs, mean, components)
+    if whiten:
+        out = out / jnp.sqrt(explained_variance.astype(out.dtype))
+    return out
+
+
 @jax.jit
 def _center_and_mask(X, w, mean):
     # Padding rows must stay exact zeros after centering so they vanish from
@@ -267,17 +281,24 @@ class PCA(BaseEstimator, TransformerMixin):
 
     def transform(self, X):
         X = check_array(X)
-        Xs, n = shard_rows(X)
-        # one fused dispatch (vs 2-4 eager ops); matters on high-RTT links
-        out = _project(Xs, jnp.asarray(self.mean_),
-                       jnp.asarray(self.components_))
-        if self.whiten:
-            out = out / jnp.sqrt(jnp.asarray(
-                self.explained_variance_, out.dtype))
-        # whitening divides by a variance that can be zero: the output can
-        # be non-finite for FINITE input, so it must keep the downstream
-        # NaN scan (trusted=False) — host-path error semantics preserved
-        return maybe_host(unpad_rows(out, n), trusted=not self.whiten)
+        from dask_ml_tpu.config import get_config
+        from dask_ml_tpu.parallel import precision as precision_lib
+
+        # wire staging + one jitted program per shape bucket + HOST-side
+        # unpad: a repeat transform whose n lands in a warm bucket
+        # compiles nothing (the serving-path contract, docs/serving.md)
+        Xs, n = shard_rows(X, dtype=precision_lib.staging_wire_dtype())
+        out = transform_program(
+            Xs, jnp.asarray(self.mean_), jnp.asarray(self.components_),
+            jnp.asarray(self.explained_variance_),
+            whiten=bool(self.whiten))
+        if get_config()["device_outputs"]:
+            # whitening divides by a variance that can be zero: the output
+            # can be non-finite for FINITE input, so it must keep the
+            # downstream NaN scan (trusted=False) — host-path error
+            # semantics preserved
+            return maybe_host(unpad_rows(out, n), trusted=not self.whiten)
+        return np.asarray(out)[:n]
 
     def inverse_transform(self, X):
         X = check_array(X)

@@ -20,7 +20,9 @@ from __future__ import annotations
 
 import logging
 import os
+from functools import partial
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from sklearn.base import BaseEstimator
@@ -28,6 +30,7 @@ from sklearn.base import BaseEstimator
 from dask_ml_tpu.metrics import accuracy_score, r2_score
 from dask_ml_tpu.models import glm as core
 from dask_ml_tpu.parallel import mesh as mesh_lib
+from dask_ml_tpu.parallel import precision as precision_lib
 from dask_ml_tpu.parallel.sharding import prepare_data, shard_rows, unpad_rows
 from dask_ml_tpu.parallel import telemetry
 from dask_ml_tpu.utils.validation import check_array
@@ -48,6 +51,53 @@ def _intercept_block(blk):
     on the transform's identity) compiles once across estimator fits."""
     X_b, y_b, w_b = blk
     return add_intercept(X_b), y_b, w_b
+
+
+@partial(jax.jit, static_argnames=("intercept",))
+def eta_program(Xs, coef, *, intercept: bool):
+    """The WHOLE linear predictor as one jitted program over staged rows:
+    in-trace intercept append plus the precision-aware contraction
+    (operands feed the MXU in the data's wire dtype, accumulation forced
+    f32 — for f32 data this is the plain ``X @ coef`` it replaces).
+
+    One program per (bucket, d, coef-shape): both the direct
+    ``_decision_function`` path and the serving loop's batch runners
+    (:mod:`dask_ml_tpu.parallel.serving`) route through it, which is what
+    makes served results structurally bit-identical to direct calls —
+    same executable, row-independent math, different padding only.
+    """
+    if intercept:
+        Xs = add_intercept(Xs)
+    ct = coef.T if coef.ndim == 2 else coef
+    return precision_lib.pmatmul(Xs, ct)
+
+
+def proba_from_eta(eta: np.ndarray, multiclass: str) -> np.ndarray:
+    """Host epilogue mapping a fetched linear predictor to probabilities —
+    rowwise, so serving can apply it to a padded batch and slice after.
+    Binary: 1-D sigmoid of the positive-class score (reference glm.py:
+    203-215 semantics). Multiclass: softmax over joint logits for
+    'multinomial', per-class sigmoids normalized per row for 'ovr'."""
+    from scipy.special import expit
+
+    if eta.ndim == 2 and multiclass == "multinomial":
+        z = np.exp(eta - eta.max(axis=1, keepdims=True))
+        return z / z.sum(axis=1, keepdims=True)
+    scores = expit(eta)
+    if scores.ndim == 2:
+        denom = np.maximum(scores.sum(axis=1, keepdims=True), 1e-30)
+        return scores / denom
+    return scores
+
+
+def labels_from_proba(proba: np.ndarray, classes) -> np.ndarray:
+    """Host epilogue mapping probabilities to class labels (rowwise)."""
+    if proba.ndim == 2:
+        return np.asarray(classes)[np.argmax(proba, axis=1)]
+    mask = proba > 0.5
+    if classes is not None:
+        return np.asarray(classes)[mask.astype(np.int64)]
+    return mask
 
 
 class _GLM(BaseEstimator):
@@ -232,13 +282,18 @@ class _GLM(BaseEstimator):
     def _decision_function(self, X):
         """Linear predictor on sharded rows, gathered back to host.
         ``_coef`` is 1-D for a single problem, (n_classes, width) for OVR —
-        the latter yields an (n, n_classes) score matrix, like sklearn."""
+        the latter yields an (n, n_classes) score matrix, like sklearn.
+
+        Staged on the precision wire into the active shape bucket and run
+        through the shared :func:`eta_program`, then sliced HOST-side: a
+        repeat predict whose n lands in a warm bucket compiles NOTHING
+        (the per-request contract the serving loop builds on; pinned by
+        ``tests/test_serving.py::test_direct_predict_zero_compiles``)."""
         X = check_array(X)
-        Xs, n = shard_rows(X)
-        Xs = add_intercept(Xs) if self.fit_intercept else Xs
-        coef = jnp.asarray(self._coef, Xs.dtype)
-        eta = Xs @ (coef.T if coef.ndim == 2 else coef)
-        return np.asarray(unpad_rows(eta, n))
+        Xs, n = shard_rows(X, dtype=precision_lib.staging_wire_dtype())
+        eta = eta_program(Xs, jnp.asarray(self._coef, jnp.float32),
+                          intercept=bool(self.fit_intercept))
+        return np.asarray(eta)[:n]
 
     # -- larger-than-HBM block streaming ----------------------------------
 
@@ -790,27 +845,14 @@ class LogisticRegression(_GLM):
         # (glm.py:203-215 returns sigmoid(X·coef), not an (n, 2) matrix).
         # Multiclass: softmax over the joint logits for 'multinomial';
         # per-class sigmoids normalized per row for 'ovr' (sklearn's
-        # OneVsRestClassifier semantics).
-        from scipy.special import expit
-
-        eta = self._decision_function(X)
-        if eta.ndim == 2 and self.multiclass == "multinomial":
-            z = np.exp(eta - eta.max(axis=1, keepdims=True))
-            return z / z.sum(axis=1, keepdims=True)
-        scores = expit(eta)
-        if scores.ndim == 2:
-            denom = np.maximum(scores.sum(axis=1, keepdims=True), 1e-30)
-            return scores / denom
-        return scores
+        # OneVsRestClassifier semantics). The eta→proba map lives in the
+        # module-level ``proba_from_eta`` so the serving runners share it
+        # bit-for-bit.
+        return proba_from_eta(self._decision_function(X), self.multiclass)
 
     def predict(self, X):
-        proba = self.predict_proba(X)
-        if proba.ndim == 2:
-            return self.classes_[np.argmax(proba, axis=1)]
-        mask = proba > 0.5
-        if hasattr(self, "classes_"):
-            return self.classes_[mask.astype(np.int64)]
-        return mask
+        return labels_from_proba(self.predict_proba(X),
+                                 getattr(self, "classes_", None))
 
     def score(self, X, y):
         return accuracy_score(np.asarray(y), self.predict(X))

@@ -64,6 +64,12 @@ from dask_ml_tpu.parallel.stream import (  # noqa: F401
     HostBlockSource,
     prefetched_scan,
 )
+from dask_ml_tpu.parallel.serving import (  # noqa: F401
+    ModelRegistry,
+    ServingClosed,
+    ServingLoop,
+    ServingQueueFull,
+)
 from dask_ml_tpu.parallel.elastic import (  # noqa: F401
     BlockPlan,
     ElasticRun,

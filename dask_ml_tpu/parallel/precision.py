@@ -76,6 +76,7 @@ __all__ = [
     "neumaier_add",
     "neumaier_sum",
     "cast_wire",
+    "staging_wire_dtype",
 ]
 
 #: dtypes considered "low precision" for the state-dtype floor: optimizer
@@ -243,6 +244,23 @@ def lloyd_bounds_dtype(data_dtype, policy=None):
     if override is None:
         return base
     return jnp.promote_types(state_dtype(override), base)
+
+
+def staging_wire_dtype():
+    """The dtype inference facades stage X in: the explicit ``dtype``
+    config knob when set (it outranks the policy, same precedence as
+    ``prepare_data``), else the active policy's storage dtype, else
+    ``None`` (keep the input dtype). This is the ONE rule that keeps every
+    predict/transform path — direct calls and the serving loop's batch
+    staging (:mod:`dask_ml_tpu.parallel.serving`) — on the same wire, so
+    serving results can be bit-identical to direct calls. Resolved at
+    facade level, never inside a trace (module docstring)."""
+    from dask_ml_tpu.config import get_config
+
+    dtype = get_config()["dtype"]
+    if dtype is not None:
+        return dtype
+    return resolve().storage_dtype()
 
 
 # ---------------------------------------------------------------------------

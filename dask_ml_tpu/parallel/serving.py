@@ -1,0 +1,857 @@
+"""Online inference service: continuously-batched, compile-once serving.
+
+Every ``predict``/``transform`` call in this package is a one-shot facade:
+validate, stage, dispatch one program, fetch. That is the right shape for a
+fit-time evaluation and exactly the wrong shape for live traffic — a
+service handling concurrent small requests would pay per-call staging, a
+fresh dispatch per request, and (before PR 4/PR 9's staging work) a
+compile per distinct request length. This module converts the substrate
+the previous PRs built — :class:`~dask_ml_tpu.parallel.shapes.PadPolicy`
+shape buckets, the PR-5 precision wire, the PR-7 telemetry registry —
+into a persistent serving subsystem (ROADMAP item 1; the
+continuous-batching discipline of modern inference servers applied to the
+``ParallelPostFit`` wrapper the reference ships, reference wrappers.py:
+124-272):
+
+- :class:`ModelRegistry` holds many FITTED estimators resident behind
+  stable names. Registration builds one *runner* per predict family —
+  KMeans assignment (``models/kmeans.py::predict_labels`` over the fused
+  distance kernels), GLM ``predict``/``predict_proba``
+  (``linear_model/glm.py::eta_program`` + the shared host epilogues), PCA
+  ``transform`` (``decomposition/pca.py::transform_program``), and
+  spectral out-of-sample ``predict``
+  (``SpectralClustering._assign_staged``) — each closing over the fitted
+  state staged device-side ONCE. Anything else (foreign sklearn
+  estimators included) gets a host-fallback runner, so the batching path
+  is universal even where the compile-once guarantee is not.
+- :class:`ServingLoop` owns a long-lived dispatch thread and a bounded
+  queue. ``submit()`` validates a request host-side (no device work on
+  the client thread) and returns a ``concurrent.futures.Future``; the
+  dispatch thread coalesces queued requests for the same (model, method)
+  into one micro-batch, zero-pads it HOST-side to a serving-tuned
+  :class:`~dask_ml_tpu.parallel.shapes.PadPolicy` bucket in the precision
+  wire dtype, stages it with a single sharded ``device_put``, runs the
+  family's jitted program, and scatters per-request row slices back to
+  the caller futures. Because padding happens on host and the per-bucket
+  programs are pre-warmed (:meth:`ServingLoop.warmup`), steady-state
+  traffic compiles NOTHING — not even the per-shape pad/slice trivia a
+  direct call used to pay — gated via
+  :func:`~dask_ml_tpu.parallel.shapes.compile_stats` by ``bench.py
+  --serving`` and the CI ``serving`` job.
+- **Bit-identity.** Every runner routes through the SAME jitted program
+  and host epilogue as the estimator's direct method, and every program
+  is row-independent (each output row depends only on its input row and
+  the replicated fitted state), so a served result equals the direct call
+  bit-for-bit regardless of how requests were coalesced or padded
+  (pinned per family across ragged sizes in ``tests/test_serving.py``).
+- **Observability** goes through the PR-7 telemetry layer only (no new
+  surface): ``serving.request`` spans on the blocking client path
+  (:meth:`ServingLoop.call`), ``serving.batch`` spans in the dispatch
+  thread, ``serving.queue_depth`` / ``serving.batch_occupancy`` gauges,
+  per-model ``serving.requests``/``serving.rows``/``serving.batches``/
+  ``serving.errors`` counters, and ``serving.request_seconds`` /
+  ``serving.batch_seconds`` latency histograms whose
+  :meth:`~dask_ml_tpu.parallel.telemetry.Histogram.percentiles` are the
+  p50/p99 the bench commits. The dispatch thread inherits the creating
+  thread's effective ``telemetry`` knob at :meth:`ServingLoop.start`.
+- **Lifecycle.** The loop composes with
+  :class:`~dask_ml_tpu.parallel.faults.GracefulDrain`: on SIGTERM (or
+  ``drain.request()``) it stops accepting, flushes every queued batch,
+  resolves all futures, and exits. A
+  :class:`~dask_ml_tpu.parallel.faults.FaultInjector` transfer fault
+  surfaces as per-request errors on the affected batch only — optionally
+  retried under a :class:`~dask_ml_tpu.parallel.faults.RetryPolicy` —
+  and never wedges the queue.
+
+``ParallelPostFit(serving=loop)`` turns the sklearn-facing wrapper into a
+thin client of this loop; see ``docs/serving.md`` for bucket tuning and
+the latency-vs-occupancy tradeoff.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Callable, Optional
+
+import numpy as np
+
+from dask_ml_tpu.parallel.shapes import PadPolicy
+
+__all__ = [
+    "ServingLoop",
+    "ModelRegistry",
+    "ServedModel",
+    "ServingError",
+    "ServingClosed",
+    "ServingQueueFull",
+    "DEFAULT_SERVING_POLICY",
+    "serving_buckets",
+]
+
+
+class ServingError(RuntimeError):
+    """Base class for serving-layer errors."""
+
+
+class ServingClosed(ServingError):
+    """The loop is draining or stopped: no new requests are accepted."""
+
+
+class ServingQueueFull(ServingError):
+    """The bounded request queue is at capacity (backpressure): the caller
+    should retry with backoff or shed load."""
+
+
+#: Serving-tuned bucket policy: pure powers of two from a 32-row floor.
+#: ``waste_cap=1.0`` keeps the bucket count minimal (one per octave —
+#: "a handful of pre-warmed programs" to cover any mix of request sizes)
+#: at the price of up to 2x padded rows per batch; padding rows cost only
+#: device FLOPs, which the small-batch regime has to spare, while every
+#: extra bucket costs a warmup compile per (model, method).
+DEFAULT_SERVING_POLICY = PadPolicy(waste_cap=1.0, min_rows=32)
+
+
+def serving_buckets(policy: PadPolicy, max_rows: int, align: int = 1):
+    """The distinct bucket sizes ``policy`` can produce for batches of 1..
+    ``max_rows`` rows — the program shapes :meth:`ServingLoop.warmup`
+    pre-compiles. Ascending; the top bucket covers ``max_rows`` itself."""
+    out = []
+    n = 1
+    while n <= int(max_rows):
+        b = policy.bucket(n, align=align)
+        out.append(b)
+        n = b + 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# per-family runners
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _Runner:
+    """One served method: ``kind`` is ``"device"`` (``run`` takes a staged
+    padded device array, returns padded host outputs to row-slice) or
+    ``"host"`` (``run`` takes the unpadded concatenated host batch)."""
+
+    kind: str
+    run: Callable
+
+
+def _glm_runners(est) -> dict:
+    import jax.numpy as jnp
+
+    from dask_ml_tpu.linear_model import glm as glm_lib
+
+    coef = jnp.asarray(est._coef, jnp.float32)
+    intercept = bool(est.fit_intercept)
+
+    def eta(Xs):
+        return np.asarray(
+            glm_lib.eta_program(Xs, coef, intercept=intercept))
+
+    runners = {}
+    family = getattr(est, "family", None)
+    if hasattr(est, "predict_proba"):  # classifier
+        multiclass = getattr(est, "multiclass", "ovr")
+        classes = getattr(est, "classes_", None)
+
+        def run_proba(Xs):
+            return glm_lib.proba_from_eta(eta(Xs), multiclass)
+
+        def run_predict(Xs):
+            return glm_lib.labels_from_proba(run_proba(Xs), classes)
+
+        runners["predict_proba"] = _Runner("device", run_proba)
+        runners["predict"] = _Runner("device", run_predict)
+    elif family == "poisson":
+        runners["predict"] = _Runner("device", lambda Xs: np.exp(eta(Xs)))
+    else:  # linear
+        runners["predict"] = _Runner("device", eta)
+    return runners
+
+
+def _kmeans_runners(est) -> dict:
+    import jax.numpy as jnp
+
+    from dask_ml_tpu.models import kmeans as km_core
+
+    centers = jnp.asarray(est.cluster_centers_)
+
+    def run(Xs):
+        # same program + uint8-wire epilogue as KMeans.predict's host path
+        labels = km_core.predict_labels(Xs, centers)
+        if int(est.n_clusters) <= 255:
+            return np.asarray(labels.astype(jnp.uint8)).astype(np.int32)
+        return np.asarray(labels)
+
+    return {"predict": _Runner("device", run)}
+
+
+def _pca_runners(est) -> dict:
+    import jax.numpy as jnp
+
+    from dask_ml_tpu.decomposition import pca as pca_lib
+
+    mean = jnp.asarray(est.mean_)
+    components = jnp.asarray(est.components_)
+    ev = jnp.asarray(est.explained_variance_)
+    whiten = bool(est.whiten)
+
+    def run(Xs):
+        return np.asarray(pca_lib.transform_program(
+            Xs, mean, components, ev, whiten=whiten))
+
+    return {"transform": _Runner("device", run)}
+
+
+def _spectral_runners(est) -> dict:
+    def run(Xs):
+        return np.asarray(est._assign_staged(Xs)).astype(np.int32)
+
+    return {"predict": _Runner("device", run)}
+
+
+def _host_runners(est, methods) -> dict:
+    """Fallback for anything else (foreign sklearn estimators included):
+    the loop still coalesces concurrent requests into one host batch per
+    dispatch — sklearn kernels amortize per-call overhead over the batch —
+    but there is no staged program, so the compile-once guarantee does
+    not apply."""
+    out = {}
+    for m in methods:
+        fn = getattr(est, m, None)
+        if callable(fn):
+            out[m] = _Runner("host", fn)
+    return out
+
+
+_SERVABLE_METHODS = ("predict", "predict_proba", "transform")
+
+
+def _build_runners(est, methods=None) -> dict:
+    """Family detection → runners. Explicit ``methods`` restricts the
+    served surface; by default every servable method the family supports
+    is exposed."""
+    from dask_ml_tpu.cluster.k_means import KMeans
+    from dask_ml_tpu.cluster.spectral import SpectralClustering
+    from dask_ml_tpu.decomposition.pca import PCA
+    from dask_ml_tpu.linear_model.glm import _GLM
+
+    if isinstance(est, KMeans):
+        runners = _kmeans_runners(est)
+    elif isinstance(est, SpectralClustering):
+        km = getattr(est, "assign_labels_", None)
+        if isinstance(km, KMeans) and not callable(est.affinity):
+            runners = _spectral_runners(est)
+        else:  # eager kernel strip / foreign assigner: host path
+            runners = _host_runners(est, _SERVABLE_METHODS)
+    elif isinstance(est, PCA):
+        runners = _pca_runners(est)
+    elif isinstance(est, _GLM):
+        runners = _glm_runners(est)
+    else:
+        runners = _host_runners(est, _SERVABLE_METHODS)
+    if methods is not None:
+        missing = [m for m in methods if m not in runners]
+        if missing:
+            raise ValueError(
+                f"estimator {type(est).__name__} cannot serve "
+                f"method(s) {missing}; available: {sorted(runners)}")
+        runners = {m: runners[m] for m in methods}
+    if not runners:
+        raise ValueError(
+            f"estimator {type(est).__name__} exposes none of "
+            f"{_SERVABLE_METHODS}")
+    return runners
+
+
+def _n_features_of(est) -> Optional[int]:
+    for attr, width in (
+        ("cluster_centers_", lambda a: a.shape[1]),
+        ("_landmarks_", lambda a: a.shape[1]),
+        ("mean_", lambda a: a.shape[0]),
+    ):
+        a = getattr(est, attr, None)
+        if a is not None:
+            return int(width(np.asarray(a)))
+    coef = getattr(est, "_coef", None)
+    if coef is not None:
+        return int(np.asarray(coef).shape[-1]
+                   - (1 if getattr(est, "fit_intercept", False) else 0))
+    nf = getattr(est, "n_features_in_", None)
+    return int(nf) if nf is not None else None
+
+
+@dataclasses.dataclass
+class ServedModel:
+    """A registered, fitted estimator with its per-method runners and the
+    expected request width (``n_features``; ``None`` disables the width
+    check for host-fallback models that do not declare one)."""
+
+    name: str
+    estimator: object
+    runners: dict
+    n_features: Optional[int]
+
+    @property
+    def methods(self) -> tuple:
+        return tuple(sorted(self.runners))
+
+
+class ModelRegistry:
+    """Named, fitted estimators resident behind one serving mesh.
+
+    ``register`` builds the family runners (staging fitted state
+    device-side once); ``ensure`` is the idempotent variant keyed on
+    estimator identity that :class:`~dask_ml_tpu.wrappers.ParallelPostFit`
+    uses. Registration is cheap relative to a warmup, so re-registering
+    after a refit (``invalidate`` + ``register``) is the supported way to
+    roll a model version.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._models: dict = {}
+        self._by_id: dict = {}  # id(estimator) -> name (ensure() memo)
+
+    def register(self, name: str, estimator, *, methods=None) -> ServedModel:
+        runners = _build_runners(estimator, methods)
+        model = ServedModel(name=str(name), estimator=estimator,
+                            runners=runners,
+                            n_features=_n_features_of(estimator))
+        with self._lock:
+            prior = self._models.get(model.name)
+            if prior is not None and prior.estimator is not estimator:
+                raise ValueError(
+                    f"model name {model.name!r} is already registered to a "
+                    "different estimator; unregister it first (or pick a "
+                    "distinct name)")
+            self._models[model.name] = model
+            self._by_id[id(estimator)] = model.name
+        return model
+
+    def ensure(self, estimator, name: Optional[str] = None) -> str:
+        """Idempotent registration keyed on estimator identity: returns
+        the existing name when this object is already registered."""
+        with self._lock:
+            existing = self._by_id.get(id(estimator))
+            if existing is not None and existing in self._models \
+                    and self._models[existing].estimator is estimator:
+                return existing
+        if name is None:
+            name = f"{type(estimator).__name__.lower()}-{id(estimator):x}"
+        return self.register(name, estimator).name
+
+    def get(self, name: str) -> ServedModel:
+        with self._lock:
+            model = self._models.get(str(name))
+        if model is None:
+            raise KeyError(f"no model registered under {name!r}")
+        return model
+
+    def names(self) -> list:
+        with self._lock:
+            return sorted(self._models)
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            model = self._models.pop(str(name), None)
+            if model is not None:
+                self._by_id.pop(id(model.estimator), None)
+
+    def invalidate(self, estimator) -> None:
+        """Drop every entry serving ``estimator`` (by identity) — called
+        after a refit mutates the fitted state the runners closed over."""
+        with self._lock:
+            stale = [n for n, m in self._models.items()
+                     if m.estimator is estimator]
+            for n in stale:
+                del self._models[n]
+            self._by_id.pop(id(estimator), None)
+
+
+# ---------------------------------------------------------------------------
+# the serving loop
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _Request:
+    model: str
+    method: str
+    X: np.ndarray
+    n: int
+    future: Future
+    t_enqueue: float
+    #: coalesce key: (model, method) for device runners; host runners
+    #: additionally split by input dtype so a foreign estimator sees each
+    #: request's rows in exactly the dtype the caller passed (numpy
+    #: concatenation would silently promote a mixed-dtype batch)
+    key: tuple = ()
+
+
+class ServingLoop:
+    """Persistent dispatch loop coalescing concurrent requests into
+    compile-once micro-batches (module docstring has the architecture).
+
+    Parameters
+    ----------
+    registry : ModelRegistry, optional
+        Shared registry; a private one is created by default.
+    policy : PadPolicy
+        Serving bucket policy (default :data:`DEFAULT_SERVING_POLICY`,
+        powers of two from 32). Smaller ``min_rows``/more buckets trade
+        warmup compiles for less padding waste; see docs/serving.md.
+    max_batch_rows : int
+        Row budget per micro-batch AND the per-request row cap
+        (:attr:`max_request_rows`): larger batches amortize dispatch
+        further but add head-of-line latency for the requests in them.
+    max_queue : int
+        Bounded queue capacity in REQUESTS; ``submit`` past it raises
+        :class:`ServingQueueFull` (backpressure, never silent dropping).
+    coalesce_window_s : float
+        Extra time the dispatcher may wait after picking a batch's first
+        request to let the batch fill. The default 0 never waits —
+        under load, batching emerges naturally from dispatch latency
+        (continuous batching); a small positive window trades p50 latency
+        for occupancy on lightly-loaded mixes.
+    mesh, drain, retry_policy, fault_injector
+        Mesh override; a :class:`~dask_ml_tpu.parallel.faults.
+        GracefulDrain` to compose shutdown with SIGTERM; a
+        :class:`~dask_ml_tpu.parallel.faults.RetryPolicy` for transient
+        transfer failures; a :class:`~dask_ml_tpu.parallel.faults.
+        FaultInjector` whose ``on_transfer`` hook the batch staging calls
+        (the same hook contract the streamed tier drills).
+    """
+
+    def __init__(self, registry: Optional[ModelRegistry] = None, *,
+                 policy: Optional[PadPolicy] = None,
+                 max_batch_rows: int = 2048,
+                 max_queue: int = 4096,
+                 coalesce_window_s: float = 0.0,
+                 mesh=None,
+                 drain=None,
+                 retry_policy=None,
+                 fault_injector=None,
+                 name: str = "serving"):
+        self.registry = registry if registry is not None else ModelRegistry()
+        self.policy = policy if policy is not None else DEFAULT_SERVING_POLICY
+        self.max_batch_rows = int(max_batch_rows)
+        self.max_queue = int(max_queue)
+        self.coalesce_window_s = float(coalesce_window_s)
+        self.name = str(name)
+        self._mesh = mesh
+        self._drain = drain
+        self._retry_policy = retry_policy
+        self._fault_injector = fault_injector
+
+        self._cond = threading.Condition()
+        self._queue: deque = deque()
+        self._closed = False
+        self._stopped = True
+        self._stopped_requested = False
+        self._thread: Optional[threading.Thread] = None
+        self._telemetry_inherit = False
+        self._wire = None
+        self._sharding = None
+        self._align = 1
+        self._batch_seq = 0
+        # operational counters (drain/flush logic + stats(); the
+        # OBSERVABILITY surface is the telemetry registry, not these)
+        self.n_submitted = 0
+        self.n_completed = 0
+        self.n_errors = 0
+        self.n_batches = 0
+        self.rows_served = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def max_request_rows(self) -> int:
+        """Largest single request ``submit`` accepts (clients chunk above
+        it — :class:`~dask_ml_tpu.wrappers.ParallelPostFit` does)."""
+        return self.max_batch_rows
+
+    def start(self) -> "ServingLoop":
+        """Resolve the mesh/wire (facade-level, in the CALLING thread so
+        scoped config is honored), then start the dispatch thread."""
+        from dask_ml_tpu.parallel import mesh as mesh_lib
+        from dask_ml_tpu.parallel import precision as precision_lib
+        from dask_ml_tpu.parallel import telemetry
+
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        mesh = self._mesh or mesh_lib.default_mesh()
+        self._mesh = mesh
+        self._sharding = mesh_lib.data_sharding(mesh, ndim=2)
+        self._align = mesh_lib.n_data_shards(mesh)
+        self._wire = precision_lib.staging_wire_dtype()
+        self._telemetry_inherit = telemetry.enabled()
+        self._closed = False
+        self._stopped = False
+        self._stopped_requested = False
+        self._thread = threading.Thread(
+            target=self._run, name=f"{self.name}-dispatch", daemon=True)
+        self._thread.start()
+        return self
+
+    def __enter__(self) -> "ServingLoop":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def stop(self, drain: bool = True, timeout: Optional[float] = 30.0):
+        """Stop the loop. ``drain=True`` (default) stops accepting new
+        requests, lets the dispatch thread flush every queued batch, and
+        resolves all futures before returning; ``drain=False`` fails
+        queued requests with :class:`ServingClosed` immediately."""
+        with self._cond:
+            self._closed = True
+            if not drain:
+                while self._queue:
+                    r = self._queue.popleft()
+                    if r.future.set_running_or_notify_cancel():
+                        r.future.set_exception(ServingClosed(
+                            "serving loop stopped without drain"))
+            self._stopped_requested = True
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None and t.is_alive() \
+                and t is not threading.current_thread():
+            t.join(timeout)
+        self._stopped = True
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def warmup(self, buckets=None, models=None) -> dict:
+        """Pre-compile every (model, method, bucket) program by pushing a
+        zero batch of each bucket size through the EXACT serving staging
+        path. Returns ``{"n_programs", "n_compiles", "compile_seconds"}``
+        so callers can log what warmup actually cost. After a warmup
+        covering the traffic's buckets, steady-state serving compiles
+        nothing (the ``bench.py --serving`` gate)."""
+        from dask_ml_tpu.parallel.shapes import track_compiles
+
+        if self._sharding is None:
+            raise ServingError("start() the loop before warmup()")
+        sizes = list(buckets) if buckets is not None else serving_buckets(
+            self.policy, self.max_batch_rows, align=self._align)
+        names = list(models) if models is not None else self.registry.names()
+        n_programs = 0
+        with track_compiles() as t:
+            for name in names:
+                model = self.registry.get(name)
+                d = model.n_features
+                if d is None:
+                    continue
+                for method, runner in model.runners.items():
+                    if runner.kind != "device":
+                        continue
+                    for b in sizes:
+                        buf = np.zeros((int(b), d), self._batch_dtype())
+                        runner.run(self._stage(buf))
+                        n_programs += 1
+        return {"n_programs": n_programs,
+                "n_compiles": t["n_compiles"],
+                "compile_seconds": round(t["compile_seconds"], 3)}
+
+    # -- client side -------------------------------------------------------
+
+    def submit(self, model: str, X, method: str = "predict") -> Future:
+        """Enqueue one inference request; returns a Future resolving to
+        the method's host-numpy result for exactly these rows.
+
+        Validation runs HOST-side here so the dispatch thread only ever
+        sees well-formed requests — a malformed request fails ITS caller,
+        never a batch it would have shared. Device families get the same
+        checks ``check_array`` applies on the direct path (staging cast +
+        finiteness); host-fallback models receive the batch exactly as
+        given (dtype preserved, NaN passed through) so a foreign
+        estimator behaves identically to calling it directly — NaN-native
+        models keep working, and its own validation errors stay its
+        own."""
+        from dask_ml_tpu.parallel import telemetry
+        from dask_ml_tpu.utils.validation import staging_dtype
+
+        model = str(model)
+        entry = self.registry.get(model)  # KeyError for unknown names
+        runner = entry.runners.get(method)
+        if runner is None:
+            raise ValueError(
+                f"model {model!r} does not serve {method!r}; "
+                f"available: {list(entry.methods)}")
+        arr = np.asarray(X)
+        if arr.ndim != 2:
+            raise ValueError(
+                f"Expected 2D array, got {arr.ndim}D array of shape "
+                f"{arr.shape}")
+        if arr.shape[0] < 1:
+            raise ValueError("request has no rows")
+        if arr.shape[0] > self.max_request_rows:
+            raise ValueError(
+                f"request has {arr.shape[0]} rows, above the per-request "
+                f"cap {self.max_request_rows}; split it (ParallelPostFit's "
+                "serving mode chunks automatically)")
+        if entry.n_features is not None and arr.shape[1] != entry.n_features:
+            raise ValueError(
+                f"model {model!r} expects {entry.n_features} features, "
+                f"request has {arr.shape[1]}")
+        if runner.kind == "device":
+            kind = np.dtype(arr.dtype).kind
+            if kind not in "fiub":
+                raise ValueError(f"Unsupported dtype {arr.dtype}")
+            sd = staging_dtype(arr.dtype)
+            if sd is not None:
+                arr = arr.astype(sd)
+            if np.dtype(arr.dtype).kind == "f" \
+                    and not bool(np.isfinite(arr).all()):
+                raise ValueError("Input contains NaN or infinity")
+            key = (model, str(method))
+        else:
+            key = (model, str(method), str(arr.dtype))
+
+        fut: Future = Future()
+        req = _Request(model=model, method=str(method), X=arr,
+                       n=int(arr.shape[0]), future=fut,
+                       t_enqueue=time.perf_counter(), key=key)
+        with self._cond:
+            if self._drain is not None and self._drain.requested:
+                # SIGTERM landed: stop accepting IMMEDIATELY (the dispatch
+                # thread flushes what is already queued)
+                self._closed = True
+                self._cond.notify_all()
+            if self._closed or self._stopped:
+                raise ServingClosed(
+                    f"serving loop {self.name!r} is not accepting requests")
+            if len(self._queue) >= self.max_queue:
+                raise ServingQueueFull(
+                    f"serving queue at capacity ({self.max_queue})")
+            self._queue.append(req)
+            depth = len(self._queue)
+            self.n_submitted += 1
+            self._cond.notify()
+        if telemetry.enabled():
+            telemetry.metrics().gauge("serving.queue_depth").set(depth)
+        return fut
+
+    def call(self, model: str, X, method: str = "predict",
+             timeout: Optional[float] = None) -> np.ndarray:
+        """Blocking convenience: ``submit`` + wait, wrapped in a
+        ``serving.request`` span — the canonical client-side request
+        (per-request latency lands in the span tree AND, loop-side, in
+        the ``serving.request_seconds`` histogram)."""
+        from dask_ml_tpu.parallel import telemetry
+
+        with telemetry.span("serving.request", model=str(model),
+                            method=str(method)):
+            return self.submit(model, X, method=method).result(timeout)
+
+    def stats(self) -> dict:
+        """Operational snapshot (observability lives in the telemetry
+        registry — ``telemetry_report()`` — not here)."""
+        with self._cond:
+            depth = len(self._queue)
+        return {
+            "models": self.registry.names(),
+            "queue_depth": depth,
+            "submitted": self.n_submitted,
+            "completed": self.n_completed,
+            "errors": self.n_errors,
+            "batches": self.n_batches,
+            "rows_served": self.rows_served,
+            "closed": self._closed,
+        }
+
+    # -- dispatch side -----------------------------------------------------
+
+    def _batch_dtype(self):
+        if self._wire is not None:
+            return np.dtype(self._wire)
+        return np.dtype(np.float32)
+
+    def _stage(self, buf: np.ndarray):
+        """One sharded ``device_put`` of the host-padded batch — the
+        fault-injection hook and retry policy wrap exactly this transfer,
+        mirroring the streamed tier's ``device_put`` contract."""
+        import jax
+
+        seq = self._batch_seq
+
+        def put():
+            if self._fault_injector is not None:
+                self._fault_injector.on_transfer(seq)
+            return jax.device_put(buf, self._sharding)
+
+        if self._retry_policy is not None:
+            return self._retry_policy.run(
+                put, kind="serving-transfer", detail=f"batch {seq}")
+        return put()
+
+    def _collect(self) -> list:
+        """Under the condition lock: wait for work, then pull the oldest
+        request plus every queued request sharing its (model, method), up
+        to the batch row budget. Returns [] when told to exit."""
+        with self._cond:
+            while True:
+                if self._queue:
+                    break
+                if self._closed or self._stopped \
+                        or getattr(self, "_stopped_requested", False):
+                    return []
+                if self._drain is not None and self._drain.requested:
+                    self._closed = True
+                    return []
+                self._cond.wait(timeout=0.05)
+            first = self._queue.popleft()
+            key = first.key
+            batch = [first]
+            rows = first.n
+            keep: deque = deque()
+            while self._queue:
+                r = self._queue.popleft()
+                if r.key == key and rows + r.n <= self.max_batch_rows:
+                    batch.append(r)
+                    rows += r.n
+                else:
+                    keep.append(r)
+            self._queue.extendleft(reversed(keep))
+        if self.coalesce_window_s > 0:
+            deadline = first.t_enqueue + self.coalesce_window_s
+            while time.perf_counter() < deadline \
+                    and rows < self.max_batch_rows:
+                with self._cond:
+                    if not self._queue:
+                        remaining = deadline - time.perf_counter()
+                        if remaining > 0:
+                            self._cond.wait(timeout=remaining)
+                    pulled = False
+                    keep = deque()
+                    while self._queue:
+                        r = self._queue.popleft()
+                        if r.key == key \
+                                and rows + r.n <= self.max_batch_rows:
+                            batch.append(r)
+                            rows += r.n
+                            pulled = True
+                        else:
+                            keep.append(r)
+                    self._queue.extendleft(reversed(keep))
+                    if self._closed or self._stopped:
+                        break
+                if not pulled and time.perf_counter() >= deadline:
+                    break
+        return batch
+
+    def _execute(self, batch: list) -> None:
+        from dask_ml_tpu.parallel import telemetry
+
+        # claim every future FIRST: a request its caller cancelled before
+        # dispatch is dropped here, and a claimed (running) future can no
+        # longer be cancelled, so the set_result/set_exception below
+        # cannot race a client-side cancel into an InvalidStateError that
+        # would kill the dispatch thread
+        batch = [r for r in batch
+                 if r.future.set_running_or_notify_cancel()]
+        if not batch:
+            return
+        model_name, method = batch[0].model, batch[0].method
+        rows = sum(r.n for r in batch)
+        tel = telemetry.enabled()
+        t0 = time.perf_counter()
+        self._batch_seq += 1
+        try:
+            model = self.registry.get(model_name)
+            runner = model.runners[method]
+            with telemetry.span("serving.batch", model=model_name,
+                                method=method, n_requests=len(batch),
+                                rows=rows) as sp:
+                if runner.kind == "host":
+                    hb = (batch[0].X if len(batch) == 1 else
+                          np.concatenate([r.X for r in batch], axis=0))
+                    out = np.asarray(runner.run(hb))
+                    bucket = rows
+                else:
+                    bucket = self.policy.bucket(rows, align=self._align)
+                    buf = np.zeros((bucket, model.n_features),
+                                   self._batch_dtype())
+                    off = 0
+                    for r in batch:
+                        buf[off:off + r.n] = r.X
+                        off += r.n
+                    out = np.asarray(runner.run(self._stage(buf)))
+                sp.set(bucket=bucket)
+        except Exception as e:  # noqa: BLE001 — per-request error delivery
+            self.n_errors += len(batch)
+            for r in batch:
+                r.future.set_exception(e)
+            if tel:
+                telemetry.metrics().counter(
+                    "serving.errors", model=model_name).inc(len(batch))
+            return
+        dt = time.perf_counter() - t0
+        now = time.perf_counter()
+        off = 0
+        for r in batch:
+            r.future.set_result(out[off:off + r.n].copy())
+            off += r.n
+        self.n_completed += len(batch)
+        self.n_batches += 1
+        self.rows_served += rows
+        if tel:
+            reg = telemetry.metrics()
+            reg.counter("serving.batches", model=model_name).inc()
+            reg.counter("serving.requests", model=model_name).inc(len(batch))
+            reg.counter("serving.rows", model=model_name).inc(rows)
+            reg.gauge("serving.batch_occupancy").set(rows / max(bucket, 1))
+            reg.histogram("serving.batch_rows").observe(rows)
+            reg.histogram("serving.batch_seconds").observe(dt)
+            lat = reg.histogram("serving.request_seconds", model=model_name)
+            for r in batch:
+                lat.observe(now - r.t_enqueue)
+
+    def _run(self) -> None:
+        import contextlib
+
+        from dask_ml_tpu import config as config_lib
+        from dask_ml_tpu.parallel import telemetry
+
+        # the dispatch thread inherits an ENABLED telemetry scope from the
+        # thread that called start() (thread-local scopes don't cross
+        # threads; this makes config_context(telemetry=True) around
+        # start() behave the way it reads). When start() saw the knob off,
+        # install NO override: the thread then follows the global knob, so
+        # set_config(telemetry=True) on a long-running loop takes effect
+        # mid-flight.
+        ctx = (config_lib.config_context(telemetry=True)
+               if self._telemetry_inherit else contextlib.nullcontext())
+        with ctx:
+            while True:
+                batch = self._collect()
+                if not batch:
+                    with self._cond:
+                        drain_hit = (self._drain is not None
+                                     and self._drain.requested)
+                        if drain_hit:
+                            self._closed = True
+                        if (self._closed
+                                or getattr(self, "_stopped_requested", False)
+                                ) and not self._queue:
+                            self._stopped = True
+                            self._cond.notify_all()
+                            return
+                    continue
+                if telemetry.enabled():
+                    with self._cond:
+                        depth = len(self._queue)
+                    telemetry.metrics().gauge(
+                        "serving.queue_depth").set(depth)
+                self._execute(batch)

@@ -178,6 +178,24 @@ def shard_rows(
 
 
 def _shard_rows_impl(x, mesh, dtype):
+    if not isinstance(x, jax.Array):
+        # HOST input: cast + zero-pad in numpy, then ONE sharded
+        # device_put. The former jnp route staged an unsharded copy first
+        # and compiled a tiny pad program per distinct n — fixed overhead
+        # a serving/predict path pays per request (docs/serving.md); this
+        # path compiles NOTHING. Values are bit-identical (same cast, same
+        # zero fill).
+        x = np.asarray(x)
+        if dtype is not None and x.dtype != np.dtype(dtype):
+            x = x.astype(dtype)
+        n = int(x.shape[0])
+        pad = _padded_rows(n, mesh) - n
+        if pad:
+            padded = np.zeros((n + pad,) + x.shape[1:], x.dtype)
+            padded[:n] = x
+            x = padded
+        sharding = mesh_lib.data_sharding(mesh, ndim=x.ndim)
+        return jax.device_put(x, sharding), n
     x = jnp.asarray(x, dtype=dtype)
     n = int(x.shape[0])
     pad = _padded_rows(n, mesh) - n
@@ -362,7 +380,17 @@ def _prepare_data_impl(X, y, sample_weight, mesh, dtype, y_dtype,
         Xs, n = shard_rows(X, mesh=mesh, dtype=dtype)
     ys = None
     if y is not None:
-        y_arr = jnp.asarray(y, dtype=y_dtype)
+        # keep host y on host until the one sharded put (same no-compile
+        # staging rule as X; device y — search CV slices — stays device)
+        if isinstance(y, jax.Array):
+            y_arr = jnp.asarray(y, dtype=y_dtype)
+        else:
+            y_arr = np.asarray(y, dtype=y_dtype)
+            if y_dtype is None and y_arr.dtype.kind in "iuf" \
+                    and y_arr.dtype.itemsize > 4:
+                # match jnp.asarray's x32 canonicalization for untyped y
+                y_arr = y_arr.astype(
+                    np.int32 if y_arr.dtype.kind in "iu" else np.float32)
         if y_arr.shape[0] != n:
             raise ValueError(
                 f"X has {n} rows but y has {y_arr.shape[0]}"

@@ -171,12 +171,23 @@ class Gauge:
             self.n_samples += 1
 
 
+#: raw-sample retention bound per histogram: percentiles are computed over
+#: the most recent this-many observations (a sliding window — for serving
+#: latency that is exactly the "recent traffic" view wanted; below the cap
+#: the window is ALL observations, which is what the numpy-percentile pin
+#: in tests/test_telemetry.py relies on)
+HISTOGRAM_SAMPLE_CAP = 8192
+
+
 class Histogram:
     """Count/sum/min/max plus power-of-two buckets (``le_2^e`` holds
-    observations in ``(2^(e-1), 2^e]``; nonpositive values land in ``0``) —
-    fixed memory however many observations arrive."""
+    observations in ``(2^(e-1), 2^e]``; nonpositive values land in ``0``),
+    plus a bounded window of raw samples (:data:`HISTOGRAM_SAMPLE_CAP` most
+    recent) from which :meth:`percentiles` reads p50/p99-style summary
+    stats — bounded memory however many observations arrive."""
 
-    __slots__ = ("_lock", "count", "total", "min", "max", "buckets")
+    __slots__ = ("_lock", "count", "total", "min", "max", "buckets",
+                 "samples")
 
     def __init__(self, lock: threading.Lock):
         self._lock = lock
@@ -185,6 +196,7 @@ class Histogram:
         self.min = None
         self.max = None
         self.buckets: dict = {}
+        self.samples: deque = deque(maxlen=HISTOGRAM_SAMPLE_CAP)
 
     @staticmethod
     def bucket_of(v: float) -> str:
@@ -201,6 +213,32 @@ class Histogram:
             self.min = v if self.min is None else min(self.min, v)
             self.max = v if self.max is None else max(self.max, v)
             self.buckets[b] = self.buckets.get(b, 0) + 1
+            self.samples.append(v)
+
+    def percentiles(self, q=(50, 90, 99)) -> dict:
+        """``{"p50": ..., "p90": ..., "p99": ...}`` over the retained
+        sample window, with numpy's default linear interpolation — pinned
+        equal to ``np.percentile(samples, q)`` while the observation count
+        stays under :data:`HISTOGRAM_SAMPLE_CAP` (beyond it the window
+        slides to the most recent cap-many samples). Empty histogram →
+        all ``None``."""
+        # copy under the (registry-wide) lock, sort OUTSIDE it: an 8k-
+        # sample sort must not stall concurrent metric writers on the
+        # dispatch hot path
+        with self._lock:
+            data = list(self.samples)
+        data.sort()
+        out: dict = {}
+        for qq in q:
+            key = f"p{qq:g}"
+            if not data:
+                out[key] = None
+                continue
+            pos = (len(data) - 1) * (float(qq) / 100.0)
+            lo = math.floor(pos)
+            hi = math.ceil(pos)
+            out[key] = data[lo] + (data[hi] - data[lo]) * (pos - lo)
+        return out
 
 
 class MetricsRegistry:
@@ -260,17 +298,24 @@ class MetricsRegistry:
                 }
                 for k, g in sorted(self._gauges.items())
             }
-            histograms = {
-                self._render_key(k): {
+            hist_items = sorted(self._histograms.items())
+        # percentiles take the shared lock per histogram; computed OUTSIDE
+        # the snapshot lock hold so a large sample window never stalls
+        # other metric writers behind a sort
+        histograms = {}
+        for k, h in hist_items:
+            with self._lock:
+                rec = {
                     "count": h.count,
                     "sum": h.total,
                     "min": h.min,
                     "max": h.max,
                     "mean": (h.total / h.count) if h.count else None,
                     "buckets": dict(h.buckets),
+                    "n_samples_retained": len(h.samples),
                 }
-                for k, h in sorted(self._histograms.items())
-            }
+            rec.update(h.percentiles())
+            histograms[self._render_key(k)] = rec
         return {"counters": counters, "gauges": gauges,
                 "histograms": histograms}
 
@@ -607,8 +652,11 @@ def render_report(max_rows: int = 12) -> str:
                      f"max={g['max']} n={g['n_samples']}")
     for name, h in list(m["histograms"].items())[:max_rows]:
         mean = "n/a" if h["mean"] is None else f"{h['mean']:.4g}"
+        pcts = "".join(
+            f" {k}={h[k]:.4g}" for k in ("p50", "p90", "p99")
+            if h.get(k) is not None)
         lines.append(f"  histogram {name}: count={h['count']} mean={mean} "
-                     f"min={h['min']} max={h['max']}")
+                     f"min={h['min']} max={h['max']}{pcts}")
     c = rep["compile"]
     lines.append(f"  compile: {c['n_compiles']} compiles "
                  f"({c['compile_seconds']:.2f}s), {c['n_traces']} traces, "

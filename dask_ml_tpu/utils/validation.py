@@ -27,7 +27,14 @@ def check_array(
     dtype: Optional[jnp.dtype] = None,
     min_samples: int = 1,
 ):
-    """Validate an input array on the host and return it as ``jnp``-compatible.
+    """Validate an input array and return it staging-ready.
+
+    Host (numpy/list) inputs validate entirely host-side and come back as
+    host numpy — the staging layer owns the single host→device transfer,
+    and no per-shape device program (the old jitted finite-scan compiled
+    once per distinct ``(n, d)``) ever runs for them. Device
+    (``jax.Array``) inputs keep the fused on-device scan, so
+    ``device_outputs`` pipelines never materialize to host here.
 
     Dtype policy (TPU-first): integer and float64 inputs are converted to
     float32 unless an explicit ``dtype`` is given — the reference similarly
@@ -103,6 +110,26 @@ def _check_array_impl(X, ensure_2d, allow_nd, force_all_finite, dtype,
         if kind not in "fiub":
             raise ValueError(f"Unsupported dtype {arr.dtype}")
         dtype = staging_dtype(arr.dtype)
+    if not isinstance(X, jax.Array):
+        # HOST input: validate host-side and return host numpy — the
+        # staging layer (shard_rows/prepare_data) owns the one transfer.
+        # The former jnp round-trip here cost an extra unsharded upload
+        # AND compiled the finite-scan per distinct (n, d): exactly the
+        # per-request overhead a predict path serving live traffic cannot
+        # pay (docs/serving.md). Cast BEFORE scanning so an overflow the
+        # narrowing cast manufactures (1e300 → inf in f32) is still
+        # caught, matching the device path's post-cast scan.
+        if dtype is not None and arr.dtype != np.dtype(dtype):
+            arr = arr.astype(dtype)
+        if force_all_finite and np.dtype(arr.dtype).kind == "f":
+            try:
+                finite = bool(np.isfinite(arr).all())
+            except TypeError:  # exotic float (ml_dtypes) without ufunc
+                finite = bool(np.isfinite(
+                    arr.astype(np.float32, copy=False)).all())
+            if not finite:
+                raise ValueError("Input contains NaN or infinity")
+        return arr
     out = jnp.asarray(arr, dtype=dtype)
     if force_all_finite:
         if isinstance(X, jax.Array):

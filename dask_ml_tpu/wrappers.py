@@ -120,13 +120,29 @@ class ParallelPostFit(BaseEstimator, MetaEstimatorMixin):
         Rows per block for host-side blockwise inference over foreign
         estimators. jax-native estimators ignore it (the mesh shards
         instead).
+    serving : ServingLoop, optional
+        A started :class:`~dask_ml_tpu.parallel.serving.ServingLoop`:
+        ``predict``/``predict_proba``/``transform`` become thin clients of
+        the loop — the estimator is registered (idempotently, by identity)
+        in the loop's :class:`~dask_ml_tpu.parallel.serving.ModelRegistry`
+        on first use, requests above the loop's per-request row cap are
+        chunked and their futures gathered, and results are bit-identical
+        to the direct path (docs/serving.md). Sparse inputs and methods
+        the loop does not serve fall back to the direct path. A refit
+        through :meth:`fit` invalidates the loop's registration so stale
+        fitted state is never served.
+    serving_model : str, optional
+        Explicit registry name (default: derived from the estimator).
     """
 
     def __init__(self, estimator=None, scoring=None,
-                 block_size: int = DEFAULT_BLOCK_SIZE):
+                 block_size: int = DEFAULT_BLOCK_SIZE,
+                 serving=None, serving_model=None):
         self.estimator = estimator
         self.scoring = scoring
         self.block_size = block_size
+        self.serving = serving
+        self.serving_model = serving_model
 
     @property
     def _postfit_estimator(self):
@@ -136,7 +152,19 @@ class ParallelPostFit(BaseEstimator, MetaEstimatorMixin):
         """Fit the underlying estimator as-is (reference: wrappers.py:124-146)."""
         start = tic()
         logger.info("Starting fit")
-        result = self.estimator.fit(X, y, **kwargs)
+        if self.serving is not None:
+            # the runners closed over the PREVIOUS fitted state; drop them
+            # before it mutates so a racing submit can never serve a
+            # half-updated model
+            self.serving.registry.invalidate(self.estimator)
+        try:
+            result = self.estimator.fit(X, y, **kwargs)
+        finally:
+            if self.serving is not None:
+                # a predict racing this fit may have RE-registered the
+                # estimator mid-mutation; drop that snapshot too so the
+                # next request stages the final fitted state
+                self.serving.registry.invalidate(self.estimator)
         logger.info("Finished fit, %0.2f", tic() - start)
         copy_learned_attributes(result, self)
         return self
@@ -152,6 +180,53 @@ class ParallelPostFit(BaseEstimator, MetaEstimatorMixin):
                 f"'{method}' method."
             )
         return getattr(estimator, method)
+
+    def _serving_name(self):
+        est = self._postfit_estimator
+        return self.serving.registry.ensure(est, name=self.serving_model)
+
+    def _serving_call(self, method, X):
+        """Route one logical request through the serving loop: chunk to
+        the loop's per-request cap, submit every chunk (they coalesce
+        with concurrent traffic loop-side), gather in order. One
+        ``serving.request`` span per logical request."""
+        from dask_ml_tpu.parallel import telemetry
+
+        loop = self.serving
+        name = self._serving_name()
+        X = np.asarray(X)
+        n = X.shape[0]
+        with telemetry.span("serving.request", model=name, method=method,
+                            rows=n):
+            cap = min(int(self.block_size), loop.max_request_rows)
+            if n <= cap:
+                return loop.submit(name, X, method=method).result()
+            futs = [loop.submit(name, X[s], method=method)
+                    for s in _block_slices(n, cap)]
+            return np.concatenate([f.result() for f in futs], axis=0)
+
+    def _dispatch(self, method, X):
+        if self.serving is not None and not sp.issparse(X):
+            self._check_method(method)  # AttributeError contract first
+            entry = None
+            if not getattr(self, "_serving_unsupported", False):
+                try:
+                    name = self._serving_name()
+                    entry = self.serving.registry.get(name)
+                except ValueError as e:
+                    if self.serving_model is not None:
+                        # the user NAMED this registration; a collision or
+                        # unsupported family is a config error, not a
+                        # silent downgrade
+                        raise
+                    self._serving_unsupported = True
+                    logger.warning(
+                        "serving registration failed for %s; falling back "
+                        "to the direct path: %s",
+                        type(self._postfit_estimator).__name__, e)
+            if entry is not None and method in entry.runners:
+                return self._serving_call(method, X)
+        return self._blockwise(self._check_method(method), X)
 
     def _blockwise(self, fn, X):
         """Apply ``fn`` over row blocks of ``X``.
@@ -172,16 +247,16 @@ class ParallelPostFit(BaseEstimator, MetaEstimatorMixin):
         return _concat_rows(parts)
 
     def predict(self, X):
-        return self._blockwise(self._check_method("predict"), X)
+        return self._dispatch("predict", X)
 
     def predict_proba(self, X):
-        return self._blockwise(self._check_method("predict_proba"), X)
+        return self._dispatch("predict_proba", X)
 
     def predict_log_proba(self, X):
         return self._blockwise(self._check_method("predict_log_proba"), X)
 
     def transform(self, X):
-        return self._blockwise(self._check_method("transform"), X)
+        return self._dispatch("transform", X)
 
     def score(self, X, y):
         """Score via the configured scorer, else delegate

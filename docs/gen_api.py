@@ -77,6 +77,14 @@ SECTIONS = [
      "preemption-safe checkpoint/drain/resume for the streamed tier, and "
      "the deterministic fault-injection harness — see docs/robustness.md "
      "for the contract and the CI drill."),
+    ("dask_ml_tpu.parallel.serving", "Online inference serving",
+     "The continuously-batched, compile-once serving subsystem: "
+     "ModelRegistry holds fitted estimators resident behind stable names "
+     "with one runner per predict family; ServingLoop coalesces "
+     "concurrent submit() requests into micro-batches padded to "
+     "pre-warmed shape buckets, with results bit-identical to direct "
+     "predict calls — see docs/serving.md for bucket tuning, lifecycle, "
+     "and the telemetry taxonomy."),
     ("dask_ml_tpu.parallel.elastic", "Elastic data plane",
      "Multi-host sharded ingestion for the streamed tier: the seeded "
      "cross-epoch BlockPlan permutation (coordination is arithmetic — no "

@@ -1,0 +1,765 @@
+"""Online inference service (docs/serving.md): continuous batching with
+bit-identity to the direct predict paths, the zero-recompile steady-state
+contract, lifecycle (GracefulDrain / FaultInjector) composition, and the
+``ParallelPostFit(serving=...)`` thin client.
+
+The bit-identity pins are the load-bearing ones: every registry family
+routes through the SAME jitted program and host epilogue as the
+estimator's direct method, so a served result must equal the direct call
+bit-for-bit however requests were coalesced or padded — across ragged
+request sizes straddling bucket boundaries, including n=1 and
+n < the smallest bucket.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu import config
+from dask_ml_tpu.parallel import telemetry
+from dask_ml_tpu.parallel.faults import (
+    FaultInjector,
+    GracefulDrain,
+    InjectedTransferError,
+    RetryPolicy,
+)
+from dask_ml_tpu.parallel.serving import (
+    DEFAULT_SERVING_POLICY,
+    ModelRegistry,
+    ServingClosed,
+    ServingLoop,
+    ServingQueueFull,
+    serving_buckets,
+)
+from dask_ml_tpu.parallel.shapes import PadPolicy, track_compiles
+
+#: ragged request sizes straddling the serving bucket boundaries
+#: (DEFAULT_SERVING_POLICY: powers of two from 32) — n=1 and n < min
+#: bucket included per the acceptance criteria
+RAGGED_SIZES = (1, 3, 31, 32, 33, 63, 64, 65, 100, 127, 128, 200)
+
+
+def _data(n=512, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randn(n, d).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    """One fitted estimator per registry family (module-scoped: fitting
+    is the expensive part and every test only reads)."""
+    from dask_ml_tpu.cluster import KMeans, SpectralClustering
+    from dask_ml_tpu.decomposition import PCA
+    from dask_ml_tpu.linear_model import LinearRegression, LogisticRegression
+
+    X = _data(512, 8)
+    rng = np.random.RandomState(1)
+    y_bin = (rng.rand(512) > 0.5).astype(np.int32)
+    y_multi = rng.randint(0, 3, 512).astype(np.int32)
+    y_reg = X @ rng.randn(8).astype(np.float32)
+
+    return {
+        "X": X,
+        "kmeans": KMeans(n_clusters=4, random_state=0, max_iter=5).fit(X),
+        "logistic": LogisticRegression(max_iter=20).fit(X, y_bin),
+        "multinomial": LogisticRegression(
+            max_iter=20, multiclass="multinomial").fit(X, y_multi),
+        "linear": LinearRegression(max_iter=20).fit(X, y_reg),
+        "pca": PCA(n_components=3, random_state=0).fit(X),
+        "pca_whiten": PCA(n_components=3, whiten=True,
+                          random_state=0).fit(X),
+        "spectral": SpectralClustering(
+            n_clusters=3, n_components=40, gamma=None,
+            random_state=0).fit(_data(400, 8, seed=2)),
+    }
+
+
+@pytest.fixture()
+def loop(fitted):
+    reg = ModelRegistry()
+    for name in ("kmeans", "logistic", "multinomial", "linear", "pca",
+                 "pca_whiten", "spectral"):
+        reg.register(name, fitted[name])
+    lp = ServingLoop(reg, max_batch_rows=256)
+    lp.start()
+    yield lp
+    lp.stop()
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: every family, ragged sizes across bucket boundaries
+# ---------------------------------------------------------------------------
+
+
+#: (registry name, served method, direct call)
+FAMILIES = [
+    ("kmeans", "predict", lambda est, X: est.predict(X)),
+    ("logistic", "predict", lambda est, X: est.predict(X)),
+    ("logistic", "predict_proba", lambda est, X: est.predict_proba(X)),
+    ("multinomial", "predict", lambda est, X: est.predict(X)),
+    ("multinomial", "predict_proba", lambda est, X: est.predict_proba(X)),
+    ("linear", "predict", lambda est, X: est.predict(X)),
+    ("pca", "transform", lambda est, X: est.transform(X)),
+    ("pca_whiten", "transform", lambda est, X: est.transform(X)),
+    ("spectral", "predict", lambda est, X: est.predict(X)),
+]
+
+
+@pytest.mark.parametrize("name,method,direct",
+                         FAMILIES, ids=[f"{n}-{m}" for n, m, _ in FAMILIES])
+def test_bit_identity_ragged(loop, fitted, name, method, direct):
+    """submit() == direct call bit-for-bit at every ragged size — the
+    whole point of routing both paths through one jitted program."""
+    est = fitted[name]
+    X = fitted["X"]
+    futs = [(n, loop.submit(name, X[:n], method=method))
+            for n in RAGGED_SIZES]
+    for n, fut in futs:
+        served = fut.result(timeout=60)
+        want = direct(est, X[:n])
+        assert served.dtype == np.asarray(want).dtype, (name, method, n)
+        assert np.array_equal(served, want), (name, method, n)
+
+
+def test_bit_identity_concatenation_order(loop, fitted):
+    """Requests coalesced into ONE batch come back row-exact: each future
+    resolves to its own rows, not a neighbor's."""
+    X = fitted["X"]
+    est = fitted["linear"]
+    # distinct row contents per request so a scatter off-by-one is loud
+    reqs = [X[i * 10:(i * 10) + 7] for i in range(8)]
+    futs = [loop.submit("linear", r) for r in reqs]
+    for r, fut in zip(reqs, futs):
+        assert np.array_equal(fut.result(60), est.predict(r))
+
+
+# ---------------------------------------------------------------------------
+# compile-once: warmup covers the buckets, traffic compiles nothing
+# ---------------------------------------------------------------------------
+
+
+def test_warmup_then_zero_compiles(loop, fitted):
+    """After warmup() the EXACT serving staging path is compiled for every
+    (model, method, bucket): mixed-size steady-state traffic adds zero
+    compiles — the ``bench.py --serving`` gate, pinned here at test
+    scale."""
+    X = fitted["X"]
+    w = loop.warmup()
+    assert w["n_programs"] > 0
+    # second warmup over the same buckets is free
+    w2 = loop.warmup()
+    assert w2["n_compiles"] == 0
+
+    with track_compiles() as t:
+        futs = []
+        for n in RAGGED_SIZES:
+            futs.append(loop.submit("kmeans", X[:n]))
+            futs.append(loop.submit("logistic", X[:n],
+                                    method="predict_proba"))
+            futs.append(loop.submit("pca", X[:n], method="transform"))
+        for f in futs:
+            f.result(60)
+    assert t["n_compiles"] == 0, t
+
+
+def test_serving_buckets_cover_range():
+    pol = DEFAULT_SERVING_POLICY
+    sizes = serving_buckets(pol, 256)
+    assert sizes == sorted(set(sizes))
+    assert sizes[-1] >= 256
+    # every batch size 1..max maps onto a warmed bucket
+    assert {pol.bucket(n) for n in range(1, 257)} <= set(sizes)
+
+
+def test_direct_predict_zero_compiles(fitted):
+    """Satellite: the PLAIN (non-serving) predict paths stage through the
+    active PadPolicy + precision wire, so repeated one-off predicts on
+    nearby input lengths stop recompiling per distinct n (mirrors the
+    PR-4 K-fold compile gate)."""
+    X = _data(450, 8, seed=9)
+    km, lr, pca = fitted["kmeans"], fitted["logistic"], fitted["pca"]
+    # warm one bucket: DEFAULT_POLICY puts 390..416 in the 416 bucket
+    km.predict(X[:400])
+    lr.predict(X[:400])
+    lr.predict_proba(X[:400])
+    pca.transform(X[:400])
+    with track_compiles() as t:
+        for n in (390, 401, 410, 416):
+            km.predict(X[:n])
+            lr.predict(X[:n])
+            lr.predict_proba(X[:n])
+            pca.transform(X[:n])
+    assert t["n_compiles"] == 0, t
+
+
+def test_direct_vs_served_same_program(fitted):
+    """The serving loop and the direct path share executables: warming via
+    DIRECT calls at the serving buckets leaves nothing for warmup() to
+    compile (same program identity, not merely same semantics)."""
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    from dask_ml_tpu.parallel import shapes
+
+    X = _data(300, 6, seed=4)
+    y = (np.random.RandomState(0).rand(300) > 0.5).astype(np.int32)
+    est = LogisticRegression(max_iter=10).fit(X, y)
+    reg = ModelRegistry()
+    reg.register("m", est)
+    # loop on the SAME policy the direct path stages with, so the bucket
+    # sets coincide and program identity is observable via compile counts
+    with ServingLoop(reg, policy=shapes.DEFAULT_POLICY,
+                     max_batch_rows=256) as lp:
+        for b in serving_buckets(lp.policy, 256, align=lp._align):
+            est.predict_proba(X[:b])
+            est.predict(X[:b])
+        w = lp.warmup()
+    assert w["n_compiles"] == 0, w
+
+
+# ---------------------------------------------------------------------------
+# batching mechanics
+# ---------------------------------------------------------------------------
+
+
+class _BlockingModel:
+    """Host-fallback estimator whose predict blocks until released —
+    the deterministic way to hold the dispatch thread while requests
+    pile up behind it."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.entered = threading.Event()
+
+    def predict(self, X):
+        self.entered.set()
+        assert self.release.wait(30), "never released"
+        return np.asarray(X).sum(axis=1)
+
+
+def test_concurrent_requests_coalesce(fitted):
+    """Requests queued while the dispatcher is busy are served as ONE
+    micro-batch (continuous batching), and batch accounting shows it."""
+    blocker = _BlockingModel()
+    reg = ModelRegistry()
+    reg.register("blocker", blocker)
+    reg.register("lin", fitted["linear"])
+    with ServingLoop(reg, max_batch_rows=512) as lp:
+        head = lp.submit("blocker", fitted["X"][:4])
+        assert blocker.entered.wait(30)
+        # dispatcher is now parked inside the blocker's predict
+        futs = [lp.submit("lin", fitted["X"][i:i + 5]) for i in range(10)]
+        blocker.release.set()
+        head.result(60)
+        for i, f in enumerate(futs):
+            assert np.array_equal(
+                f.result(60),
+                fitted["linear"].predict(fitted["X"][i:i + 5]))
+        assert lp.n_batches == 2  # blocker batch + ONE coalesced batch
+        assert lp.rows_served == 4 + 50
+
+
+def test_batch_row_budget_splits(fitted):
+    """A pile-up larger than max_batch_rows splits into multiple batches,
+    each under the budget."""
+    blocker = _BlockingModel()
+    reg = ModelRegistry()
+    reg.register("blocker", blocker)
+    reg.register("lin", fitted["linear"])
+    with ServingLoop(reg, max_batch_rows=64) as lp:
+        head = lp.submit("blocker", fitted["X"][:4])
+        assert blocker.entered.wait(30)
+        futs = [lp.submit("lin", fitted["X"][:40]) for _ in range(4)]
+        blocker.release.set()
+        head.result(60)
+        for f in futs:
+            f.result(60)
+        # 4 x 40 rows under a 64-row budget -> one request per batch
+        assert lp.n_batches == 1 + 4
+
+
+def test_queue_full_backpressure(fitted):
+    blocker = _BlockingModel()
+    reg = ModelRegistry()
+    reg.register("blocker", blocker)
+    reg.register("lin", fitted["linear"])
+    with ServingLoop(reg, max_batch_rows=64, max_queue=2) as lp:
+        head = lp.submit("blocker", fitted["X"][:4])
+        assert blocker.entered.wait(30)
+        lp.submit("lin", fitted["X"][:4])
+        lp.submit("lin", fitted["X"][:4])
+        with pytest.raises(ServingQueueFull):
+            lp.submit("lin", fitted["X"][:4])
+        blocker.release.set()
+        head.result(60)
+
+
+# ---------------------------------------------------------------------------
+# request validation (fails the caller, never a shared batch)
+# ---------------------------------------------------------------------------
+
+
+def test_submit_validation(loop, fitted):
+    X = fitted["X"]
+    with pytest.raises(KeyError):
+        loop.submit("nope", X[:4])
+    with pytest.raises(ValueError, match="does not serve"):
+        loop.submit("kmeans", X[:4], method="predict_proba")
+    with pytest.raises(ValueError, match="2D"):
+        loop.submit("kmeans", X[0])
+    with pytest.raises(ValueError, match="no rows"):
+        loop.submit("kmeans", X[:0])
+    with pytest.raises(ValueError, match="features"):
+        loop.submit("kmeans", X[:4, :5])
+    with pytest.raises(ValueError, match="cap"):
+        loop.submit("kmeans", np.zeros((loop.max_request_rows + 1, 8),
+                                       np.float32))
+    bad = X[:4].copy()
+    bad[1, 2] = np.nan
+    with pytest.raises(ValueError, match="NaN"):
+        loop.submit("kmeans", bad)
+
+
+def test_integer_input_staged_like_direct(loop, fitted):
+    Xi = (fitted["X"][:40] * 10).astype(np.int32)
+    assert np.array_equal(loop.submit("kmeans", Xi).result(60),
+                          fitted["kmeans"].predict(Xi))
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+
+def test_registry_semantics(fitted):
+    reg = ModelRegistry()
+    m = reg.register("a", fitted["kmeans"])
+    assert m.methods == ("predict",)
+    assert reg.ensure(fitted["kmeans"]) == "a"  # idempotent by identity
+    with pytest.raises(ValueError, match="already registered"):
+        reg.register("a", fitted["pca"])
+    reg.register("a", fitted["kmeans"])  # same estimator: fine
+    name = reg.ensure(fitted["pca"])
+    assert reg.get(name).estimator is fitted["pca"]
+    reg.invalidate(fitted["kmeans"])
+    with pytest.raises(KeyError):
+        reg.get("a")
+    assert reg.names() == [name]
+    reg.unregister(name)
+    assert reg.names() == []
+
+
+def test_register_restricted_methods(fitted):
+    reg = ModelRegistry()
+    m = reg.register("lg", fitted["logistic"], methods=["predict_proba"])
+    assert m.methods == ("predict_proba",)
+    with pytest.raises(ValueError, match="cannot serve"):
+        reg.register("pc", fitted["pca"], methods=["predict"])
+
+
+def test_host_fallback_foreign_estimator(fitted):
+    """A foreign (non-jax) sklearn estimator is still servable through the
+    host-batch path, results equal to calling it directly."""
+    from sklearn.neighbors import KNeighborsClassifier
+
+    X = fitted["X"][:200]
+    y = (np.random.RandomState(0).rand(200) > 0.5).astype(np.int32)
+    knn = KNeighborsClassifier(n_neighbors=3).fit(X, y)
+    reg = ModelRegistry()
+    assert "predict" in reg.register("knn", knn).runners
+    with ServingLoop(reg, max_batch_rows=128) as lp:
+        for n in (1, 7, 33):
+            assert np.array_equal(lp.submit("knn", X[:n]).result(60),
+                                  knn.predict(X[:n]))
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: stop/drain/faults
+# ---------------------------------------------------------------------------
+
+
+def test_stop_rejects_new_submits(fitted):
+    reg = ModelRegistry()
+    reg.register("lin", fitted["linear"])
+    lp = ServingLoop(reg).start()
+    lp.stop()
+    with pytest.raises(ServingClosed):
+        lp.submit("lin", fitted["X"][:4])
+
+
+def test_stop_without_drain_fails_queued(fitted):
+    blocker = _BlockingModel()
+    reg = ModelRegistry()
+    reg.register("blocker", blocker)
+    reg.register("lin", fitted["linear"])
+    lp = ServingLoop(reg).start()
+    head = lp.submit("blocker", fitted["X"][:4])
+    assert blocker.entered.wait(30)
+    fut = lp.submit("lin", fitted["X"][:4])
+    blocker.release.set()
+    lp.stop(drain=False)
+    # the queued request either resolved before the stop landed or was
+    # failed with ServingClosed — never silently dropped
+    assert fut.done()
+    head.result(60)
+
+
+def test_graceful_drain_flushes_then_rejects(fitted):
+    """SIGTERM semantics via GracefulDrain.request(): in-flight and queued
+    requests all resolve (futures never dangle), new submits raise
+    ServingClosed, and the dispatch thread exits."""
+    drain = GracefulDrain()
+    blocker = _BlockingModel()
+    reg = ModelRegistry()
+    reg.register("blocker", blocker)
+    reg.register("lin", fitted["linear"])
+    lp = ServingLoop(reg, drain=drain).start()
+    head = lp.submit("blocker", fitted["X"][:4])
+    assert blocker.entered.wait(30)
+    futs = [lp.submit("lin", fitted["X"][i:i + 3]) for i in range(6)]
+    drain.request()  # deterministic SIGTERM stand-in (PR-3 contract)
+    with pytest.raises(ServingClosed):
+        lp.submit("lin", fitted["X"][:4])
+    blocker.release.set()
+    head.result(60)
+    for i, f in enumerate(futs):
+        assert np.array_equal(
+            f.result(60), fitted["linear"].predict(fitted["X"][i:i + 3]))
+    lp._thread.join(30)
+    assert not lp._thread.is_alive()
+    assert lp.stats()["closed"]
+
+
+def test_transfer_fault_fails_batch_not_queue(fitted):
+    """An injected transfer fault surfaces on the affected batch's futures
+    only; the loop keeps serving afterwards (the queue is never wedged)."""
+    inj = FaultInjector().fail_transfer(1, times=1)  # first traffic batch
+    reg = ModelRegistry()
+    reg.register("lin", fitted["linear"])
+    with ServingLoop(reg, fault_injector=inj) as lp:
+        bad = lp.submit("lin", fitted["X"][:8])
+        with pytest.raises(InjectedTransferError):
+            bad.result(60)
+        good = lp.submit("lin", fitted["X"][:8])
+        assert np.array_equal(good.result(60),
+                              fitted["linear"].predict(fitted["X"][:8]))
+        assert lp.n_errors == 1
+        assert inj.injected["transfer"] == 1
+
+
+def test_transfer_fault_retried_under_policy(fitted):
+    """With a RetryPolicy the same injected fault is retried transparently:
+    the caller sees a normal result."""
+    inj = FaultInjector().fail_transfer(1, times=2)
+    pol = RetryPolicy(max_retries=3, base_delay=0.01)
+    reg = ModelRegistry()
+    reg.register("lin", fitted["linear"])
+    with ServingLoop(reg, fault_injector=inj, retry_policy=pol) as lp:
+        fut = lp.submit("lin", fitted["X"][:8])
+        assert np.array_equal(fut.result(60),
+                              fitted["linear"].predict(fitted["X"][:8]))
+    assert pol.retries == 2
+    assert inj.injected["transfer"] == 2
+
+
+def test_runner_exception_delivered_per_request(fitted):
+    """A runner raising (host fallback here) fails its requests with THAT
+    exception and the loop survives."""
+
+    class Broken:
+        def predict(self, X):
+            raise RuntimeError("kaboom")
+
+    reg = ModelRegistry()
+    reg.register("broken", Broken())
+    reg.register("lin", fitted["linear"])
+    with ServingLoop(reg) as lp:
+        fut = lp.submit("broken", fitted["X"][:4])
+        with pytest.raises(RuntimeError, match="kaboom"):
+            fut.result(60)
+        ok = lp.submit("lin", fitted["X"][:4])
+        assert np.array_equal(ok.result(60),
+                              fitted["linear"].predict(fitted["X"][:4]))
+
+
+# ---------------------------------------------------------------------------
+# telemetry surface
+# ---------------------------------------------------------------------------
+
+
+def test_serving_telemetry_surface(fitted):
+    """The loop reports through the PR-7 registry only: request/row/batch
+    counters, queue-depth + occupancy gauges, latency histograms whose
+    percentiles land in telemetry_report()."""
+    telemetry.reset_telemetry()
+    reg = ModelRegistry()
+    reg.register("lin", fitted["linear"])
+    with config.config_context(telemetry=True):
+        with ServingLoop(reg, max_batch_rows=128) as lp:
+            futs = [lp.submit("lin", fitted["X"][:n])
+                    for n in (1, 5, 17, 40)]
+            for f in futs:
+                f.result(60)
+            n_req, rows = 4, 63
+        rep = telemetry.telemetry_report()
+    counters = rep["metrics"]["counters"]
+    assert counters["serving.requests{model=lin}"] == n_req
+    assert counters["serving.rows{model=lin}"] == rows
+    assert counters["serving.batches{model=lin}"] == lp.n_batches
+    gauges = rep["metrics"]["gauges"]
+    occ = gauges["serving.batch_occupancy"]
+    assert 0.0 < occ["last"] <= 1.0
+    qd = gauges["serving.queue_depth"]
+    assert qd["n_samples"] >= n_req and qd["min"] >= 0
+    hist = rep["metrics"]["histograms"]
+    lat = hist["serving.request_seconds{model=lin}"]
+    assert lat["count"] == n_req
+    assert lat["p99"] is not None and lat["p99"] >= lat["p50"] > 0
+    assert hist["serving.batch_seconds"]["count"] == lp.n_batches
+    by_name = rep["spans"]["by_name"]
+    assert by_name["serving.batch"]["count"] == lp.n_batches
+    # spans/metrics stay empty when the knob is off (default)
+    telemetry.reset_telemetry()
+    reg2 = ModelRegistry()
+    reg2.register("lin", fitted["linear"])
+    with ServingLoop(reg2) as lp2:
+        lp2.submit("lin", fitted["X"][:4]).result(60)
+    rep_off = telemetry.telemetry_report()
+    assert "serving.requests{model=lin}" not in rep_off["metrics"]["counters"]
+
+
+def test_call_records_request_span(fitted):
+    telemetry.reset_telemetry()
+    reg = ModelRegistry()
+    reg.register("lin", fitted["linear"])
+    with config.config_context(telemetry=True):
+        with ServingLoop(reg) as lp:
+            out = lp.call("lin", fitted["X"][:9])
+    assert np.array_equal(out, fitted["linear"].predict(fitted["X"][:9]))
+    names = [s["name"] for s in telemetry.spans()]
+    assert "serving.request" in names
+
+
+# ---------------------------------------------------------------------------
+# ParallelPostFit thin client
+# ---------------------------------------------------------------------------
+
+
+def test_parallel_post_fit_serving_mode(fitted):
+    from dask_ml_tpu.wrappers import ParallelPostFit
+
+    reg = ModelRegistry()
+    with ServingLoop(reg, max_batch_rows=128) as lp:
+        clf = ParallelPostFit(estimator=fitted["logistic"], serving=lp)
+        X = fitted["X"]
+        for n in (1, 31, 100):
+            assert np.array_equal(clf.predict(X[:n]),
+                                  fitted["logistic"].predict(X[:n]))
+            assert np.array_equal(clf.predict_proba(X[:n]),
+                                  fitted["logistic"].predict_proba(X[:n]))
+        # registered idempotently, by identity
+        assert len(reg.names()) == 1
+        # above the per-request cap: chunked + gathered, still identical
+        big = _data(300, 8, seed=3)
+        assert np.array_equal(clf.predict(big),
+                              fitted["logistic"].predict(big))
+        assert lp.n_completed >= 3 * 2 + 3  # 300 rows -> 3 chunks of 128
+
+
+def test_parallel_post_fit_serving_fallback_methods(fitted):
+    """Methods the loop does not serve fall back to the direct path: the
+    KMeans family serves only ``predict``, so ``transform`` through a
+    serving-mode wrapper runs direct (and still matches)."""
+    from dask_ml_tpu.wrappers import ParallelPostFit
+
+    reg = ModelRegistry()
+    with ServingLoop(reg) as lp:
+        clf = ParallelPostFit(estimator=fitted["kmeans"], serving=lp)
+        X = fitted["X"][:20]
+        np.testing.assert_array_equal(
+            np.asarray(clf.transform(X)),
+            np.asarray(fitted["kmeans"].transform(X)))
+        with pytest.raises(AttributeError):
+            ParallelPostFit(estimator=fitted["pca"],
+                            serving=lp).predict(X)
+
+
+def test_parallel_post_fit_refit_invalidates(fitted):
+    """fit() drops the serving registration BEFORE refitting so a stale
+    model is never served; the next predict re-registers the new state."""
+    from dask_ml_tpu.linear_model import LinearRegression
+    from dask_ml_tpu.wrappers import ParallelPostFit
+
+    rng = np.random.RandomState(5)
+    X = rng.randn(256, 4).astype(np.float32)
+    y1 = X @ rng.randn(4).astype(np.float32)
+    y2 = -3.0 * (X @ rng.randn(4).astype(np.float32))
+    est = LinearRegression(max_iter=20)
+    reg = ModelRegistry()
+    with ServingLoop(reg) as lp:
+        clf = ParallelPostFit(estimator=est, serving=lp)
+        clf.fit(X, y1)
+        out1 = clf.predict(X[:50])
+        assert np.array_equal(out1, est.predict(X[:50]))
+        clf.fit(X, y2)  # invalidates the registration
+        out2 = clf.predict(X[:50])
+        assert np.array_equal(out2, est.predict(X[:50]))
+        assert not np.array_equal(out1, out2)
+
+
+def test_parallel_post_fit_sparse_falls_back(fitted):
+    import scipy.sparse as sp
+    from sklearn.naive_bayes import BernoulliNB
+
+    from dask_ml_tpu.wrappers import ParallelPostFit
+
+    rng = np.random.RandomState(7)
+    Xs = sp.csr_matrix((rng.rand(100, 8) > 0.7).astype(np.float32))
+    y = (rng.rand(100) > 0.5).astype(np.int32)
+    nb = BernoulliNB().fit(Xs, y)
+    reg = ModelRegistry()
+    with ServingLoop(reg) as lp:
+        clf = ParallelPostFit(estimator=nb, serving=lp)
+        assert np.array_equal(clf.predict(Xs), nb.predict(Xs))
+        assert reg.names() == []  # sparse input never touched the loop
+
+
+# ---------------------------------------------------------------------------
+# serving-tuned policy shapes
+# ---------------------------------------------------------------------------
+
+
+def test_custom_policy_honored(fitted):
+    pol = PadPolicy(waste_cap=1.0, min_rows=8)
+    reg = ModelRegistry()
+    reg.register("lin", fitted["linear"])
+    with ServingLoop(reg, policy=pol, max_batch_rows=64) as lp:
+        lp.warmup()
+        with track_compiles() as t:
+            assert np.array_equal(
+                lp.submit("lin", fitted["X"][:5]).result(60),
+                fitted["linear"].predict(fitted["X"][:5]))
+        assert t["n_compiles"] == 0, t
+
+
+def test_set_config_enables_telemetry_mid_flight(fitted):
+    """A loop started with telemetry off follows the GLOBAL knob: flipping
+    set_config(telemetry=True) on a long-running loop takes effect without
+    a restart (the dispatch thread installs no thread-local override)."""
+    telemetry.reset_telemetry()
+    reg = ModelRegistry()
+    reg.register("lin", fitted["linear"])
+    with ServingLoop(reg) as lp:
+        lp.submit("lin", fitted["X"][:4]).result(60)  # knob off: silent
+        config.set_config(telemetry=True)
+        try:
+            lp.submit("lin", fitted["X"][:4]).result(60)
+            counters = telemetry.metrics().snapshot()["counters"]
+        finally:
+            config.set_config(telemetry=False)
+    assert counters.get("serving.requests{model=lin}") == 1
+
+
+def test_cancel_before_dispatch_does_not_kill_loop(fitted):
+    """A future its caller cancels while still queued is dropped at
+    dispatch time; the batch's other requests — and the dispatch thread —
+    are unaffected (a cancel racing set_result must never raise
+    InvalidStateError in the dispatcher)."""
+    blocker = _BlockingModel()
+    reg = ModelRegistry()
+    reg.register("blocker", blocker)
+    reg.register("lin", fitted["linear"])
+    with ServingLoop(reg) as lp:
+        head = lp.submit("blocker", fitted["X"][:4])
+        assert blocker.entered.wait(30)
+        doomed = lp.submit("lin", fitted["X"][:5])
+        kept = lp.submit("lin", fitted["X"][5:12])
+        assert doomed.cancel()
+        blocker.release.set()
+        head.result(60)
+        assert np.array_equal(kept.result(60),
+                              fitted["linear"].predict(fitted["X"][5:12]))
+        # loop still alive and serving
+        ok = lp.submit("lin", fitted["X"][:3])
+        assert np.array_equal(ok.result(60),
+                              fitted["linear"].predict(fitted["X"][:3]))
+        assert doomed.cancelled()
+
+
+def test_host_fallback_preserves_dtype_and_nan(fitted):
+    """Host-fallback models see requests exactly as given: float64 stays
+    float64 (no staging downcast) and NaN passes through to a NaN-native
+    estimator — direct-path parity. Mixed-dtype traffic coalesces per
+    dtype, so concatenation never promotes a request's rows."""
+
+    class Echo:
+        def predict(self, X):
+            assert X.dtype in (np.float32, np.float64), X.dtype
+            return np.nansum(X, axis=1)
+
+    echo = Echo()
+    reg = ModelRegistry()
+    reg.register("echo", echo)
+    blocker = _BlockingModel()
+    reg.register("blocker", blocker)
+    X64 = np.asarray(fitted["X"][:8], np.float64)
+    X64[2, 1] = np.nan
+    X32 = fitted["X"][8:13]
+    with ServingLoop(reg) as lp:
+        head = lp.submit("blocker", fitted["X"][:4])
+        assert blocker.entered.wait(30)
+        f64 = lp.submit("echo", X64)
+        f32 = lp.submit("echo", X32)
+        blocker.release.set()
+        head.result(60)
+        out64 = f64.result(60)
+        assert out64.dtype == np.float64
+        assert np.array_equal(out64, echo.predict(X64))
+        assert np.array_equal(f32.result(60), echo.predict(X32))
+
+
+def test_named_registration_conflict_raises(fitted):
+    """serving_model is an explicit user configuration: a name collision
+    raises instead of silently downgrading to the direct path (an
+    UNNAMED unsupported estimator logs + falls back instead)."""
+    from dask_ml_tpu.wrappers import ParallelPostFit
+
+    reg = ModelRegistry()
+    reg.register("taken", fitted["kmeans"])
+    with ServingLoop(reg) as lp:
+        clf = ParallelPostFit(estimator=fitted["logistic"], serving=lp,
+                              serving_model="taken")
+        with pytest.raises(ValueError, match="already registered"):
+            clf.predict(fitted["X"][:4])
+
+
+def test_mid_fit_reregistration_dropped(fitted):
+    """A predict racing a refit may re-register stale state mid-fit; the
+    wrapper invalidates again AFTER fit so the next request stages the
+    final coefficients (pinned single-threaded via a fit hook)."""
+    from dask_ml_tpu.linear_model import LinearRegression
+    from dask_ml_tpu.wrappers import ParallelPostFit
+
+    hook = {"fn": None}
+
+    class HookedLR(LinearRegression):
+        def fit(self, X, y=None, **kw):
+            if hook["fn"] is not None:
+                hook["fn"]()  # the "racing predict", before coef updates
+            return super().fit(X, y, **kw)
+
+    rng = np.random.RandomState(11)
+    X = rng.randn(256, 4).astype(np.float32)
+    y1 = X @ rng.randn(4).astype(np.float32)
+    y2 = -2.0 * (X @ rng.randn(4).astype(np.float32))
+    est = HookedLR(max_iter=20)
+    reg = ModelRegistry()
+    with ServingLoop(reg) as lp:
+        clf = ParallelPostFit(estimator=est, serving=lp)
+        clf.fit(X, y1)
+        clf.predict(X[:10])
+        hook["fn"] = lambda: clf.predict(X[:10])  # re-registers old coef
+        clf.fit(X, y2)
+        hook["fn"] = None
+        assert np.array_equal(clf.predict(X[:50]), est.predict(X[:50]))

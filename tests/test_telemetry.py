@@ -452,3 +452,54 @@ def test_log_array_bf16_itemsize_fallback(caplog):
         log_array(logger, "Xbf16", FakeArr())
     [rec] = caplog.records
     assert "32 B" in rec.getMessage()  # 16 items x 2 bytes, not 64 B
+
+
+def test_histogram_percentiles_pin_numpy():
+    """Histogram.percentiles == np.percentile over the recorded samples
+    (numpy's default linear interpolation) while the observation count
+    stays under the retention cap — the p50/p99 the serving bench reads
+    off telemetry_report() are real percentiles, not bucket guesses."""
+    rng = np.random.RandomState(0)
+    samples = rng.lognormal(mean=-5.0, sigma=1.2, size=1000)
+    with config.config_context(telemetry=True):
+        h = telemetry.metrics().histogram("pin.latency")
+        for v in samples:
+            h.observe(float(v))
+        got = h.percentiles((50, 90, 99))
+        for q in (50, 90, 99):
+            np.testing.assert_allclose(
+                got[f"p{q}"], np.percentile(samples, q), rtol=1e-12)
+        # surfaced in the report + rendered text
+        rep = telemetry.telemetry_report()
+        hist = rep["metrics"]["histograms"]["pin.latency"]
+        assert hist["p50"] == got["p50"] and hist["p99"] == got["p99"]
+        assert hist["n_samples_retained"] == len(samples)
+        text = telemetry.render_report()
+        assert "p50=" in text and "p99=" in text
+
+
+def test_histogram_percentiles_window_slides_at_cap():
+    """Past HISTOGRAM_SAMPLE_CAP observations the percentile window holds
+    the most recent cap-many samples (recent-traffic view); count keeps
+    the true total."""
+    cap = telemetry.HISTOGRAM_SAMPLE_CAP
+    with config.config_context(telemetry=True):
+        h = telemetry.metrics().histogram("pin.window")
+        for v in range(cap + 100):
+            h.observe(float(v))
+        assert h.count == cap + 100
+        assert len(h.samples) == cap
+        # window = [100, cap+100): its min is 100, pinned via p0
+        assert h.percentiles((0, 100)) == {"p0": 100.0,
+                                           "p100": float(cap + 99)}
+        np.testing.assert_allclose(
+            h.percentiles((50,))["p50"],
+            np.percentile(np.arange(100, cap + 100, dtype=float), 50))
+
+
+def test_histogram_percentiles_empty_and_single():
+    with config.config_context(telemetry=True):
+        h = telemetry.metrics().histogram("pin.empty")
+        assert h.percentiles() == {"p50": None, "p90": None, "p99": None}
+        h.observe(3.25)
+        assert h.percentiles((50, 99)) == {"p50": 3.25, "p99": 3.25}
