@@ -1957,6 +1957,319 @@ def bench_bounds(_rtt):
 
 
 # ---------------------------------------------------------------------------
+# two-level mesh scale-out drill (ISSUE 10): flat vs (pod, chip) on the
+# 8-device CPU mesh — trajectory pins per solver family, the cross-pod
+# ("DCN-modeled") logical-byte reduction gate, the compile-once gate, and
+# the telemetry-mirror exactness pin. Committed as MULTICHIP_r06.json.
+# ---------------------------------------------------------------------------
+
+
+def _multichip_child():
+    """Re-exec target: the drill needs >= 8 devices; when the parent
+    process has fewer (the TPU deployment has 1 local chip), the drill
+    runs in a subprocess on a forced 8-device CPU mesh — same pattern as
+    __graft_entry__.dryrun_multichip."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    kept = [f for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f]
+    env["XLA_FLAGS"] = " ".join(
+        kept + ["--xla_force_host_platform_device_count=8"])
+    env["JAX_PLATFORMS"] = "cpu"
+    env["_DASK_ML_TPU_MULTICHIP_CHILD"] = "1"
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--multichip"],
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)))
+    # the child emitted the records and its own summary; exit with its
+    # status so the parent never appends an empty duplicate summary
+    raise SystemExit(proc.returncode)
+
+
+def _multichip_dryrun_smoke() -> dict:
+    """The driver's entry-point smoke (the r05 record), upgraded per the
+    satellite: besides {rc, ok, tail} it now records n_devices, the mesh
+    shapes exercised, per-axis collective bytes/calls (parsed from the
+    dryrun's LEDGER line), and wall time — so MULTICHIP trajectory files
+    stay comparable across PRs even when only the dryrun runs."""
+    import subprocess
+    import sys
+
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms', 'cpu'); "
+         "import __graft_entry__ as g; g.dryrun_multichip(8); "
+         "print('dryrun_multichip subprocess: ok')"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8"
+                           ).strip(),
+             "_DASK_ML_TPU_DRYRUN_CHILD": "1"},
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        capture_output=True, text=True, timeout=900)
+    wall = time.perf_counter() - t0
+    out = (proc.stdout or "") + (proc.stderr or "")
+    ledger_lines = [ln for ln in out.splitlines()
+                    if ln.startswith("LEDGER ")]
+    per_axis = json.loads(ledger_lines[-1][len("LEDGER "):]) \
+        if ledger_lines else None
+    return {
+        "n_devices": 8,
+        "mesh_shapes": {"flat": [8], "hierarchical": [2, 4]},
+        "rc": proc.returncode,
+        "ok": proc.returncode == 0,
+        "wall_seconds": round(wall, 2),
+        "per_axis_collectives": per_axis,
+        "tail": out[-600:],
+    }
+
+
+def bench_multichip(_rtt):
+    """Hierarchical scale-out drill (docs/scale-out.md):
+
+    1. **Dryrun smoke** — the entry-point SPMD check, now recording mesh
+       shape + per-axis collective bytes/calls + wall time (satellite).
+    2. **Trajectory pins** — every hpsum solver family (Lloyd fused +
+       bounded, k-means|| init, binary ADMM (z, x, u), tsqr Q/R) run flat
+       vs ``(4, 2)`` vs ``(2, 4)`` vs the degenerate ``(1, 8)`` on the
+       same 8 devices: degenerate must be BIT-identical to flat (tsqr,
+       whose hierarchical lowering restructures even at n_pods=1, is
+       pinned close instead), real splits pinned Neumaier-close at
+       rtol 2e-5 (re-association of <= 8 f32 partials; see
+       tests/test_hierarchy.py for the argument).
+    3. **Traffic gate** — per-trace ledger: flat ``data``-axis combining
+       bytes (all DCN-exposed under topology-oblivious routing) over the
+       hierarchical ``pod``-axis bytes must be >= chips_per_pod for the
+       M-step and z-consensus reductions — the analytic factor
+       (N-1)/(n_pods-1).
+    4. **Compile gate** — a repeat fit under the active hierarchical mesh
+       adds ZERO compiles (and zero ledger growth — recording is
+       per-trace).
+    5. **Telemetry mirror** — ``collective.bytes{axis=}`` /
+       ``collective.calls{axis=,op=}`` counters exactly equal the ledger.
+
+    Committed as MULTICHIP_r06.json; nonzero exit on any gate failure.
+    """
+    import jax
+
+    if len(jax.devices()) < 8 and not os.environ.get(
+            "_DASK_ML_TPU_MULTICHIP_CHILD"):
+        _multichip_child()
+        return
+
+    import jax.numpy as jnp
+
+    from dask_ml_tpu import config as config_lib
+    from dask_ml_tpu.models import glm as glm_core
+    from dask_ml_tpu.models import kmeans as km
+    from dask_ml_tpu.ops import linalg
+    from dask_ml_tpu.parallel import hierarchy as hier
+    from dask_ml_tpu.parallel import mesh as mesh_lib
+    from dask_ml_tpu.parallel import telemetry
+    from dask_ml_tpu.parallel.shapes import track_compiles
+    from dask_ml_tpu.parallel.sharding import prepare_data
+
+    f32 = jnp.float32
+    n = int(os.environ.get("MULTICHIP_N", 65536))
+    d, k = 24, 8
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    c0 = jnp.asarray(X[:k])
+    tol0 = jnp.asarray(0.0, f32)
+    lloyd_iters, admm_iters = 8, 4
+
+    # the drill's meshes all use the SAME first 8 devices (a >8-device
+    # host would otherwise fail the fixed-shape hierarchical reshapes)
+    devs = jax.devices()[:8]
+    meshes = {
+        "flat": mesh_lib.make_mesh(devices=devs),
+        "hier42": hier.make_hierarchical_mesh(4, 2, devices=devs),
+        "hier24": hier.make_hierarchical_mesh(2, 4, devices=devs),
+        "hier18": hier.make_hierarchical_mesh(1, 8, devices=devs),
+    }
+
+    def run_families(mesh):
+        hier.reset_ledger()
+        t0 = time.perf_counter()
+        with mesh_lib.use_mesh(mesh):
+            data = prepare_data(X, y=y)
+            lf = km.lloyd_loop_fused(data.X, data.weights, c0, tol0,
+                                     mesh=mesh, max_iter=lloyd_iters)
+            lb = km.lloyd_loop_bounded(data.X, data.weights, c0, tol0,
+                                       mesh=mesh, max_iter=lloyd_iters)
+            ci = km.init_scalable(data.X, data.weights, data.n, k,
+                                  jax.random.key(0), mesh=mesh)
+            z, _, st, _ = glm_core.admm(
+                data.X, data.y, data.weights, jnp.zeros((d,), f32),
+                jnp.ones((d,), f32), mesh, family="logistic", lamduh=0.5,
+                max_iter=admm_iters, abstol=0.0, reltol=0.0,
+                return_state=True)
+            Q, R = linalg.tsqr(data.X, mesh=mesh, weights=data.weights)
+            outs = {
+                "lloyd_centers": np.asarray(lf[0]),
+                "lloyd_inertia": float(lf[1]),
+                "lloyd_niter": int(lf[2]),
+                "bounded_centers": np.asarray(lb[0]),
+                "bounded_labels": np.asarray(lb[4]),
+                "init_centers": np.asarray(ci),
+                "admm_z": np.asarray(z),
+                "admm_x": np.asarray(st[1]),
+                "admm_u": np.asarray(st[2]),
+                "tsqr_Q": np.asarray(Q),
+                "tsqr_R": np.asarray(R),
+            }
+        wall = time.perf_counter() - t0
+        return outs, hier.ledger_snapshot(), wall
+
+    outs, snaps, walls = {}, {}, {}
+    for name, m in meshes.items():
+        outs[name], snaps[name], walls[name] = run_families(m)
+
+    gates, deltas = {}, {}
+
+    # -- 2. trajectory pins ------------------------------------------------
+    bit_keys = ["lloyd_centers", "bounded_centers", "bounded_labels",
+                "init_centers", "admm_z", "admm_x", "admm_u"]
+    gates["degenerate_bit_identical"] = all(
+        np.array_equal(outs["flat"][kk], outs["hier18"][kk])
+        for kk in bit_keys) and (
+            outs["flat"]["lloyd_niter"] == outs["hier18"]["lloyd_niter"])
+
+    def close(a, b, rtol=2e-5, atol=1e-5):
+        return bool(np.allclose(a, b, rtol=rtol, atol=atol))
+
+    # tsqr's hierarchical path changes the LOWERING even at n_pods=1
+    # (explicit shard_map Gram instead of GSPMD), so the degenerate case
+    # is pinned close rather than bit-identical — as a drill gate, not
+    # just a test (tests/test_hierarchy.py carries the argument)
+    gates["degenerate_tsqr_close"] = (
+        close(outs["flat"]["tsqr_Q"], outs["hier18"]["tsqr_Q"])
+        and close(outs["flat"]["tsqr_R"], outs["hier18"]["tsqr_R"],
+                  atol=1e-4))
+
+    for mode in ("hier42", "hier24"):
+        ok = outs["flat"]["lloyd_niter"] == outs[mode]["lloyd_niter"]
+        ok &= np.array_equal(outs["flat"]["bounded_labels"],
+                             outs[mode]["bounded_labels"])
+        dd = {}
+        for kk in ("lloyd_centers", "bounded_centers", "init_centers",
+                   "admm_z", "admm_x", "admm_u", "tsqr_Q", "tsqr_R"):
+            delta = float(np.max(np.abs(
+                np.asarray(outs["flat"][kk], np.float64)
+                - np.asarray(outs[mode][kk], np.float64))))
+            dd[kk] = delta
+            ok &= close(outs["flat"][kk], outs[mode][kk],
+                        atol=1e-4 if kk == "tsqr_R" else 1e-5)
+        ok &= close(outs["flat"]["lloyd_inertia"],
+                    outs[mode]["lloyd_inertia"], atol=1e-2)
+        deltas[mode] = dd
+        gates[f"trajectories_pinned_{mode}"] = bool(ok)
+
+    # -- 3. cross-pod ("DCN-modeled") byte-reduction gate ------------------
+    traffic = {}
+    for mode, cpp in (("hier42", 2), ("hier24", 4)):
+        rec = {}
+        for op in ("kmeans.mstep", "glm.admm.consensus"):
+            flat_b = snaps["flat"]["ops"][op]["data"]
+            pod_b = snaps[mode]["ops"][op]["pod"]
+            rec[op] = {
+                "flat_dcn_modeled_bytes": flat_b,
+                "hier_pod_bytes": pod_b,
+                "reduction_factor": round(flat_b / max(pod_b, 1), 3),
+                "required_factor": cpp,
+            }
+            gates[f"dcn_bytes_{op}_{mode}"] = flat_b >= cpp * pod_b
+        traffic[mode] = rec
+
+    # -- 4. compile-once + zero ledger growth under the hier mesh ----------
+    m = meshes["hier42"]
+    with mesh_lib.use_mesh(m):
+        data = prepare_data(X, y=y)
+        hier.reset_ledger()
+        with track_compiles() as tc:
+            km.lloyd_loop_fused(data.X, data.weights, c0, tol0, mesh=m,
+                                max_iter=lloyd_iters)
+            glm_core.admm(data.X, data.y, data.weights,
+                          jnp.zeros((d,), f32), jnp.ones((d,), f32), m,
+                          family="logistic", lamduh=0.5,
+                          max_iter=admm_iters, abstol=0.0, reltol=0.0)
+    gates["zero_steady_state_compiles"] = int(tc["n_compiles"]) == 0
+    gates["zero_steady_state_ledger_growth"] = (
+        hier.ledger_snapshot()["bytes"] == {})
+
+    # -- 5. telemetry mirror exactness -------------------------------------
+    hier.reset_ledger()
+    telemetry.reset_telemetry()
+    n2 = n + 8  # fresh shape => fresh trace under the warm caches
+    X2 = rng.randn(n2, d).astype(np.float32)
+    with config_lib.config_context(telemetry=True):
+        with mesh_lib.use_mesh(meshes["hier24"]):
+            d2 = prepare_data(X2)
+            km.lloyd_loop_fused(d2.X, d2.weights, c0, tol0,
+                                mesh=meshes["hier24"],
+                                max_iter=lloyd_iters)
+    snap = hier.ledger_snapshot()
+    counters = telemetry.metrics().snapshot()["counters"]
+    mirror_ok = bool(snap["bytes"]) and all(
+        counters.get(f"collective.bytes{{axis={ax}}}") == b
+        for ax, b in snap["bytes"].items()) and all(
+        counters.get("collective.calls{axis=%s,op=%s}"
+                     % tuple(key.split("/", 1))) == c
+        for key, c in snap["calls"].items())
+    gates["telemetry_mirror_exact"] = mirror_ok
+
+    dryrun = _multichip_dryrun_smoke()
+    gates["dryrun_ok"] = bool(dryrun["ok"])
+
+    rec = {
+        "metric": "multichip_hierarchical",
+        "value": traffic["hier24"]["glm.admm.consensus"][
+            "reduction_factor"],
+        "unit": "flat-DCN-modeled / hierarchical cross-pod logical bytes "
+                "(z-consensus, (2,4) mesh)",
+        "vs_baseline": None,
+        "backend": jax.default_backend(),
+        "n_devices": 8,
+        "rows": n, "cols": d, "n_clusters": k,
+        "lloyd_iters": lloyd_iters, "admm_iters": admm_iters,
+        "all_gates_pass": all(gates.values()),
+        "gates": gates,
+        "mesh_shapes": {name: list(m.shape.values())
+                        for name, m in meshes.items()},
+        "wall_seconds": {name: round(w, 3) for name, w in walls.items()},
+        "per_axis_bytes": {name: s["bytes"]
+                           for name, s in snaps.items()},
+        "per_axis_calls": {name: s["calls"]
+                           for name, s in snaps.items()},
+        "per_op_bytes": {name: s["ops"] for name, s in snaps.items()},
+        "dcn_reduction": traffic,
+        "max_abs_trajectory_delta": deltas,
+        "dryrun": dryrun,
+        "note": "ledger records logical combining bytes per TRACE of each "
+                "collective site ((s-1)*B per reduction group per axis; "
+                "docs/scale-out.md); flat bytes are DCN-exposed under "
+                "topology-oblivious routing, so reduction_factor = "
+                "(N-1)/(n_pods-1) >= chips_per_pod analytically and the "
+                "measured ledger must reproduce it exactly. Trajectory "
+                "pins: degenerate (1,8) bit-identical to flat; real pod "
+                "splits Neumaier-close (rtol 2e-5) per "
+                "tests/test_hierarchy.py's re-association argument.",
+    }
+    emit(rec)
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "MULTICHIP_r06.json")
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=1)
+    if not all(gates.values()):
+        raise SystemExit(
+            "multichip hierarchical drill: failed gates: "
+            + ", ".join(g for g, v in gates.items() if not v))
+
+
+# ---------------------------------------------------------------------------
 # unified-telemetry drill (ISSUE 7): spans + metrics + Perfetto export over
 # a streamed ADMM fit and a bucketed K-fold search, with the three
 # acceptance gates — the numbers committed as TELEMETRY_r01.json and
@@ -2530,6 +2843,16 @@ def bench_kdd(_rtt):
             k_: round(float(v), 2)
             for k_, v in init_phases["effective_gbps"].items()},
         "init_fused_dispatch": init_phases["fused"],
+        # per-mesh-axis collective accounting — present only under a
+        # hierarchical (pod, chip) mesh (docs/scale-out.md); stable keys
+        # next to the per-device streaming roofline above
+        **({"init_phase_bytes_by_axis":
+                init_phases["bytes_moved_by_axis"],
+            "init_phase_effective_gbps_by_axis": {
+                p: {ax: round(float(v), 4) for ax, v in axes.items()}
+                for p, axes in
+                init_phases["effective_gbps_by_axis"].items()}}
+           if "bytes_moved_by_axis" in init_phases else {}),
         "init_round_skip_ratio": round(
             float(init_phases["round_skip_ratio"]), 4),
         "lloyd_seconds": round(float(phases.get("lloyd", 0.0)), 2),
@@ -2724,6 +3047,15 @@ if __name__ == "__main__":
         # SERVING_r01.json)
         _enable_compilation_cache()
         bench_serving(measure_rtt())
+        emit_summary()
+    elif "--multichip" in sys.argv:
+        # two-level mesh scale-out drill (ISSUE 10); CI's multichip job
+        # runs this on the 8-device CPU mesh: flat-vs-hierarchical
+        # trajectory pins, the cross-pod logical-byte reduction gate
+        # (>= chips_per_pod x), compile-once + telemetry-mirror gates,
+        # nonzero exit on any failure (committed as MULTICHIP_r06.json)
+        _enable_compilation_cache()
+        bench_multichip(measure_rtt())
         emit_summary()
     elif "--telemetry" in sys.argv:
         # unified-telemetry drill (ISSUE 7); CI's telemetry job runs this:

@@ -45,7 +45,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from dask_ml_tpu.parallel import precision as px
-from dask_ml_tpu.parallel.mesh import DATA_AXIS, shard_map
+from dask_ml_tpu.parallel.hierarchy import hpsum
+from dask_ml_tpu.parallel.mesh import data_pspec, n_data_shards, shard_map
 
 # ---------------------------------------------------------------------------
 # Families: pointwise loss ℓ(eta, y) and curvature h(eta, y) = ∂²ℓ/∂eta²
@@ -503,20 +504,21 @@ def _admm_impl(X, y, w, beta0, x0, u0, mask, lamduh, rho, abstol, reltol,
     the same stacked layout and ``done`` the Boyd-stopping convergence flag."""
     loss_fn, hess_fn = FAMILIES[family]
     _, pen_prox = _penalty(regularizer)
-    n_shards = mesh.shape[DATA_AXIS]
+    n_shards = n_data_shards(mesh)
     d = X.shape[1]
+    d2, d1 = data_pspec(mesh, ndim=2), data_pspec(mesh, ndim=1)
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
-                  P(), P(DATA_AXIS, None), P(DATA_AXIS, None),
+        in_specs=(d2, d1, d1,
+                  P(), d2, d2,
                   P(), P(), P(), P(), P(), P()),
-        out_specs=(P(), P(), P(DATA_AXIS, None), P(DATA_AXIS, None), P()),
+        out_specs=(P(), P(), d2, d2, P()),
     )
     def run(X_loc, y_loc, w_loc, z0, x0_loc, u0_loc, mask_, lamduh, rho,
             abstol, reltol, inner_tol):
-        sw = jnp.maximum(lax.psum(jnp.sum(w_loc), DATA_AXIS), 1.0)
+        sw = jnp.maximum(hpsum(jnp.sum(w_loc), mesh, op="glm.admm.sw"), 1.0)
         lam_eff = lamduh / sw
 
         # Pointwise dℓ/deta via jax.grad of the summed loss (elementwise, so
@@ -562,15 +564,18 @@ def _admm_impl(X, y, w, beta0, x0, u0, mask, lamduh, rho, abstol, reltol,
         def body(state):
             z, x, u, it, _ = state
             x = local_newton(x, z, u)
-            zbar = lax.psum(x + u, DATA_AXIS) / n_shards
+            # the z-consensus: the per-iteration (d,)-vector reduction the
+            # hierarchical lowering folds within-pod before crossing DCN
+            zbar = hpsum(x + u, mesh, op="glm.admm.consensus") / n_shards
             t = lam_eff / (rho * n_shards)
             z_new = jnp.where(mask_ > 0, pen_prox(zbar, t), zbar)
             u = u + x - z_new
             # Boyd stopping: primal/dual residuals vs abs+rel tolerances.
-            pri2 = lax.psum(jnp.sum((x - z_new) ** 2), DATA_AXIS)
+            pri2 = hpsum(jnp.sum((x - z_new) ** 2), mesh,
+                         op="glm.admm.residuals")
             dual = rho * jnp.sqrt(float(n_shards)) * jnp.linalg.norm(z_new - z)
-            xnorm2 = lax.psum(jnp.sum(x * x), DATA_AXIS)
-            unorm2 = lax.psum(jnp.sum(u * u), DATA_AXIS)
+            xnorm2 = hpsum(jnp.sum(x * x), mesh, op="glm.admm.residuals")
+            unorm2 = hpsum(jnp.sum(u * u), mesh, op="glm.admm.residuals")
             eps_pri = (jnp.sqrt(float(n_shards * d)) * abstol
                        + reltol * jnp.maximum(jnp.sqrt(xnorm2),
                                               jnp.sqrt(float(n_shards))
@@ -617,11 +622,18 @@ def admm(X, y, w, beta0, mask, mesh, *, family="logistic", regularizer="l2",
     ``done`` the loop's own convergence flag (ADVICE r3).
     Unlike the L-BFGS carry, ADMM state is bound to the data-axis shard
     count (each shard owns its consensus subproblem): resuming on a mesh
-    with a different number of shards is rejected.
+    with a different number of shards is rejected. On a hierarchical
+    ``('pod', 'chip')`` mesh (parallel/hierarchy.py) the z-consensus and
+    stopping residuals lower as reduce-within-pod (ICI) then across pods
+    (DCN) with per-axis traffic metered in the ledger; shard count and
+    pod-major shard order match the flat mesh over the same devices, so
+    state round-trips between the two layouts (and across
+    checkpoint/resume on either — tests/test_multihost.py pins the
+    2-process hierarchical case).
     """
     dt = _state_dtype(X)  # consensus state stays >= f32 even for bf16 data
     d = X.shape[1]
-    n_shards = mesh.shape[DATA_AXIS]
+    n_shards = n_data_shards(mesh)
     if state is None:
         z0 = beta0.astype(dt)
         x0 = jnp.broadcast_to(beta0, (n_shards, d)).astype(dt)
@@ -662,23 +674,24 @@ def _admm_multinomial_impl(X, y_idx, w, z0, x0, u0, mask, lamduh, rho,
     Hessian — dense and positive definite, built as one einsum over the
     shard's rows (H = Σᵢ wᵢ · xᵢxᵢᵀ ⊗ (diag(pᵢ) − pᵢpᵢᵀ) / SW + ρI)."""
     _, pen_prox = _penalty(regularizer)
-    n_shards = mesh.shape[DATA_AXIS]
+    n_shards = n_data_shards(mesh)
     d = X.shape[1]
     K = n_classes
     dK = d * K
+    d2, d1 = data_pspec(mesh, ndim=2), data_pspec(mesh, ndim=1)
+    d3 = data_pspec(mesh, ndim=3)
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
-                  P(), P(DATA_AXIS, None, None), P(DATA_AXIS, None, None),
+        in_specs=(d2, d1, d1,
+                  P(), d3, d3,
                   P(), P(), P(), P(), P(), P()),
-        out_specs=(P(), P(), P(DATA_AXIS, None, None),
-                   P(DATA_AXIS, None, None), P()),
+        out_specs=(P(), P(), d3, d3, P()),
     )
     def run(X_loc, y_loc, w_loc, z0, x0_loc, u0_loc, mask_, lamduh, rho,
             abstol, reltol, inner_tol):
-        sw = jnp.maximum(lax.psum(jnp.sum(w_loc), DATA_AXIS), 1.0)
+        sw = jnp.maximum(hpsum(jnp.sum(w_loc), mesh, op="glm.admm.sw"), 1.0)
         lam_eff = lamduh / sw
         Yoh = jax.nn.one_hot(y_loc.astype(jnp.int32), K, dtype=z0.dtype)
 
@@ -725,15 +738,16 @@ def _admm_multinomial_impl(X, y_idx, w, z0, x0, u0, mask, lamduh, rho,
         def body(state):
             z, x, u, it, _ = state
             x = local_newton(x, z, u)
-            zbar = lax.psum(x + u, DATA_AXIS) / n_shards
+            zbar = hpsum(x + u, mesh, op="glm.admm.consensus") / n_shards
             t = lam_eff / (rho * n_shards)
             z_new = jnp.where(mask_[:, None] > 0, pen_prox(zbar, t), zbar)
             u = u + x - z_new
-            pri2 = lax.psum(jnp.sum((x - z_new) ** 2), DATA_AXIS)
+            pri2 = hpsum(jnp.sum((x - z_new) ** 2), mesh,
+                         op="glm.admm.residuals")
             dual = (rho * jnp.sqrt(float(n_shards))
                     * jnp.linalg.norm((z_new - z).ravel()))
-            xnorm2 = lax.psum(jnp.sum(x * x), DATA_AXIS)
-            unorm2 = lax.psum(jnp.sum(u * u), DATA_AXIS)
+            xnorm2 = hpsum(jnp.sum(x * x), mesh, op="glm.admm.residuals")
+            unorm2 = hpsum(jnp.sum(u * u), mesh, op="glm.admm.residuals")
             eps_pri = (jnp.sqrt(float(n_shards * dK)) * abstol
                        + reltol * jnp.maximum(
                            jnp.sqrt(xnorm2),
@@ -769,7 +783,7 @@ def admm_multinomial(X, y_idx, w, B0, mask, mesh, *, n_classes,
     dt = _state_dtype(X)
     d = X.shape[1]
     K = int(n_classes)
-    n_shards = mesh.shape[DATA_AXIS]
+    n_shards = n_data_shards(mesh)
     if state is None:
         z0 = B0.astype(dt)
         x0 = jnp.broadcast_to(B0, (n_shards, d, K)).astype(dt)
@@ -1092,6 +1106,13 @@ def _admm_streamed_host(source, z0, x0, u0, mask, lamduh, rho, abstol,
                 start_block=start_block if first else 0,
                 outs=outs0 if first else None)
             x = jnp.stack(xs)
+            # the single-host streamed consensus reduces the whole block
+            # stack locally: a ZERO-byte entry on the cross-host ("pod")
+            # axis — the zero-collective path the ledger pins must show
+            # as exactly 0 (the elastic driver's counterpart records the
+            # real cross-host import bytes; parallel/elastic.py)
+            from dask_ml_tpu.parallel.hierarchy import ledger
+            ledger().record("glm.admm.consensus", "pod", 0)
             with telemetry.span("glm.admm.consensus", epoch=it):
                 z, u, done = _host_consensus(
                     z, x, u, mask, lamduh, rho, abstol, reltol, sw_total,

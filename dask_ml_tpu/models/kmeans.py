@@ -325,10 +325,19 @@ def lloyd_loop_fused(X, w, centers0, tol, *, mesh, max_iter: int,
     small d (6.8–7.8× there) and for bf16 with d ≥ 128 (1.5–2×); XLA
     everywhere else, including the flagship small-k f32 shape where its
     two-pass roofline wins.
+
+    On a hierarchical ``('pod', 'chip')`` mesh the per-iteration M-step
+    reduction lowers as reduce-within-pod (ICI) then across pods (DCN)
+    through :func:`~dask_ml_tpu.parallel.hierarchy.hpsum` — only one
+    already-reduced (k·d + k + 1)-float partial per pod crosses the DCN
+    per iteration, with per-axis bytes metered in the traffic ledger
+    (docs/scale-out.md). On a flat mesh the same call IS today's single
+    psum over ``"data"`` — bit-identical program.
     """
     from jax.sharding import PartitionSpec as P
 
-    from dask_ml_tpu.parallel.mesh import DATA_AXIS, shard_map
+    from dask_ml_tpu.parallel.hierarchy import hpsum
+    from dask_ml_tpu.parallel.mesh import data_pspec, shard_map
 
     k, d = centers0.shape
     if kernel not in ("auto", "pallas", "xla"):
@@ -339,10 +348,12 @@ def lloyd_loop_fused(X, w, centers0, tol, *, mesh, max_iter: int,
     use_pallas = kernel == "pallas" or (
         kernel == "auto" and _pallas_auto_wins(k, d, X.dtype))
 
+    dspec2, dspec1 = data_pspec(mesh, ndim=2), data_pspec(mesh, ndim=1)
+
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(), P()),
+        in_specs=(dspec2, dspec1, P(), P()),
         out_specs=(P(), P(), P(), P()),
         # vma typing can't see through a pallas_call (and interpret mode
         # trips on kernel-internal constants), so the pallas path runs
@@ -387,9 +398,9 @@ def lloyd_loop_fused(X, w, centers0, tol, *, mesh, max_iter: int,
 
         def one_iter(centers):
             sums, counts, inertia = local_stats(centers)
-            sums = jax.lax.psum(sums, DATA_AXIS)
-            counts = jax.lax.psum(counts, DATA_AXIS)
-            inertia = jax.lax.psum(inertia, DATA_AXIS)
+            sums = hpsum(sums, mesh, op="kmeans.mstep")
+            counts = hpsum(counts, mesh, op="kmeans.mstep")
+            inertia = hpsum(inertia, mesh, op="kmeans.mstep")
             new_centers = _new_centers(sums, counts, centers)
             shift = jnp.sum((new_centers - centers) ** 2)
             return new_centers, inertia, shift
@@ -646,16 +657,18 @@ def lloyd_loop_bounded(X, w, centers0, tol, *, max_iter: int, mesh=None,
     # ---- sharded path: the lloyd_loop_fused counterpart -----------------
     from jax.sharding import PartitionSpec as P
 
-    from dask_ml_tpu.parallel.mesh import DATA_AXIS, shard_map
+    from dask_ml_tpu.parallel.hierarchy import hpsum
+    from dask_ml_tpu.parallel.mesh import data_pspec, shard_map
 
     bdt = jnp.dtype(bounds_dtype)
     kidx = jnp.arange(k, dtype=jnp.int32)[:, None]
+    dspec2, dspec1 = data_pspec(mesh, ndim=2), data_pspec(mesh, ndim=1)
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(), P()),
-        out_specs=(P(), P(), P(), P(), P(DATA_AXIS), P()),
+        in_specs=(dspec2, dspec1, P(), P()),
+        out_specs=(P(), P(), P(), P(), dspec1, P()),
         # the row-skipping eval runs lax.cond/pallas inside — vma typing
         # can't see through either (same rule as the fused family's own
         # shard_map wrappers)
@@ -680,8 +693,11 @@ def lloyd_loop_bounded(X, w, centers0, tol, *, max_iter: int, mesh=None,
                 oh_w, XT.astype(jnp.float32), (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)  # (k, d)
             counts = oh_w.sum(axis=1)
-            sums = jax.lax.psum(sums, DATA_AXIS)
-            counts = jax.lax.psum(counts, DATA_AXIS)
+            # the bounded carry's movement norms (_bounded_move) derive
+            # from these reduced centers, so the M-step psum is the one
+            # collective the whole bound machinery rides on
+            sums = hpsum(sums, mesh, op="kmeans.mstep")
+            counts = hpsum(counts, mesh, op="kmeans.mstep")
             return _new_centers(sums, counts, centers)
 
         def cond(state):
@@ -707,9 +723,9 @@ def lloyd_loop_bounded(X, w, centers0, tol, *, max_iter: int, mesh=None,
             _bounded_init_state(c0, X_pad.shape[0], G, max_iter, bdt))
         centers, _, _, _, n_iter, shift, skip_h, held_h = state
         labels_f, mind_f = fused_argmin_min(X_loc, centers, kernel=kernel)
-        inertia = jax.lax.psum(jnp.sum(mind_f * w_loc), DATA_AXIS)
-        stats = {"rows_skipped": jax.lax.psum(skip_h, DATA_AXIS),
-                 "bounds_held": jax.lax.psum(held_h, DATA_AXIS)}
+        inertia = hpsum(jnp.sum(mind_f * w_loc), mesh, op="kmeans.inertia")
+        stats = {"rows_skipped": hpsum(skip_h, mesh, op="kmeans.stats"),
+                 "bounds_held": hpsum(held_h, mesh, op="kmeans.stats")}
         return centers, inertia, n_iter, shift, labels_f, stats
 
     return run(X, w, centers0.astype(jnp.float32),
@@ -1401,6 +1417,43 @@ def _init_phase_traffic(n: int, d: int, itemsize: int, *, n_rounds: int,
     return dict(seed=seed, rounds=rounds, weights=weights, finish=finish)
 
 
+def _init_phase_collective_traffic(mesh, d: int, *, n_rounds: int, cap: int,
+                                   max_cand: int) -> dict:
+    """Per-MESH-AXIS logical collective bytes per init phase — the
+    cross-device companion of :func:`_init_phase_traffic`'s (per-device
+    HBM-streaming) accounting, for the hierarchical scale-out report
+    (docs/scale-out.md). Uses the ledger's combining model
+    (:func:`~dask_ml_tpu.parallel.hierarchy.collective_bytes`: (s−1)·B per
+    reduction group per axis; gathers modeled with the same rule on their
+    payload). Dominant terms per phase:
+
+    - ``seed``: the φ₀ scalar reduction.
+    - ``rounds``: per executed round, the φ scalar + draw-count
+      reductions and the ≤``cap``-row candidate gather into the
+      replicated buffer (payload cap·d·4 — candidate rows are f32).
+    - ``weights``: the (max_cand,) candidate-weight psum (the one-hot
+      contraction's cross-shard combine).
+    - ``finish``: replicated candidate-buffer compute — zero collective
+      bytes (the zero-collective path, reported as exact 0s).
+    """
+    from dask_ml_tpu.parallel.hierarchy import collective_bytes
+
+    def cb(nbytes):
+        return collective_bytes(mesh, int(nbytes))
+
+    def add(a, b):
+        return {k: a.get(k, 0) + b.get(k, 0) for k in set(a) | set(b)}
+
+    zero = {k: 0 for k in cb(0)}
+    r = max(int(n_rounds), 0)
+    per_round = add(add(cb(4), cb(4)), cb(cap * d * 4))
+    rounds = zero
+    for _ in range(r):
+        rounds = add(rounds, per_round)
+    return dict(seed=cb(4), rounds=rounds,
+                weights=cb(max_cand * 4), finish=zero)
+
+
 def measure_init_phases(X, w, n_clusters: int, key,
                         oversampling_factor: float = 2.0,
                         max_iter: Optional[int] = None,
@@ -1496,7 +1549,7 @@ def measure_init_phases(X, w, n_clusters: int, key,
     if telemetry.enabled():
         telemetry.metrics().gauge(
             "kmeans.init.round_skip_ratio").set(skip_ratio)
-    return {
+    report = {
         "seconds": phases,
         "bytes_moved": traffic,
         "effective_gbps": {
@@ -1507,6 +1560,23 @@ def measure_init_phases(X, w, n_clusters: int, key,
         # whose distance work the reverse-triangle bound skipped
         "round_skip_ratio": skip_ratio,
     }
+    # hierarchical scale-out companion (docs/scale-out.md): per-mesh-axis
+    # collective bytes + effective GB/s per phase, under stable keys next
+    # to the PR-2 per-device streaming accounting above. Only reported
+    # when the ACTIVE mesh is hierarchical — on a flat mesh the ledger
+    # taxonomy has one axis and the keys would duplicate nothing useful.
+    from dask_ml_tpu.parallel.mesh import is_hierarchical
+
+    if mesh is not None and is_hierarchical(mesh):
+        by_axis = _init_phase_collective_traffic(
+            mesh, d, n_rounds=int(jax.device_get(n_rounds)), cap=cap,
+            max_cand=max_cand)
+        report["bytes_moved_by_axis"] = by_axis
+        report["effective_gbps_by_axis"] = {
+            p: {ax: b / max(phases[p], 1e-9) / 1e9
+                for ax, b in by_axis[p].items()}
+            for p in phases}
+    return report
 
 
 def init_scalable(

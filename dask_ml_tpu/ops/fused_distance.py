@@ -140,6 +140,19 @@ def _use_pallas(kernel, n, m, d, dtype, mesh):
         kernel == "auto" and _fused_auto_wins(n, m, d, dtype, mesh))
 
 
+def _row_specs(mesh):
+    """The family's shard_map specs for ``mesh`` —
+    ``(P(axes, None), P(axes), P(None, axes))`` for (n, d) / (n,) /
+    (1, n) row-sharded operands, where ``axes`` is ``'data'`` on a flat
+    mesh and ``('pod', 'chip')`` on a hierarchical one
+    (parallel/hierarchy.py): the wrappers below are mesh-level-agnostic."""
+    from dask_ml_tpu.parallel.mesh import data_axes
+
+    axes = data_axes(mesh)
+    a = axes[0] if len(axes) == 1 else axes
+    return P(a, None), P(a), P(None, a)
+
+
 def _row_sumsq(X):
     """Per-row Σx² as a ones-matmul, f32-accumulated — the SAME op (and
     accumulation order) the kernel uses in VMEM, so reference and fused
@@ -566,12 +579,13 @@ def fused_rowwise_min(X, Y, mask=None, *, kernel: str = "auto", mesh=None,
         maskf = _maskf(mask, m)
         if mesh is None:
             return _fused_pallas(X, Y, maskf, None, "min")
-        from dask_ml_tpu.parallel.mesh import DATA_AXIS, shard_map
+        from dask_ml_tpu.parallel.mesh import shard_map
 
+        d2, d1, _ = _row_specs(mesh)
         fn = shard_map(
             lambda Xl, Yl, ml: _fused_pallas(Xl, Yl, ml, None, "min"),
-            mesh=mesh, in_specs=(P(DATA_AXIS, None), P(), P()),
-            out_specs=P(DATA_AXIS), check_vma=False)
+            mesh=mesh, in_specs=(d2, P(), P()),
+            out_specs=d1, check_vma=False)
         return fn(X, Y, maskf)
     maskf = _maskf(mask, m)
     if not use_pallas:
@@ -580,24 +594,26 @@ def fused_rowwise_min(X, Y, mask=None, *, kernel: str = "auto", mesh=None,
         # the blocked lax.map must run PER SHARD (a global block any()
         # would all-reduce per block under GSPMD) — same shard_map shape
         # as the pallas path
-        from dask_ml_tpu.parallel.mesh import DATA_AXIS, shard_map
+        from dask_ml_tpu.parallel.mesh import shard_map
 
+        d2, d1, _ = _row_specs(mesh)
         fn = shard_map(
             lambda Xl, nl: _blocked_xla(Xl, Y, mask, nl, "min"),
-            mesh=mesh, in_specs=(P(DATA_AXIS, None), P(DATA_AXIS)),
-            out_specs=P(DATA_AXIS), check_vma=False)
+            mesh=mesh, in_specs=(d2, d1),
+            out_specs=d1, check_vma=False)
         return fn(X, row_need)
     need2d = row_need.astype(jnp.float32)[None, :]
     if mesh is None:
         return _fused_pallas(X, Y, maskf, None, "min", need2d=need2d)
-    from dask_ml_tpu.parallel.mesh import DATA_AXIS, shard_map
+    from dask_ml_tpu.parallel.mesh import shard_map
 
+    d2, d1, d1m = _row_specs(mesh)
     fn = shard_map(
         lambda Xl, Yl, ml, nl: _fused_pallas(Xl, Yl, ml, None, "min",
                                              need2d=nl),
         mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(), P(), P(None, DATA_AXIS)),
-        out_specs=P(DATA_AXIS), check_vma=False)
+        in_specs=(d2, P(), P(), d1m),
+        out_specs=d1, check_vma=False)
     return fn(X, Y, maskf, need2d)
 
 
@@ -611,12 +627,13 @@ def fused_argmin_min(X, Y, mask=None, *, kernel: str = "auto", mesh=None):
     maskf = _maskf(mask, m)
     if mesh is None:
         return _fused_pallas(X, Y, maskf, None, "argmin_min")
-    from dask_ml_tpu.parallel.mesh import DATA_AXIS, shard_map
+    from dask_ml_tpu.parallel.mesh import shard_map
 
+    d2, d1, _ = _row_specs(mesh)
     fn = shard_map(
         lambda Xl, Yl, ml: _fused_pallas(Xl, Yl, ml, None, "argmin_min"),
-        mesh=mesh, in_specs=(P(DATA_AXIS, None), P(), P()),
-        out_specs=(P(DATA_AXIS), P(DATA_AXIS)), check_vma=False)
+        mesh=mesh, in_specs=(d2, P(), P()),
+        out_specs=(d1, d1), check_vma=False)
     return fn(X, Y, maskf)
 
 
@@ -646,24 +663,26 @@ def fused_argmin_min2(X, Y, mask=None, *, kernel: str = "auto", mesh=None,
         maskf = _maskf(mask, m)
         if mesh is None:
             return _fused_pallas(X, Y, maskf, None, "argmin_min2")
-        from dask_ml_tpu.parallel.mesh import DATA_AXIS, shard_map
+        from dask_ml_tpu.parallel.mesh import shard_map
 
+        d2, d1, _ = _row_specs(mesh)
         fn = shard_map(
             lambda Xl, Yl, ml: _fused_pallas(Xl, Yl, ml, None,
                                              "argmin_min2"),
-            mesh=mesh, in_specs=(P(DATA_AXIS, None), P(), P()),
-            out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+            mesh=mesh, in_specs=(d2, P(), P()),
+            out_specs=(d1, d1, d1),
             check_vma=False)
         return fn(X, Y, maskf)
     if not use_pallas:
         if mesh is None:
             return _blocked_xla(X, Y, mask, row_need, "argmin_min2")
-        from dask_ml_tpu.parallel.mesh import DATA_AXIS, shard_map
+        from dask_ml_tpu.parallel.mesh import shard_map
 
+        d2, d1, _ = _row_specs(mesh)
         fn = shard_map(
             lambda Xl, nl: _blocked_xla(Xl, Y, mask, nl, "argmin_min2"),
-            mesh=mesh, in_specs=(P(DATA_AXIS, None), P(DATA_AXIS)),
-            out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+            mesh=mesh, in_specs=(d2, d1),
+            out_specs=(d1, d1, d1),
             check_vma=False)
         return fn(X, row_need)
     maskf = _maskf(mask, m)
@@ -671,14 +690,15 @@ def fused_argmin_min2(X, Y, mask=None, *, kernel: str = "auto", mesh=None,
     if mesh is None:
         return _fused_pallas(X, Y, maskf, None, "argmin_min2",
                              need2d=need2d)
-    from dask_ml_tpu.parallel.mesh import DATA_AXIS, shard_map
+    from dask_ml_tpu.parallel.mesh import shard_map
 
+    d2, d1, d1m = _row_specs(mesh)
     fn = shard_map(
         lambda Xl, Yl, ml, nl: _fused_pallas(Xl, Yl, ml, None,
                                              "argmin_min2", need2d=nl),
         mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(), P(), P(None, DATA_AXIS)),
-        out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        in_specs=(d2, P(), P(), d1m),
+        out_specs=(d1, d1, d1),
         check_vma=False)
     return fn(X, Y, maskf, need2d)
 
@@ -689,22 +709,65 @@ def fused_argmin_weight(X, w, Y, mask=None, *, kernel: str = "auto",
     count ``cw[j] = Σ_i w_i · [argmin_i == j]`` (f32, shape (m,)) — the
     k-means|| candidate-weighting / M-step-count contraction, fused so
     neither the (n × m) distance matrix nor the (n × m) one-hot ever
-    reaches HBM. Masked rows always get ``cw == 0``."""
+    reaches HBM. Masked rows always get ``cw == 0``.
+
+    The ``cw`` accumulation is the family's one cross-shard reduction; on
+    a hierarchical mesh it lowers chip-then-pod through
+    :func:`~dask_ml_tpu.parallel.hierarchy.hpsum` (ledger op
+    ``fused.argmin_weight``) — on the XLA path too, which wraps in
+    ``shard_map`` there (a flat mesh keeps today's plain GSPMD
+    expression, bit-identical)."""
+    from dask_ml_tpu.parallel.mesh import is_hierarchical, shard_map
+
     m, d = Y.shape
     if not _use_pallas(kernel, X.shape[0], m, d, X.dtype, mesh):
-        return _argmin_weight_ref(X, w, Y, mask)
+        if mesh is None or not is_hierarchical(mesh):
+            if mesh is not None:
+                # the flat XLA lowering's (m,) cw reduction is
+                # GSPMD-implicit; record it so flat-vs-hierarchical
+                # per-op accounting covers the same reduction regardless
+                # of which kernel auto-selection wins (the same rule as
+                # _tsqr_impl's flat Gram branch)
+                from dask_ml_tpu.parallel.hierarchy import \
+                    record_collective
+                record_collective("fused.argmin_weight", mesh, (m,),
+                                  jnp.float32)
+            return _argmin_weight_ref(X, w, Y, mask)
+        from dask_ml_tpu.parallel.hierarchy import hpsum
+
+        d2, d1, _ = _row_specs(mesh)
+
+        def local_xla(Xl, wl):
+            s = _scores_ref(Xl, Y, mask)
+            idx = jnp.argmin(s, axis=1).astype(jnp.int32)
+            onehot = (jnp.arange(Y.shape[0], dtype=jnp.int32)[None, :]
+                      == idx[:, None])
+            cw = jax.lax.dot_general(
+                wl.astype(jnp.float32), onehot.astype(jnp.float32),
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)  # (m,) local partial
+            cw = hpsum(cw, mesh, op="fused.argmin_weight")
+            if mask is not None:
+                cw = jnp.where(mask, cw, 0.0)
+            return idx, cw
+
+        fn = shard_map(local_xla, mesh=mesh, in_specs=(d2, d1),
+                       out_specs=(d1, P()), check_vma=False)
+        return fn(X, w)
     maskf = _maskf(mask, m)
     w2d = w.astype(jnp.float32)[None, :]
     if mesh is None:
         return _fused_pallas(X, Y, maskf, w2d, "argmin_weight")
-    from dask_ml_tpu.parallel.mesh import DATA_AXIS, shard_map
+    from dask_ml_tpu.parallel.hierarchy import hpsum
+
+    d2, d1, d1m = _row_specs(mesh)
 
     def local(Xl, wl, Yl, ml):
         am, cw = _fused_pallas(Xl, Yl, ml, wl, "argmin_weight")
-        return am, jax.lax.psum(cw, DATA_AXIS)
+        return am, hpsum(cw, mesh, op="fused.argmin_weight")
 
     fn = shard_map(
         local, mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(None, DATA_AXIS), P(), P()),
-        out_specs=(P(DATA_AXIS), P()), check_vma=False)
+        in_specs=(d2, d1m, P(), P()),
+        out_specs=(d1, P()), check_vma=False)
     return fn(X, w2d, Y, maskf)

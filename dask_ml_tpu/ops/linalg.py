@@ -38,32 +38,79 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from dask_ml_tpu.parallel import hierarchy as hier
 from dask_ml_tpu.parallel import mesh as mesh_lib
 from dask_ml_tpu.parallel import precision as px
-from dask_ml_tpu.parallel.mesh import DATA_AXIS
+from dask_ml_tpu.parallel.mesh import CHIP_AXIS, DATA_AXIS, POD_AXIS
 
 
-def _gather_replicated(x, n_shards):
-    """All-gather that produces a *replication-typed* (invariant) result:
-    scatter into a zero buffer + psum. all_gather's output is typed varying
-    under shard_map's vma checks, which would block P() out_specs; psum's
-    output is invariant by construction. The blocks here are tiny R factors,
-    so the extra zeros on the wire are noise."""
-    idx = lax.axis_index(DATA_AXIS)
-    buf = jnp.zeros((n_shards,) + x.shape, x.dtype)
+def _gather_axis(x, axis_name, n, mesh=None):
+    """All-gather over ONE named mesh axis that produces a
+    *replication-typed* (invariant-over-that-axis) result: scatter into a
+    zero buffer + psum. all_gather's output is typed varying under
+    shard_map's vma checks, which would block P() out_specs; psum's output
+    is invariant by construction. The blocks here are tiny R factors, so
+    the extra zeros on the wire are noise. ``mesh`` (when given) records
+    the gather's logical bytes into the per-axis traffic ledger
+    (parallel/hierarchy.py) — the tsqr tree's stacking traffic."""
+    if mesh is not None:
+        hier.record_axis_collective("tsqr.gather", mesh, axis_name,
+                                    int(np.prod(x.shape)) * x.dtype.itemsize)
+    idx = lax.axis_index(axis_name)
+    buf = jnp.zeros((n,) + x.shape, x.dtype)
     buf = lax.dynamic_update_slice_in_dim(buf, x[None], idx, axis=0)
-    buf = lax.psum(buf, DATA_AXIS)
-    return buf.reshape((n_shards * x.shape[0],) + x.shape[1:])
+    buf = lax.psum(buf, axis_name)
+    return buf.reshape((n * x.shape[0],) + x.shape[1:])
 
 
 @partial(jax.jit, static_argnames=("mesh",))
 def _tsqr_householder_impl(X, *, mesh):
     """Per-shard Householder QR + gathered small QR — the numerically
     bulletproof (but MXU-unfriendly: sequential panel factorizations) path.
-    Kept as the fallback branch of :func:`_tsqr_impl`'s condition guard."""
+    Kept as the fallback branch of :func:`_tsqr_impl`'s condition guard.
+
+    On a hierarchical ``('pod', 'chip')`` mesh the reduction tree gets a
+    REAL middle level (the Benson/Gleich/Demmel tree the flat path
+    collapses): local QR → within-pod gather + stacked QR over the ICI →
+    cross-pod gather + stacked QR over the DCN, so only one pod-level
+    ``(k, d)`` factor per pod crosses the DCN instead of every shard's —
+    the communication-avoiding structure, with both gather stages metered
+    per axis in the traffic ledger. Q back-propagates through both small
+    Q slices (``Q = Q1 @ (Q2_i @ Q3_p)``)."""
+    if mesh_lib.is_hierarchical(mesh):
+        n_pods = mesh.shape[POD_AXIS]
+        cpp = mesh.shape[CHIP_AXIS]
+
+        @partial(
+            mesh_lib.shard_map,
+            mesh=mesh,
+            in_specs=mesh_lib.data_pspec(mesh),
+            out_specs=(mesh_lib.data_pspec(mesh), P()),
+        )
+        def run_hier(X_loc):
+            n_loc, d = X_loc.shape
+            k1 = min(n_loc, d)
+            Q1, R1 = jnp.linalg.qr(X_loc, mode="reduced")
+            # level 1: stack the pod's chip factors over the ICI
+            Rs_pod = _gather_axis(R1, CHIP_AXIS, cpp, mesh=mesh)
+            Q2, R2 = jnp.linalg.qr(Rs_pod, mode="reduced")  # (cpp·k1, k2)
+            k2 = min(cpp * k1, d)
+            ci = lax.axis_index(CHIP_AXIS)
+            Q2_i = lax.dynamic_slice_in_dim(Q2, ci * k1, k1, axis=0)
+            # level 2: one reduced (k2, d) factor per pod crosses the DCN
+            Rs_all = _gather_axis(R2, POD_AXIS, n_pods, mesh=mesh)
+            Q3, R = jnp.linalg.qr(Rs_all, mode="reduced")  # (pods·k2, k3)
+            pi = lax.axis_index(POD_AXIS)
+            Q3_p = lax.dynamic_slice_in_dim(Q3, pi * k2, k2, axis=0)
+            Q = Q1 @ (Q2_i @ Q3_p)  # (n_loc, k3)
+            return Q, R
+
+        return run_hier(X)
+
     n_shards = mesh.shape[DATA_AXIS]
 
     @partial(
@@ -76,7 +123,7 @@ def _tsqr_householder_impl(X, *, mesh):
         n_loc, d = X_loc.shape
         k1 = min(n_loc, d)
         Q1, R1 = jnp.linalg.qr(X_loc, mode="reduced")  # (n_loc,k1),(k1,d)
-        Rs = _gather_replicated(R1, n_shards)  # (P·k1, d) replicated
+        Rs = _gather_axis(R1, DATA_AXIS, n_shards, mesh=mesh)  # replicated
         Q2, R = jnp.linalg.qr(Rs, mode="reduced")  # (P·k1,k2),(k2,d)
         idx = lax.axis_index(DATA_AXIS)
         Q2_i = lax.dynamic_slice_in_dim(Q2, idx * k1, k1, axis=0)
@@ -90,6 +137,46 @@ def _tsqr_householder_impl(X, *, mesh):
 #: f32 inputs land ~1e-6; the error grows ~cond(X)²·eps, so exceeding this
 #: means the Gram squaring lost real information and Householder must run.
 _CHOLQR_ORTHO_TOL = 1e-3
+
+
+@partial(jax.jit, static_argnames=("mesh",))
+def _cholqr2_hier_impl(X, *, mesh):
+    """CholeskyQR2 with EXPLICIT two-stage Gram reductions for a
+    hierarchical mesh — the "within-pod stacking before the cross-pod
+    combine" structure of the communication-avoiding tree applied to the
+    fast path: each round's (d, d) Gram partials fold over the ICI first
+    and only one per pod crosses the DCN
+    (:func:`~dask_ml_tpu.parallel.hierarchy.hpsum`, ledger op
+    ``tsqr.gram``). Same arithmetic as :func:`_cholesky_qr2` (ridge,
+    floor, two rounds); returns ``(Q, R, err)`` with the orthogonality
+    error computed in-program (one more metered Gram, ledger op
+    ``tsqr.guard``)."""
+    @partial(
+        mesh_lib.shard_map,
+        mesh=mesh,
+        in_specs=mesh_lib.data_pspec(mesh),
+        out_specs=(mesh_lib.data_pspec(mesh), P(), P()),
+    )
+    def run(X_loc):
+        d = X_loc.shape[1]
+
+        def one(Yc):
+            G = hier.hpsum(Yc.T @ Yc, mesh, op="tsqr.gram")
+            ridge = (1e-6 * jnp.trace(G) / d
+                     + jnp.finfo(G.dtype).tiny * 1e6)
+            G = G + ridge * jnp.eye(d, dtype=G.dtype)
+            L = jnp.linalg.cholesky(G)
+            Qc = jax.lax.linalg.triangular_solve(
+                L, Yc, left_side=False, lower=True, transpose_a=True)
+            return Qc, L.T
+
+        Q1, R1 = one(X_loc)
+        Q2, R2 = one(Q1)
+        QtQ = hier.hpsum(Q2.T @ Q2, mesh, op="tsqr.guard")
+        err = jnp.max(jnp.abs(QtQ - jnp.eye(d, dtype=QtQ.dtype)))
+        return Q2, R2 @ R1, err
+
+    return run(X)
 
 
 @partial(jax.jit, static_argnames=("mesh",))
@@ -112,8 +199,14 @@ def _tsqr_impl(X, *, mesh):
 
     Falls back statically to Householder when per-shard rows < d (the fast
     path's (n, d) output shape needs full column rank per the Gram).
+
+    On a hierarchical ``('pod', 'chip')`` mesh both branches restructure
+    as reduce-within-pod-then-across-DCN: the fast path's Gram rounds go
+    through :func:`_cholqr2_hier_impl`, the fallback through the
+    three-level tree in :func:`_tsqr_householder_impl` — per-axis traffic
+    metered in the ledger either way. The flat-mesh program is untouched.
     """
-    n_shards = mesh.shape[DATA_AXIS]
+    n_shards = mesh_lib.n_data_shards(mesh)
     n, d = X.shape
     # the exact factorization stays ≥ f32 (docs/precision.md): a bf16 Gram
     # would square bf16's 8-bit mantissa loss into the factor, and the
@@ -126,9 +219,18 @@ def _tsqr_impl(X, *, mesh):
         # short shards: Householder handles the k1 = n_loc < d shapes
         return _tsqr_householder_impl(X, mesh=mesh)
 
-    Qf, Rf = _cholesky_qr2(X)
-    err = jnp.max(jnp.abs(
-        Qf.T @ Qf - jnp.eye(d, dtype=Qf.dtype)))  # psum over sharded axis
+    if mesh_lib.is_hierarchical(mesh):
+        Qf, Rf, err = _cholqr2_hier_impl(X, mesh=mesh)
+    else:
+        Qf, Rf = _cholesky_qr2(X)
+        # the flat fast path's Gram reductions are GSPMD-implicit (plain
+        # sharded matmuls); record their combining bytes here so the
+        # ledger's flat-vs-hierarchical comparison covers the same ops
+        # (two CholeskyQR2 rounds + the guard below, one (d, d) each)
+        for op in ("tsqr.gram", "tsqr.gram", "tsqr.guard"):
+            hier.record_collective(op, mesh, (d, d), X.dtype)
+        err = jnp.max(jnp.abs(
+            Qf.T @ Qf - jnp.eye(d, dtype=Qf.dtype)))  # psum over shards
     return lax.cond(
         err < _CHOLQR_ORTHO_TOL,
         lambda X: (Qf, Rf),

@@ -9,17 +9,32 @@ happens through XLA collectives instead of task-graph reductions.
 """
 
 from dask_ml_tpu.parallel.mesh import (  # noqa: F401
+    CHIP_AXIS,
     DATA_AXIS,
     MODEL_AXIS,
+    POD_AXIS,
+    data_axes,
+    data_pspec,
     data_sharding,
     default_mesh,
     feature_sharding,
+    is_hierarchical,
     make_2d_mesh,
     make_mesh,
     n_data_shards,
     n_model_shards,
     replicated_sharding,
     use_mesh,
+)
+from dask_ml_tpu.parallel.hierarchy import (  # noqa: F401
+    TrafficLedger,
+    hpmean,
+    hpsum,
+    hpsum_scatter,
+    ledger,
+    ledger_snapshot,
+    make_hierarchical_mesh,
+    reset_ledger,
 )
 from dask_ml_tpu.parallel.sharding import (  # noqa: F401
     DeviceData,

@@ -645,6 +645,20 @@ def elastic_admm_host(run: ElasticRun, source, z0, x0, u0, mask, lamduh,
             x = jnp.asarray(
                 np.stack([np.asarray(results[b])
                           for b in range(n_blocks)]))
+            # per-axis traffic accounting (parallel/hierarchy.py): the
+            # elastic z-consensus imports every OTHER host's published
+            # x-blocks over the cross-host (DCN-analog) link — the fleet
+            # is the pod level of the two-level hierarchy, so the bytes
+            # land under axis "pod" like the sharded solver's cross-pod
+            # stage. Recorded per epoch (the driver is a host loop, so
+            # call-site accounting here IS per-execution).
+            n_foreign = sum(1 for b in range(n_blocks)
+                            if owner.get(b) != run.rank)
+            from dask_ml_tpu.parallel.hierarchy import ledger
+            ledger().record(
+                "glm.admm.consensus", "pod",
+                n_foreign * int(np.asarray(results[0]).nbytes)
+                if n_blocks else 0)
             with telemetry.span("elastic.consensus", epoch=it):
                 z, u, done = glm_core._host_consensus(
                     z, x, u, mask, lamduh, rho, abstol, reltol, sw_total,

@@ -1,0 +1,284 @@
+"""Two-level (pod, chip) mesh scale-out: hierarchical, communication-avoiding
+collectives with per-axis traffic accounting.
+
+Every solver in this package reduces over a sharded sample axis. On a flat
+``("data",)`` mesh that reduction is one ``psum`` whose traffic XLA routes
+however the topology allows — on a real multi-pod machine that means every
+partial may cross the slow inter-pod DCN, even though partials from chips in
+the same pod could have been folded over the fast intra-pod ICI first. The
+communication-avoiding restructure (the kernel k-means playbook of
+arxiv 2601.17136, with arxiv 1605.02989's partition-then-combine framing):
+
+1. build the mesh as a two-level ``("pod", "chip")`` grid
+   (:func:`make_hierarchical_mesh`); the sample axis shards over BOTH axes,
+   pod-major, so device order matches the flat mesh over the same devices;
+2. lower every hot reduction as reduce-over-``chip`` (ICI) **then**
+   reduce-over-``pod`` (DCN) — the :func:`hpsum` / :func:`hpmean` /
+   :func:`hpsum_scatter` family. Only ONE already-reduced partial per pod
+   crosses the DCN, shrinking cross-pod combining bytes by exactly
+   ``chips_per_pod``x versus the topology-oblivious flat worst case.
+
+On a flat mesh the family degrades to today's single ``psum`` over
+``"data"`` — same expression, same program, bit-identical. The degenerate
+hierarchical mesh ``n_pods=1`` runs the two-stage lowering with a size-1
+pod stage (an identity), so it is bit-identical to the flat mesh on the
+same devices as well.
+
+**Traffic ledger.** Each collective call records its LOGICAL combining
+bytes per mesh axis into a process-wide :class:`TrafficLedger` (and mirrors
+the same increments into the telemetry registry as
+``collective.bytes{axis=}`` / ``collective.calls{axis=,op=}`` when the
+``telemetry`` knob is on). The model, per reduction over an axis of size
+``s`` with an ``B``-byte operand: ``(s - 1) * B`` bytes per independent
+reduction group (a combining tree moves exactly s-1 partial-sized messages;
+the post-reduction broadcast is symmetric on both layouts and is not
+counted). The ``chip`` stage runs one group per pod; the ``pod`` stage one
+group total. A flat ``psum`` records all its combining bytes under
+``"data"`` — the topology-oblivious accounting in which every partial is
+DCN-exposed, which is what the MULTICHIP bench compares the hierarchical
+``"pod"`` bytes against:
+
+    flat  : (N - 1) * B            over axis "data"  (DCN-exposed)
+    hier  : n_pods * (cpp - 1) * B over axis "chip"  (ICI)
+            (n_pods - 1) * B       over axis "pod"   (DCN)
+
+so cross-pod bytes shrink by ``(N - 1) / (n_pods - 1) >= chips_per_pod``.
+
+Recording happens at the Python call site, i.e. once per TRACE of the
+enclosing program — the ledger counts logical bytes per traced execution of
+each collective site. Loops (``lax.while_loop`` bodies) re-execute sites
+without re-recording, and a jit cache hit records nothing: multiply by
+iteration/invocation counts for totals (the bench does). This is exactly
+what makes the accounting deterministic and pinnable, and it composes with
+the compile-once gate: zero new steady-state traces means zero new ledger
+growth.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh
+
+from dask_ml_tpu.parallel.mesh import (
+    CHIP_AXIS,
+    DATA_AXIS,
+    POD_AXIS,
+    data_axes,
+    data_pspec,
+    is_hierarchical,
+    make_mesh,
+    n_data_shards,
+)
+
+__all__ = [
+    "make_hierarchical_mesh",
+    "hpsum",
+    "hpmean",
+    "hpsum_scatter",
+    "TrafficLedger",
+    "ledger",
+    "reset_ledger",
+    "ledger_snapshot",
+    "collective_bytes",
+    "record_collective",
+    "record_axis_collective",
+]
+
+
+def make_hierarchical_mesh(
+    n_pods: int,
+    chips_per_pod: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """An ``(n_pods, chips_per_pod)`` mesh with axes ``('pod', 'chip')``.
+
+    ``chips_per_pod=None`` auto-factors from the device count. Devices fill
+    the grid pod-major (row-major reshape of the device list), so shard
+    ``i`` of a row-sharded array lives on the same device as shard ``i`` of
+    the flat mesh over the same list — which is what lets flat-vs-
+    hierarchical trajectory pins compare like with like, and lets e.g. ADMM
+    consensus state (bound to shard indices) resume across the two layouts.
+    ``n_pods=1`` is the degenerate case: the two-stage collectives' pod
+    stage is a size-1 identity and every program is bit-identical to the
+    flat mesh on the same devices.
+
+    On a real multi-host deployment, build it so the pod axis coincides
+    with the host/pod boundary (processes own contiguous device ranges, so
+    ``n_pods = process_count`` does exactly that — see
+    ``tests/test_multihost.py``).
+    """
+    return make_mesh(devices=devices, shape=(n_pods, chips_per_pod),
+                     axis_names=(POD_AXIS, CHIP_AXIS))
+
+
+# ---------------------------------------------------------------------------
+# per-axis traffic ledger
+# ---------------------------------------------------------------------------
+
+
+class TrafficLedger:
+    """Thread-safe per-(op, axis) logical-byte/call accounting.
+
+    One process-wide instance (:func:`ledger`) backs the collective family;
+    tests may construct private ones. Increments mirror into the telemetry
+    registry at this site (``collective.bytes{axis=}``,
+    ``collective.calls{axis=,op=}``) when the knob is on, so the two
+    surfaces agree structurally, never by reconciliation.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: dict = {}  # (op, axis) -> [bytes, calls]
+
+    def record(self, op: str, axis: str, nbytes: int, calls: int = 1) -> None:
+        nbytes = int(nbytes)
+        with self._lock:
+            e = self._entries.setdefault((str(op), str(axis)), [0, 0])
+            e[0] += nbytes
+            e[1] += int(calls)
+        from dask_ml_tpu.parallel import telemetry
+
+        if telemetry.enabled():
+            reg = telemetry.metrics()
+            reg.counter("collective.bytes", axis=axis).inc(nbytes)
+            reg.counter("collective.calls", axis=axis, op=op).inc(calls)
+
+    def snapshot(self) -> dict:
+        """JSON-round-trippable view::
+
+            {"bytes": {axis: total_bytes},
+             "calls": {"axis/op": n_calls},
+             "ops":   {op: {axis: bytes}}}
+        """
+        with self._lock:
+            items = sorted(self._entries.items())
+        by_axis: dict = {}
+        calls: dict = {}
+        by_op: dict = {}
+        for (op, axis), (b, c) in items:
+            by_axis[axis] = by_axis.get(axis, 0) + b
+            calls[f"{axis}/{op}"] = calls.get(f"{axis}/{op}", 0) + c
+            by_op.setdefault(op, {})
+            by_op[op][axis] = by_op[op].get(axis, 0) + b
+        return {"bytes": by_axis, "calls": calls, "ops": by_op}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+_ledger = TrafficLedger()
+
+
+def ledger() -> TrafficLedger:
+    """The process-wide per-axis collective-traffic ledger."""
+    return _ledger
+
+
+def reset_ledger() -> None:
+    _ledger.reset()
+
+
+def ledger_snapshot() -> dict:
+    return _ledger.snapshot()
+
+
+def _axis_sizes(mesh: Mesh) -> dict:
+    return {a: int(mesh.shape[a]) for a in mesh.axis_names}
+
+
+def collective_bytes(mesh: Mesh, nbytes: int) -> dict:
+    """Analytic per-axis logical combining bytes for ONE full sample-axis
+    reduction of an ``nbytes``-byte operand over ``mesh`` (the module
+    docstring's model): flat meshes charge ``(N-1)*B`` to ``"data"``;
+    hierarchical meshes charge ``n_pods*(cpp-1)*B`` to ``"chip"`` (one
+    combining tree per pod, over ICI) and ``(n_pods-1)*B`` to ``"pod"``
+    (one tree over DCN). Axes of size 1 charge zero — the zero-collective
+    path the ledger pins must show as exactly 0."""
+    nbytes = int(nbytes)
+    if is_hierarchical(mesh):
+        n_pods = int(mesh.shape[POD_AXIS])
+        cpp = int(mesh.shape[CHIP_AXIS])
+        return {CHIP_AXIS: n_pods * (cpp - 1) * nbytes,
+                POD_AXIS: (n_pods - 1) * nbytes}
+    return {DATA_AXIS: (n_data_shards(mesh) - 1) * nbytes}
+
+
+def record_collective(op: str, mesh: Mesh, shape, dtype) -> None:
+    """Record one full sample-axis reduction of a ``(shape, dtype)`` operand
+    at this call site (works on tracers: shapes/dtypes are static)."""
+    nbytes = int(np.prod(shape, dtype=np.int64)) \
+        * int(jax.numpy.dtype(dtype).itemsize)
+    for axis, b in collective_bytes(mesh, nbytes).items():
+        _ledger.record(op, axis, b)
+
+
+def record_axis_collective(op: str, mesh: Mesh, axis: str,
+                           nbytes: int) -> None:
+    """Record a single-axis collective (a within-pod gather, a cross-pod
+    gather) with the same (size-1)*B-per-group model: the ``chip`` axis
+    runs one group per pod, every other axis one group total."""
+    s = int(mesh.shape[axis])
+    groups = int(mesh.shape[POD_AXIS]) if (
+        axis == CHIP_AXIS and is_hierarchical(mesh)) else 1
+    _ledger.record(op, axis, (s - 1) * int(nbytes) * groups)
+
+
+# ---------------------------------------------------------------------------
+# the hierarchical collective family (call INSIDE shard_map bodies)
+# ---------------------------------------------------------------------------
+
+
+def hpsum(x, mesh: Mesh, *, op: str = "psum"):
+    """Hierarchical all-reduce-sum over the mesh's sample axes.
+
+    On a hierarchical mesh: ``psum`` over ``"chip"`` (ICI) then ``"pod"``
+    (DCN) — the explicit two-stage, communication-avoiding lowering. On a
+    flat mesh: exactly today's ``lax.psum(x, "data")`` (same expression,
+    bit-identical). The mesh choice is static (it selects the expression at
+    trace time), so it reaches traced code only through program structure —
+    the compile-once discipline of docs/compile.md.
+
+    ``op`` labels the call site in the traffic ledger (and the telemetry
+    ``collective.calls{axis=,op=}`` mirror), so per-reduction-family bytes
+    stay separable in the MULTICHIP bench. Must be called inside a
+    ``shard_map`` whose mesh binds the named axes."""
+    record_collective(op, mesh, x.shape, x.dtype)
+    if is_hierarchical(mesh):
+        x = lax.psum(x, CHIP_AXIS)
+        return lax.psum(x, POD_AXIS)
+    return lax.psum(x, DATA_AXIS)
+
+
+def hpmean(x, mesh: Mesh, *, op: str = "pmean"):
+    """Hierarchical mean over the sample axes: :func:`hpsum` divided by the
+    (static) total shard count — the z-consensus shape."""
+    return hpsum(x, mesh, op=op) / n_data_shards(mesh)
+
+
+def hpsum_scatter(x, mesh: Mesh, *, op: str = "psum_scatter"):
+    """Hierarchical reduce-scatter: each chip keeps its ``1/chips_per_pod``
+    slice of the full sum (axis 0 tiled over the ``chip`` axis — flat
+    meshes tile over ``"data"``).
+
+    Logically the same combining bytes as :func:`hpsum` (the ledger model
+    charges identically); the difference is the LOWERING — the pod stage
+    reduces distinct per-chip slices instead of ``chips_per_pod`` redundant
+    copies of the full operand, so the wire matches the logical count. Use
+    it when the consumer wants the result sharded anyway (a stacked-factor
+    combine, a sharded epilogue); ``axis 0`` of ``x`` must divide the chip
+    (flat: data) axis size."""
+    record_collective(op, mesh, x.shape, x.dtype)
+    if is_hierarchical(mesh):
+        x = lax.psum_scatter(x, CHIP_AXIS, tiled=True)
+        return lax.psum(x, POD_AXIS)
+    return lax.psum_scatter(x, DATA_AXIS, tiled=True)
+
+
+# re-exported for consumers that already import hierarchy
+__all__ += ["data_axes", "data_pspec", "is_hierarchical", "n_data_shards"]

@@ -26,6 +26,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 
+#: two-level scale-out axes (parallel/hierarchy.py): the sample axis shards
+#: over BOTH — ``pod`` is the slow cross-pod (DCN) dimension, ``chip`` the
+#: fast within-pod (ICI) dimension. Hot reductions lower chip-first so only
+#: one already-reduced partial per pod crosses the DCN (docs/scale-out.md).
+POD_AXIS = "pod"
+CHIP_AXIS = "chip"
+
 
 def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
     """``jax.shard_map`` across jax versions.
@@ -70,10 +77,44 @@ def make_mesh(
     if n_devices is not None:
         devices = devices[:n_devices]
     if shape is None:
-        shape = (len(devices),) if len(axis_names) == 1 else None
-    if shape is None:
-        raise ValueError("shape is required for multi-axis meshes")
-    arr = np.asarray(devices, dtype=object).reshape(tuple(shape))
+        if len(axis_names) != 1:
+            raise ValueError(
+                f"make_mesh needs a shape for the {len(axis_names)}-axis "
+                f"mesh {tuple(axis_names)} over {len(devices)} devices; "
+                "pass shape=... (one entry may be None to auto-factor it "
+                "from the device count)")
+        shape = (len(devices),)
+    shape = list(shape)
+    if len(shape) != len(axis_names):
+        raise ValueError(
+            f"mesh shape {tuple(shape)} has {len(shape)} entries but "
+            f"axis_names {tuple(axis_names)} has {len(axis_names)}")
+    # auto-factor: exactly one unspecified axis size (None or -1) is solved
+    # from the device count, so callers can say e.g. shape=(2, None) —
+    # "2 pods over whatever devices exist"
+    free = [i for i, s in enumerate(shape) if s is None or s == -1]
+    if len(free) > 1:
+        raise ValueError(
+            f"mesh shape {tuple(shape)} leaves more than one axis of "
+            f"{tuple(axis_names)} unspecified; at most one entry may be "
+            "None/-1")
+    if free:
+        known = int(np.prod([int(s) for i, s in enumerate(shape)
+                             if i != free[0]])) if len(shape) > 1 else 1
+        if known <= 0 or len(devices) % known:
+            raise ValueError(
+                f"cannot auto-factor axis {axis_names[free[0]]!r}: "
+                f"{len(devices)} devices do not divide by the specified "
+                f"sizes {tuple(shape)} of axes {tuple(axis_names)}")
+        shape[free[0]] = len(devices) // known
+    shape = tuple(int(s) for s in shape)
+    if int(np.prod(shape)) != len(devices):
+        raise ValueError(
+            f"mesh shape {shape} for axes {tuple(axis_names)} needs "
+            f"{int(np.prod(shape))} devices but {len(devices)} are "
+            "available; pass devices=/n_devices= or adjust the shape "
+            "(one entry may be None to auto-factor)")
+    arr = np.asarray(devices, dtype=object).reshape(shape)
     return Mesh(arr, tuple(axis_names))
 
 
@@ -126,9 +167,39 @@ def make_2d_mesh(
                      axis_names=(DATA_AXIS, MODEL_AXIS))
 
 
+def is_hierarchical(mesh: Optional[Mesh] = None) -> bool:
+    """True for a two-level ``('pod', 'chip')`` mesh
+    (:func:`dask_ml_tpu.parallel.hierarchy.make_hierarchical_mesh`)."""
+    mesh = mesh or default_mesh()
+    return POD_AXIS in mesh.axis_names and CHIP_AXIS in mesh.axis_names
+
+
+def data_axes(mesh: Optional[Mesh] = None) -> tuple:
+    """The mesh axes the SAMPLE axis shards over: ``('pod', 'chip')`` on a
+    hierarchical mesh, ``('data',)`` otherwise. Everything that builds
+    in_specs/shardings for row-sharded arrays routes through this (and
+    :func:`data_pspec`), so solvers are agnostic to the mesh's level count."""
+    mesh = mesh or default_mesh()
+    if is_hierarchical(mesh):
+        return (POD_AXIS, CHIP_AXIS)
+    return (DATA_AXIS,)
+
+
+def data_pspec(mesh: Optional[Mesh] = None, ndim: int = 2) -> PartitionSpec:
+    """The row-sharded PartitionSpec for ``mesh``: ``P('data', None, ...)``
+    flat, ``P(('pod', 'chip'), None, ...)`` hierarchical (axis 0 split over
+    both levels, pod-major — so device order matches the flat mesh built
+    from the same device list, and e.g. ADMM's per-shard stacked state keeps
+    its shard←row correspondence across the two layouts)."""
+    mesh = mesh or default_mesh()
+    axes = data_axes(mesh)
+    first = axes[0] if len(axes) == 1 else axes
+    return PartitionSpec(first, *([None] * (ndim - 1)))
+
+
 def n_data_shards(mesh: Optional[Mesh] = None) -> int:
     mesh = mesh or default_mesh()
-    return mesh.shape[DATA_AXIS]
+    return int(np.prod([mesh.shape[a] for a in data_axes(mesh)]))
 
 
 def n_model_shards(mesh: Optional[Mesh] = None) -> int:
@@ -138,9 +209,10 @@ def n_model_shards(mesh: Optional[Mesh] = None) -> int:
 
 
 def data_sharding(mesh: Optional[Mesh] = None, ndim: int = 2) -> NamedSharding:
-    """Axis-0 ("sample"-axis) sharding: ``P('data', None, ...)``."""
+    """Axis-0 ("sample"-axis) sharding: ``P('data', None, ...)``, or the
+    two-level ``P(('pod', 'chip'), None, ...)`` on a hierarchical mesh."""
     mesh = mesh or default_mesh()
-    return NamedSharding(mesh, PartitionSpec(DATA_AXIS, *([None] * (ndim - 1))))
+    return NamedSharding(mesh, data_pspec(mesh, ndim=ndim))
 
 
 def feature_sharding(mesh: Optional[Mesh] = None, ndim: int = 2) -> NamedSharding:

@@ -85,6 +85,16 @@ SECTIONS = [
      "pre-warmed shape buckets, with results bit-identical to direct "
      "predict calls — see docs/serving.md for bucket tuning, lifecycle, "
      "and the telemetry taxonomy."),
+    ("dask_ml_tpu.parallel.hierarchy", "Two-level mesh scale-out",
+     "The (pod, chip) hierarchical mesh and its communication-avoiding "
+     "collective family: hpsum/hpmean/hpsum_scatter lower every hot "
+     "sample-axis reduction as reduce-within-pod (ICI) then across pods "
+     "(DCN) — bit-identical to the flat mesh in the degenerate n_pods=1 "
+     "case — with per-axis logical combining bytes recorded in the "
+     "traffic ledger and mirrored to telemetry as collective.bytes/"
+     "collective.calls; see docs/scale-out.md for the mesh anatomy, "
+     "which reductions are hierarchical, and how to read the MULTICHIP "
+     "numbers."),
     ("dask_ml_tpu.parallel.elastic", "Elastic data plane",
      "Multi-host sharded ingestion for the streamed tier: the seeded "
      "cross-epoch BlockPlan permutation (coordination is arithmetic — no "
@@ -125,6 +135,11 @@ EXTRA = {
     "dask_ml_tpu.parallel.precision": [
         "PrecisionPolicy", "resolve", "state_dtype", "lloyd_bounds_dtype",
         "pdot", "pmatmul", "neumaier_add", "neumaier_sum", "cast_wire",
+    ],
+    "dask_ml_tpu.parallel.hierarchy": [
+        "make_hierarchical_mesh", "hpsum", "hpmean", "hpsum_scatter",
+        "TrafficLedger", "ledger", "ledger_snapshot", "reset_ledger",
+        "collective_bytes", "record_collective",
     ],
     "dask_ml_tpu.datasets": ["make_blobs", "make_regression",
                              "make_classification", "make_counts"],

@@ -113,6 +113,36 @@ WORKER = textwrap.dedent("""
     print("ADMMK", " ".join(f"{v:.6e}" for v in np.asarray(BR).ravel()),
           flush=True)
 
+    # --- two-level (2, n//2) mesh across the REAL process boundary: pods
+    # are processes (each owns its 2 local devices = its pod's chips), so
+    # the hierarchical ADMM consensus folds within this host's ICI first
+    # and exactly ONE partial per process crosses the inter-process link
+    # (Gloo standing in for the DCN). Checkpoint/resume round-trips the
+    # consensus state through a REAL save_pytree/load_pytree file cycle.
+    from dask_ml_tpu.parallel import hierarchy as hier
+    from dask_ml_tpu import checkpoint as ckpt_lib
+    hmesh = hier.make_hierarchical_mesh(2, None)
+    assert dict(hmesh.shape) == {"pod": 2, "chip": 2}
+    hsh2 = NamedSharding(hmesh, P(("pod", "chip"), None))
+    hsh1 = NamedSharding(hmesh, P(("pod", "chip")))
+    Xh = jax.make_array_from_process_local_data(hsh2, Xg[start:stop],
+                                                (n, d))
+    yh = jax.make_array_from_process_local_data(hsh1, yg[start:stop], (n,))
+    wh = jax.make_array_from_process_local_data(
+        hsh1, np.ones(stop - start, np.float32), (n,))
+    zh6, _ = core.admm(Xh, yh, wh, beta00, mask, hmesh, max_iter=6, **akw)
+    _, _, sth, _ = core.admm(Xh, yh, wh, beta00, mask, hmesh, max_iter=3,
+                             return_state=True, **akw)
+    path = sys.argv[3] + f"/admm_hier_{pid}.ckpt"
+    ckpt_lib.save_pytree(path, [np.asarray(t) for t in sth])
+    loaded, _meta = ckpt_lib.load_pytree(path)
+    zhr, _, _, _ = core.admm(Xh, yh, wh, beta00, mask, hmesh, max_iter=3,
+                             state=tuple(loaded), return_state=True, **akw)
+    assert np.array_equal(np.asarray(zhr), np.asarray(zh6)), \\
+        "hierarchical ADMM checkpoint/resume diverged from the one-shot run"
+    print("ADMMH", " ".join(f"{v:.6e}" for v in np.asarray(zhr)),
+          flush=True)
+
     # --- both tsqr branches of the condition guard ----------------------
     from jax.sharding import PartitionSpec
     from dask_ml_tpu.ops import linalg as la
@@ -175,7 +205,8 @@ def test_two_process_runtime(tmp_path):
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
         subprocess.Popen(
-            [sys.executable, str(script), str(pid), str(port)],
+            [sys.executable, str(script), str(pid), str(port),
+             str(tmp_path)],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True,
         )
@@ -285,6 +316,26 @@ def test_two_process_runtime(tmp_path):
         reltol=0.0, max_iter=4)
     np.testing.assert_allclose(_lines("ADMMK"),
                                np.asarray(B_oracle).ravel(),
+                               rtol=1e-3, atol=1e-5)
+
+    # hierarchical (2, 2) ADMM: the workers pinned the save/load-file
+    # checkpoint round-trip == one-shot bit-identity in-process; here the
+    # cross-process trajectory must match a single-process oracle on the
+    # SAME (2, 2) hierarchical layout (pod boundary = process boundary in
+    # the workers, plain device split here — the psums reduce the same
+    # partials either way)
+    from dask_ml_tpu.parallel import hierarchy as hier_mod
+
+    hmesh4 = hier_mod.make_hierarchical_mesh(
+        2, 2, devices=jax.devices()[:4])
+    hs2 = NamedSharding(hmesh4, P(("pod", "chip"), None))
+    hs1 = NamedSharding(hmesh4, P(("pod", "chip")))
+    Xh4 = jax.device_put(jnp.asarray(Xg), hs2)
+    yh4 = jax.device_put(jnp.asarray(yg), hs1)
+    wh4 = jax.device_put(jnp.ones((64,), jnp.float32), hs1)
+    zh_oracle, _ = core.admm(Xh4, yh4, wh4, jnp.zeros((5,), jnp.float32),
+                             mask, hmesh4, max_iter=6, **akw)
+    np.testing.assert_allclose(_lines("ADMMH"), np.asarray(zh_oracle),
                                rtol=1e-3, atol=1e-5)
 
     # R is sign-unnormalized on the fallback branch, so compare |R|
