@@ -201,6 +201,122 @@ def make_classification(
     return X, y
 
 
+class SparseClassificationBlocks:
+    """Block-wise view of a :func:`make_sparse_classification` problem:
+    calling ``loader(b)`` materializes ONLY block ``b`` as
+    ``(SparseRows, y, w)`` host arrays — the 1e7 x 1e5 bench problem
+    streams through this without the full dataset (let alone its 4 TB
+    dense form) ever existing on the host at once.
+
+    Deterministic by construction: content derives from fixed-size row
+    CHUNKS, each seeded ``np.random.default_rng([seed, 1, chunk_id])``
+    (numpy's counter-based bit generators are platform- and
+    process-stable), and a block assembles the chunks its row range
+    covers. Row ``i`` is therefore the same whatever the blocking — any
+    process, any ``n_blocks``, any day regenerates it bit-identically,
+    which is what lets the elastic data plane re-deal a lost host's
+    blocks to survivors and lets a scaled-down CI drill slice the exact
+    rows the full bench run used. Compatible with
+    ``HostBlockSource(loader=blocks, n_blocks=blocks.n_blocks)``: every
+    block shares the same ELL width ``k`` (fixed nonzeros per row), so
+    the consuming per-block program compiles once.
+    """
+
+    #: rows per seeding chunk — the blocking-independent generation unit
+    CHUNK = 4096
+
+    def __init__(self, n_samples, n_features, k, coef, seed, n_blocks):
+        self.n_samples = int(n_samples)
+        self.n_features = int(n_features)
+        self.k = int(k)
+        self.coef = coef
+        self.seed = int(seed)
+        self.n_blocks = int(n_blocks)
+        self.block_rows = -(-self.n_samples // self.n_blocks)
+
+    def _chunk(self, cid: int):
+        """One seeding chunk: (cols, vals, y) for rows
+        ``[cid*CHUNK, min((cid+1)*CHUNK, n))``."""
+        rows = min(self.CHUNK, self.n_samples - cid * self.CHUNK)
+        rng = np.random.default_rng([self.seed, 1, int(cid)])
+        cols = rng.integers(0, self.n_features, size=(rows, self.k),
+                            dtype=np.int32)
+        vals = rng.standard_normal((rows, self.k), dtype=np.float32)
+        eta = (vals * self.coef[cols]).sum(axis=1)
+        y = (rng.random(rows) < 1.0 / (1.0 + np.exp(-eta))).astype(
+            np.float32)
+        return cols, vals, y
+
+    def __call__(self, b: int):
+        from dask_ml_tpu.ops.sparse import SparseRows
+
+        if not 0 <= b < self.n_blocks:
+            raise IndexError(f"block {b} out of range [0, {self.n_blocks})")
+        start = b * self.block_rows
+        stop = min(start + self.block_rows, self.n_samples)
+        parts = []
+        for cid in range(start // self.CHUNK, -(-stop // self.CHUNK)):
+            c0 = cid * self.CHUNK
+            cols, vals, y = self._chunk(cid)
+            lo = max(start - c0, 0)
+            hi = min(stop - c0, cols.shape[0])
+            parts.append((cols[lo:hi], vals[lo:hi], y[lo:hi]))
+        cols = np.concatenate([p[0] for p in parts])
+        vals = np.concatenate([p[1] for p in parts])
+        y = np.concatenate([p[2] for p in parts])
+        w = np.ones(cols.shape[0], np.float32)
+        return SparseRows(vals, cols, self.n_features), y, w
+
+
+def make_sparse_classification(
+    n_samples: int = 100,
+    n_features: int = 1000,
+    density: float = 0.01,
+    n_informative: Optional[int] = None,
+    random_state: int = 0,
+    n_blocks: Optional[int] = None,
+    return_coef: bool = False,
+):
+    """Binary classification with a SPARSE design: each row holds exactly
+    ``k = round(density * n_features)`` nonzeros (uniform column draws,
+    N(0,1) values — duplicates legal and summing, the container's
+    semantics), labels from a logistic link over a dense coefficient
+    vector with ``n_informative`` (default d/10) nonzero entries.
+
+    Returns ``(X, y)`` with ``X`` a HOST
+    :class:`~dask_ml_tpu.ops.sparse.SparseRows` (stage it through any
+    sparse-capable estimator, or ``ops.sparse.to_dense`` it for small
+    oracles). With ``n_blocks=`` the data is NOT materialized: a
+    :class:`SparseClassificationBlocks` loader is returned instead, each
+    block regenerated on demand from counter-based seeds — deterministic
+    across processes, so the >HBM/elastic tiers can stream it
+    (docs/sparse.md). ``random_state`` must be an integer seed for that
+    same reason (cross-process determinism leaves no room for ambient
+    RandomState objects)."""
+    if not isinstance(random_state, (int, np.integer)):
+        raise TypeError(
+            "make_sparse_classification requires an INTEGER random_state: "
+            "blocks regenerate from counter-based seeds so any process "
+            "can rebuild any block bit-identically")
+    seed = int(random_state)
+    d = int(n_features)
+    k = max(1, int(round(float(density) * d)))
+    if n_informative is None:
+        n_informative = max(1, d // 10)
+    rng = np.random.default_rng([seed, 0])
+    idx = rng.choice(d, size=min(int(n_informative), d), replace=False)
+    coef = np.zeros(d, np.float32)
+    coef[idx] = rng.standard_normal(len(idx), dtype=np.float32)
+    blocks = SparseClassificationBlocks(n_samples, d, k, coef, seed,
+                                        n_blocks or 1)
+    if n_blocks is not None:
+        return (blocks, coef) if return_coef else blocks
+    X, y, _ = blocks(0)
+    if return_coef:
+        return X, y, coef
+    return X, y
+
+
 def make_counts(
     n_samples: int = 1000,
     n_features: int = 100,

@@ -18,6 +18,7 @@ Deliberate deviations, documented:
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 from functools import partial
@@ -40,7 +41,14 @@ logger = logging.getLogger(__name__)
 
 def add_intercept(X):
     """Append a ones column (reference: dask-glm ``add_intercept``, used at
-    glm.py:165-169). Feature axis is replicated, so sharding is preserved."""
+    glm.py:165-169). Feature axis is replicated, so sharding is preserved.
+    Sparse containers (docs/sparse.md) append the intercept as one extra
+    nonzero slot per row (column index ``d``, value 1) — same linear map,
+    same in-trace fusion, dispatched by input type."""
+    from dask_ml_tpu.ops import sparse as sparse_ops
+
+    if isinstance(X, sparse_ops.SparseRows):
+        return sparse_ops.add_intercept_ell(X)
     ones = jnp.ones((X.shape[0], 1), dtype=X.dtype)
     return jnp.concatenate([X, ones], axis=1)
 
@@ -69,6 +77,11 @@ def eta_program(Xs, coef, *, intercept: bool):
     if intercept:
         Xs = add_intercept(Xs)
     ct = coef.T if coef.ndim == 2 else coef
+    from dask_ml_tpu.ops import sparse as sparse_ops
+
+    if isinstance(Xs, sparse_ops.SparseRows):
+        return (sparse_ops.matmat(Xs, ct) if ct.ndim == 2
+                else sparse_ops.matvec(Xs, ct))
     return precision_lib.pmatmul(Xs, ct)
 
 
@@ -170,17 +183,24 @@ class _GLM(BaseEstimator):
     def fit(self, X, y=None, sample_weight=None):
         self._pf_state = None  # batch fit discards any streaming state
         self._pf_classes = None
-        X = check_array(X)
+        X = check_array(X, accept_sparse=True)
         y = self._encode_y(y)
         mesh = mesh_lib.default_mesh()
+        from dask_ml_tpu.parallel.sharding import is_sparse_input
+
+        sparse_in = is_sparse_input(X)
         # Feature-axis tensor parallelism (SURVEY §2.9): on a 2-D
         # ('data', 'model') mesh the jit-compiled solvers shard X over BOTH
         # axes — XLA partitions the O(n·d²) Hessian/Gram matmuls and their
         # (d, d) outputs over the model axis, inserting the d-axis psums
         # itself. ADMM is excluded: its shard_map program keeps per-shard
         # d-vectors, a layout that is data-parallel by construction.
+        # Sparse inputs are excluded too: the sparse tier is
+        # sample-parallel (the container shards rows like dense data; the
+        # coefficient axis replicates).
         tensor_parallel = (
             mesh_lib.n_model_shards(mesh) > 1 and self.solver != "admm"
+            and not sparse_in
         )
         if tensor_parallel:
             # the intercept joins as a TRUE column (before feature padding)
@@ -259,7 +279,16 @@ class _GLM(BaseEstimator):
                 jnp.asarray(mask), mesh=mesh, **kwargs,
             )
 
-        with telemetry.span(f"glm-{self.solver}", logger=logger):
+        from dask_ml_tpu.ops import sparse as sparse_ops
+
+        with telemetry.span(f"glm-{self.solver}", logger=logger), \
+                (sparse_ops.metered(mesh) if sparse_in
+                 else contextlib.nullcontext()):
+            # the metered scope makes the sparse contractions' cross-shard
+            # collectives (pullback/Gram reductions) record per-axis bytes
+            # into the hierarchy ledger AT TRACE TIME — cache hits record
+            # nothing, preserving the per-trace semantics docs/scale-out.md
+            # pins (zero steady-state compiles <=> zero ledger growth)
             results = [solve_one(y_dev) for y_dev in self._solve_targets(data)]
         betas = [np.asarray(b)[:d_true] for b, _ in results]  # drop padding
         self.n_iter_ = int(max(int(n) for _, n in results))
@@ -289,7 +318,7 @@ class _GLM(BaseEstimator):
         repeat predict whose n lands in a warm bucket compiles NOTHING
         (the per-request contract the serving loop builds on; pinned by
         ``tests/test_serving.py::test_direct_predict_zero_compiles``)."""
-        X = check_array(X)
+        X = check_array(X, accept_sparse=True)
         Xs, n = shard_rows(X, dtype=precision_lib.staging_wire_dtype())
         eta = eta_program(Xs, jnp.asarray(self._coef, jnp.float32),
                           intercept=bool(self.fit_intercept))
@@ -497,7 +526,7 @@ class _GLM(BaseEstimator):
 
     def partial_fit(self, X, y=None, classes=None, sample_weight=None):
         """One proximal-SGD step on this block; resumable across calls."""
-        X = check_array(X)
+        X = check_array(X, accept_sparse=True)
         y_enc = self._encode_y_partial(y, classes)
         state = self._pf_state_device(int(X.shape[1]))
         _, apply_one = core.get_stream_step(**self._sgd_config())
@@ -595,7 +624,8 @@ class _GLM(BaseEstimator):
         def prep(Xa, ya):
             import jax
 
-            Xin = Xa if isinstance(Xa, jax.Array) else check_array(Xa)
+            Xin = Xa if isinstance(Xa, jax.Array) else check_array(
+                Xa, accept_sparse=True)
             return prepare_data(Xin, y=ya, mesh=mesh, y_dtype=jnp.float32)
 
         data = prep(X, y_enc)
@@ -697,7 +727,16 @@ class LogisticRegression(_GLM):
         kwargs = self._get_solver_kwargs()
         self._pf_state = None
         self._pf_classes = None
-        X = check_array(X)
+        X = check_array(X, accept_sparse=True)
+        from dask_ml_tpu.parallel.sharding import is_sparse_input
+
+        if is_sparse_input(X) and self.solver == "admm":
+            raise ValueError(
+                "multinomial ADMM does not support sparse inputs: its "
+                "local Newton builds the (dK x dK) Hessian from dense "
+                "rows. Use solver='lbfgs' (the softmax objective routes "
+                "through the sparse gather-matmat kernels), or "
+                "multiclass='ovr'")
         K = len(self.classes_)
         data = prepare_data(X, y=idx, sample_weight=sample_weight,
                             y_dtype=jnp.float32)

@@ -75,6 +75,10 @@ def _index(a, idx):
         return a.iloc[idx]
     if hasattr(a, "tocsr"):  # scipy sparse: np.asarray would 0-d wrap it
         return a.tocsr()[idx]
+    from dask_ml_tpu.ops.sparse import SparseRows
+
+    if isinstance(a, SparseRows):  # sparse container: row-gather both
+        return a[idx]              # leaves (np.asarray would 0-d wrap it)
     return np.asarray(a)[idx]
 
 

@@ -44,6 +44,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from dask_ml_tpu.ops import sparse as sparse_ops
 from dask_ml_tpu.parallel import precision as px
 from dask_ml_tpu.parallel.hierarchy import hpsum
 from dask_ml_tpu.parallel.mesh import data_pspec, n_data_shards, shard_map
@@ -172,7 +173,16 @@ def _data_matvec(X, v):
     data this is the same contraction it replaces; for bf16-staged data the
     coefficient vector is cast down so the matmul feeds the MXU as bf16
     while the output (and therefore gradients, objectives, backtracking
-    state) stays f32."""
+    state) stays f32.
+
+    Sparse dispatch (docs/sparse.md): a staged
+    :class:`~dask_ml_tpu.ops.sparse.SparseRows` container routes to the
+    blocked-ELL gather/segment-sum kernels — the kernel swap behind this
+    stable seam is the whole sparse-GLM story, the solvers above it are
+    untouched. Dispatch is BY INPUT TYPE, never a flag: dense inputs take
+    the exact contraction they always did, bit-unchanged."""
+    if isinstance(X, sparse_ops.SparseRows):
+        return sparse_ops.matvec(X, v)
     return px.pmatmul(X, v, accum=px.state_dtype(X.dtype))
 
 
@@ -180,7 +190,10 @@ def _data_pullback(X, r):
     """``X.T @ r`` (the gradient pullback) with the same compute/accum
     discipline as :func:`_data_matvec`: the f32 residual-like vector ``r``
     is cast to X's compute dtype, the contraction over the (possibly
-    sharded) sample axis accumulates ≥f32."""
+    sharded) sample axis accumulates ≥f32. Sparse containers scatter-add
+    through ``segment_sum`` over the stored column indices."""
+    if isinstance(X, sparse_ops.SparseRows):
+        return sparse_ops.pullback(X, r)
     return px.pdot(X, r, (((0,), (0,)), ((), ())),
                    accum=px.state_dtype(X.dtype))
 
@@ -190,7 +203,12 @@ def _weighted_gram(X, h):
     ≥f32 accumulation — the d×d Hessian build every Newton path shares.
     ``h`` (f32 per-row curvature weights) is applied first and the product
     cast back to X's dtype, so on bf16 data both matmul operands are bf16
-    (MXU-native) while the Hessian itself lands f32 for the dense solve."""
+    (MXU-native) while the Hessian itself lands f32 for the dense solve.
+    Sparse containers build the same (d, d) matrix by chunked scatter-add
+    of per-row nonzero outer products — O(nnz·k), only sensible where a
+    dense Hessian is sensible at all."""
+    if isinstance(X, sparse_ops.SparseRows):
+        return sparse_ops.weighted_gram(X, h)
     Xh = (h[:, None] * X).astype(X.dtype)
     return px.pdot(X, Xh, (((0,), (0,)), ((), ())),
                    accum=px.state_dtype(X.dtype))
@@ -844,9 +862,16 @@ def multinomial_lbfgs(X, y_idx, w, B0, mask, *, n_classes, regularizer="l2",
 
     def obj(bflat):
         B = bflat.reshape(d, K)
-        logits = jax.lax.dot_general(
-            X, B.astype(X.dtype), (((1,), (0,)), ((), ())),
-            preferred_element_type=sdt)  # (n, K)
+        if isinstance(X, sparse_ops.SparseRows):
+            # sparse logits: gather-matmat through the kernel tier (the
+            # gradient's X.T-pullback falls out of autodiff as the
+            # segment-sum scatter); the dense expression below stays
+            # byte-identical for dense inputs
+            logits = sparse_ops.matmat(X, B)
+        else:
+            logits = jax.lax.dot_general(
+                X, B.astype(X.dtype), (((1,), (0,)), ((), ())),
+                preferred_element_type=sdt)  # (n, K)
         lse = jax.scipy.special.logsumexp(logits, axis=1)
         nll = jnp.sum(w * (lse - jnp.sum(Yoh * logits, axis=1)))
         pen = pen_value((B * mask[:, None]).ravel())
@@ -912,7 +937,10 @@ def batched_eval_scores(E, y, w, betas, *, family):
     """Default scores of a coefficient batch on one eval set, weighted (0
     weights exclude padding rows): accuracy for logistic (matching the
     facade's ``score``), R² for normal. ``betas`` is (M, d); returns (M,)."""
-    eta = E @ betas.T  # (nE, M)
+    if isinstance(E, sparse_ops.SparseRows):
+        eta = sparse_ops.matmat(E, betas.T)  # (nE, M)
+    else:
+        eta = E @ betas.T  # (nE, M)
     sw = jnp.maximum(jnp.sum(w), 1e-12)
     if family == "logistic":
         pred = (eta > 0).astype(jnp.float32)
@@ -1345,9 +1373,13 @@ def make_sgd_step(family="logistic", regularizer="l2", lamduh=0.0,
     def step(state, blk):
         beta, t = state
         x, y, w = blk
+        sparse_blk = isinstance(x, sparse_ops.SparseRows)
         if fit_intercept:
-            x = jnp.concatenate(
-                [x, jnp.ones((x.shape[0], 1), x.dtype)], axis=1)
+            if sparse_blk:
+                x = sparse_ops.add_intercept_ell(x)
+            else:
+                x = jnp.concatenate(
+                    [x, jnp.ones((x.shape[0], 1), x.dtype)], axis=1)
         wsum = jnp.maximum(jnp.sum(w), 1e-12)
 
         if multinomial:
@@ -1355,13 +1387,16 @@ def make_sgd_step(family="logistic", regularizer="l2", lamduh=0.0,
                                  dtype=jnp.float32)
 
             def block_loss(B):
-                logits = x @ B  # (n_blk, K)
+                logits = (sparse_ops.matmat(x, B) if sparse_blk
+                          else x @ B)  # (n_blk, K)
                 lse = jax.scipy.special.logsumexp(logits, axis=1)
                 return jnp.sum(
                     w * (lse - jnp.sum(yoh * logits, axis=1))) / wsum
         else:
             def block_loss(b):
-                return jnp.sum(w * loss_fn(x @ b, y)) / wsum
+                eta = (sparse_ops.matvec(x, b) if sparse_blk
+                       else x @ b)
+                return jnp.sum(w * loss_fn(eta, y)) / wsum
 
         g = jax.grad(block_loss)(beta)
         lr = eta0 / (1.0 + t) ** power_t

@@ -1,0 +1,581 @@
+"""Sparse row-matrix kernels: blocked-ELL SpMM + segment-sum contractions.
+
+The production workloads this package exists for (CTR, text, recommender
+features at d >> 1e5) are >99% sparse; a dense (n, d) staging of them is not
+slow but IMPOSSIBLE (4 TB for the 1e7 x 1e5 bench problem). This module is
+the kernel tier of the sparse execution path (docs/sparse.md):
+
+- :class:`SparseRows` — the device-side container: a row matrix in
+  **blocked-ELL** layout, ``values (n, k)`` / ``cols (n, k)`` with ``k`` the
+  per-row nonzero budget padded to a power-of-two bucket
+  (:func:`dask_ml_tpu.parallel.shapes.bucket_nnz`). Both leaves shard
+  ``P('data', None)`` exactly like a dense row matrix, so every consumer of
+  the sharded layout (plain-jit GSPMD solvers, the shard_map ADMM, the
+  streamed tier) takes the container with NO index re-basing: the layout is
+  positional — row ``i`` of a shard's slice is row ``i`` of that shard.
+  Registered as a pytree, so it passes through ``jit``/``vmap``/``scan``/
+  ``shard_map``/``device_put`` untouched; the compile cache keys on the
+  padded ``(rows, k)`` bucket plus ``d``, which is the compile-once
+  discipline of docs/compile.md extended to sparse shapes.
+- The two contractions every GLM solver routes through its seams
+  (``models/glm.py::_data_matvec`` / ``_data_pullback``), plus the weighted
+  Gram (``_weighted_gram``), each in an **XLA reference path** built from
+  gather + row reduction / ``jax.ops.segment_sum`` scatter-add (runs
+  everywhere, including CPU CI, and autodiffs natively) and — for the
+  matvec/matmat — a **Pallas blocked-ELL SpMM** (:func:`spmv`) with f32
+  accumulation and a custom VJP whose backward pass IS the segment-sum
+  pullback, honoring the mixed-precision policy of docs/precision.md
+  (operands feed the MXU in the values' wire dtype, accumulation >= f32).
+- Per-trace collective metering (:func:`metered`): inside a metered scope
+  the cross-shard contractions (pullback's (d,) reduction, the Gram's
+  (d, d) reduction) record their analytic combining bytes into the
+  hierarchy ledger (docs/scale-out.md) AT TRACE TIME — a jit cache hit
+  records nothing, so zero steady-state compiles still implies zero ledger
+  growth, exactly the per-trace semantics of ``parallel/hierarchy.py``.
+
+Precision convention (mirrors :func:`dask_ml_tpu.parallel.precision.pdot`):
+products are formed in the VALUES' dtype (bf16-staged values pull the dense
+operand down to bf16), every reduction accumulates in the state dtype
+(>= f32). On f32 data the reference kernels sum exactly the stored nonzeros
+— on integer-valued data this is bit-identical to the dense matmul they
+replace (every partial sum is an exactly-representable integer), which is
+what the sparse-vs-dense exactness pins in ``tests/test_sparse.py`` assert.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+__all__ = [
+    "SparseRows",
+    "ell_from_csr",
+    "ell_from_dense",
+    "to_dense",
+    "add_intercept_ell",
+    "matvec",
+    "matmat",
+    "pullback",
+    "pullback_mat",
+    "weighted_gram",
+    "column_moments",
+    "column_mean_var",
+    "scale_columns",
+    "spmv",
+    "metered",
+]
+
+
+@jax.tree_util.register_pytree_node_class
+class SparseRows:
+    """A sparse (n, d) row matrix in blocked-ELL layout.
+
+    ``values`` and ``cols`` are ``(n, k)``: row ``i`` holds its nonzeros in
+    slots ``0..k-1`` (column index + value), with unused slots padded as
+    ``(col=0, value=0)`` — inert in every contraction because the VALUE is
+    zero, so no validity mask is ever needed. ``d`` (the true feature
+    count) is static pytree aux data: it keys the compile cache together
+    with the padded ``(n, k)`` leaf shapes, never the true ``nnz``.
+
+    Duplicate column indices within a row are legal and SUM — the same
+    linear-map semantics as a scipy matrix with duplicate entries.
+
+    The container deliberately quacks like a 2-D array where the solver
+    seams need it to (``shape``/``ndim``/``dtype``/``nbytes``), so the GLM
+    cores dispatch on type at the three X-touching seams and change
+    nothing else.
+    """
+
+    def __init__(self, values, cols, d: int):
+        self.values = values
+        self.cols = cols
+        self.d = int(d)
+
+    def tree_flatten(self):
+        return (self.values, self.cols), (self.d,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        obj = object.__new__(cls)
+        obj.values, obj.cols = children
+        obj.d = aux[0]
+        return obj
+
+    # -- array-like surface (what the solver seams read) -------------------
+
+    @property
+    def shape(self) -> tuple:
+        return (self.values.shape[0], self.d)
+
+    @property
+    def ndim(self) -> int:
+        return 2
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def k(self) -> int:
+        """The per-row nonzero budget (the padded ELL width)."""
+        return int(self.values.shape[1])
+
+    @property
+    def nbytes(self) -> int:
+        """ACTUAL bytes held (values + indices) — the nnz-based size
+        ``utils/_log.py::log_array`` reports, not the dense n*d*itemsize."""
+        return int(self.values.nbytes) + int(self.cols.nbytes)
+
+    @property
+    def sharding(self):
+        """Placement of the container = placement of its values leaf (both
+        leaves are staged identically)."""
+        return getattr(self.values, "sharding", None)
+
+    def astype(self, dtype):
+        return SparseRows(self.values.astype(dtype), self.cols, self.d)
+
+    def __getitem__(self, idx):
+        """Row slicing/gathering (CV-style use: slices and index arrays);
+        columns are not sliceable (the reference forbids feature chunking
+        the same way). Scalar indices are rejected — they would drop the
+        row axis and leave a container whose shape/ndim lie."""
+        if isinstance(idx, (int, np.integer)):
+            raise TypeError(
+                "SparseRows rows are indexed with slices or index arrays "
+                f"(got scalar {idx!r}); use A[i:i+1] to keep the row axis")
+        return SparseRows(self.values[idx], self.cols[idx], self.d)
+
+    def __repr__(self):
+        return (f"SparseRows(shape={self.shape}, k={self.values.shape[1]}, "
+                f"dtype={self.dtype})")
+
+
+def is_sparse_rows(x) -> bool:
+    return isinstance(x, SparseRows)
+
+
+# ---------------------------------------------------------------------------
+# host-side encoding (numpy; the wire format the streamed tier moves)
+# ---------------------------------------------------------------------------
+
+
+def ell_from_csr(X, k: int = None, dtype=None) -> SparseRows:
+    """Encode a scipy CSR/CSC/COO matrix as a host-array :class:`SparseRows`.
+
+    ``k`` (default: :func:`~dask_ml_tpu.parallel.shapes.bucket_nnz` of the
+    max row nonzero count) is the per-row slot budget — pass it explicitly
+    to pin several blocks of one dataset to a COMMON width (the streamed
+    tier does; unequal widths would compile one program per block).
+    Vectorized fill: O(nnz) host work, no per-row Python loop.
+    """
+    import scipy.sparse
+
+    from dask_ml_tpu.parallel import shapes
+
+    if not scipy.sparse.issparse(X):
+        raise TypeError(f"ell_from_csr expects a scipy sparse matrix, got "
+                        f"{type(X).__name__}")
+    X = X.tocsr()
+    n, d = X.shape
+    row_nnz = np.diff(X.indptr)
+    k_true = int(row_nnz.max()) if n else 0
+    if k is None:
+        k = shapes.bucket_nnz(k_true)
+    elif k_true > int(k):
+        raise ValueError(
+            f"a row has {k_true} nonzeros, more than the requested ELL "
+            f"width k={k}; widen k (blocks of one dataset must share the "
+            "max row-nnz bucket)")
+    k = max(int(k), 1)
+    vdt = np.dtype(dtype) if dtype is not None else (
+        X.dtype if np.issubdtype(X.dtype, np.floating) else np.float32)
+    values = np.zeros((n, k), vdt)
+    cols = np.zeros((n, k), np.int32)
+    if X.nnz:
+        r = np.repeat(np.arange(n), row_nnz)
+        slot = np.arange(X.nnz) - np.repeat(X.indptr[:-1], row_nnz)
+        values[r, slot] = X.data.astype(vdt, copy=False)
+        cols[r, slot] = X.indices.astype(np.int32, copy=False)
+    return SparseRows(values, cols, d)
+
+
+def ell_from_dense(X, k: int = None, dtype=None) -> SparseRows:
+    """Encode a dense host array (test/bench convenience)."""
+    import scipy.sparse
+
+    return ell_from_csr(scipy.sparse.csr_matrix(np.asarray(X)), k=k,
+                        dtype=dtype)
+
+
+def to_dense(A: SparseRows):
+    """Densify (small sizes / tests): duplicate column slots SUM."""
+    n, k = A.values.shape
+    rows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, k))
+    out = jnp.zeros((n, A.d), _accum_dtype(A))
+    return out.at[rows, A.cols].add(A.values.astype(out.dtype))
+
+
+def add_intercept_ell(A: SparseRows) -> SparseRows:
+    """Append an intercept column (all-ones, column index ``d``) as ONE
+    extra slot per row — the sparse analogue of the dense ones-column
+    append, device-side and jit-traceable so it fuses into the consuming
+    program exactly like ``linear_model.glm.add_intercept`` does."""
+    n = A.values.shape[0]
+    xp = np if isinstance(A.values, np.ndarray) else jnp
+    ones = xp.ones((n, 1), A.values.dtype)
+    icol = xp.full((n, 1), A.d, dtype=A.cols.dtype)
+    return SparseRows(xp.concatenate([A.values, ones], axis=1),
+                      xp.concatenate([A.cols, icol], axis=1), A.d + 1)
+
+
+# ---------------------------------------------------------------------------
+# per-trace collective metering (the hierarchy ledger hook)
+# ---------------------------------------------------------------------------
+
+_METER = threading.local()
+
+
+@contextlib.contextmanager
+def metered(mesh):
+    """Scope within which the cross-shard sparse contractions (pullback,
+    weighted Gram) record their analytic combining bytes into the traffic
+    ledger under ops ``sparse.pullback`` / ``sparse.gram``. Recording
+    happens inside the TRACED helpers, i.e. once per trace — a compile
+    cache hit records nothing (the per-trace semantics of
+    ``parallel/hierarchy.py``, which is what lets the bench pin
+    zero-steady-state-compiles as zero ledger growth). The facades enter
+    this scope around solver dispatch when the staged data is sparse."""
+    prev = getattr(_METER, "mesh", None)
+    _METER.mesh = mesh
+    try:
+        yield
+    finally:
+        _METER.mesh = prev
+
+
+def _record(op: str, shape, dtype) -> None:
+    mesh = getattr(_METER, "mesh", None)
+    if mesh is None:
+        return
+    from dask_ml_tpu.parallel.hierarchy import record_collective
+
+    record_collective(op, mesh, shape, dtype)
+
+
+# ---------------------------------------------------------------------------
+# the contractions (XLA reference path)
+# ---------------------------------------------------------------------------
+
+
+def _accum_dtype(A: SparseRows):
+    from dask_ml_tpu.parallel import precision as px
+
+    return px.state_dtype(A.dtype)
+
+
+def matvec(A: SparseRows, v, *, kernel: str = "auto"):
+    """``A @ v`` — the sparse linear predictor. ``v`` is ``(d,)`` (or the
+    operand's true width; callers pass coefficient vectors sized to
+    ``A.d``). Products form in the values' (possibly bf16) dtype, the
+    per-row reduction accumulates >= f32 — the same discipline as
+    :func:`~dask_ml_tpu.parallel.precision.pmatmul` on dense rows.
+
+    ``kernel='auto'`` uses the Pallas blocked-ELL SpMM on TPU (when the
+    row count tiles) and the XLA gather+rowsum reference elsewhere;
+    ``'xla'``/``'pallas'`` force a path (pallas runs in interpret mode off
+    TPU — slow, CI-only). Purely rowwise: shards under GSPMD with no
+    collective, and autodiff w.r.t. ``v`` yields exactly the segment-sum
+    pullback."""
+    if _use_pallas(A, kernel):
+        return spmv(A, v)
+    cd = A.dtype
+    acc = _accum_dtype(A)
+    prods = A.values * v.astype(cd)[A.cols]
+    return jnp.sum(prods.astype(acc), axis=1)
+
+
+def matmat(A: SparseRows, B):
+    """``A @ B`` for a dense ``(d, m)`` operand (multinomial logits,
+    batched-coefficient scoring): gather ``B``'s rows per slot, reduce over
+    slots with f32 accumulation. Memory is O(n * k * m) transient — fine
+    for the small ``m`` (class counts, candidate counts) it serves."""
+    cd = A.dtype
+    acc = _accum_dtype(A)
+    g = B.astype(cd)[A.cols]                    # (n, k, m)
+    prods = A.values[:, :, None] * g
+    return jnp.sum(prods.astype(acc), axis=1)   # (n, m)
+
+
+def pullback(A: SparseRows, r):
+    """``A.T @ r`` — the gradient pullback, as a ``segment_sum``
+    scatter-add over the flattened column indices (f32 accumulation;
+    padded slots carry value 0 and contribute nothing wherever their
+    column index points). The one sparse contraction whose output reduces
+    ACROSS shards: inside a :func:`metered` scope it records the analytic
+    (n_shards-1) * d * 4 combining bytes per trace as ``sparse.pullback``."""
+    cd = A.dtype
+    acc = _accum_dtype(A)
+    _record("sparse.pullback", (A.d,), acc)
+    prods = (A.values * r.astype(cd)[:, None]).astype(acc)
+    return jax.ops.segment_sum(prods.ravel(), A.cols.ravel(),
+                               num_segments=A.d)
+
+
+def pullback_mat(A: SparseRows, R):
+    """``A.T @ R`` for a dense ``(n, m)`` cotangent (multinomial
+    gradients): segment-sum over columns, vectorized over ``m``."""
+    cd = A.dtype
+    acc = _accum_dtype(A)
+    _record("sparse.pullback", (A.d, int(R.shape[1])), acc)
+    n, k = A.values.shape
+    prods = (A.values[:, :, None] * R.astype(cd)[:, None, :]).astype(acc)
+    return jax.ops.segment_sum(prods.reshape(n * k, -1), A.cols.ravel(),
+                               num_segments=A.d)
+
+
+def _gram_chunk(n: int, k: int, budget: int = 1 << 22) -> int:
+    """Largest row-chunk size dividing ``n`` with chunk*k*k <= budget —
+    static (host) arithmetic bounding the transient (chunk, k, k) outer-
+    product buffer of :func:`weighted_gram`. Bounded search: a short
+    downward scan for a divisor, then the largest power of two dividing
+    ``n`` (staged row counts are bucketed and even; a pathological prime
+    ``n`` degrades to more scan steps, never to a host-side spin)."""
+    if n == 0:
+        return 1
+    cap = max(1, min(n, budget // max(k * k, 1)))
+    for c in range(cap, max(cap - 64, 0), -1):
+        if n % c == 0:
+            return c
+    p2 = n & -n  # largest power of two dividing n
+    while p2 > cap:
+        p2 //= 2
+    return max(p2, 1)
+
+
+def weighted_gram(A: SparseRows, h):
+    """``A.T @ diag(h) @ A`` — the (d, d) GLM curvature, as a chunked
+    scatter-add of per-row outer products over each row's <= k*k nonzero
+    pairs (O(nnz * k) work instead of the dense O(n * d^2); transient
+    memory bounded by :func:`_gram_chunk`). Accumulates f32. Only
+    meaningful where a dense (d, d) Hessian is meaningful at all (Newton /
+    ADMM inner solves at moderate d); the wide-d sparse regime runs the
+    gradient-only solvers, which never touch this."""
+    acc = _accum_dtype(A)
+    _record("sparse.gram", (A.d, A.d), acc)
+    n, k = A.values.shape
+    w = (A.values.astype(acc) * h.astype(acc)[:, None])     # (n, k)
+    vals = A.values.astype(acc)
+    c = _gram_chunk(n, k)
+    wc = w.reshape(n // c, c, k)
+    vc = vals.reshape(n // c, c, k)
+    cc = A.cols.reshape(n // c, c, k)
+
+    def body(H, inp):
+        wv, vv, ci = inp
+        contrib = wv[:, :, None] * vv[:, None, :]           # (c, k, k)
+        return H.at[ci[:, :, None], ci[:, None, :]].add(contrib), None
+
+    H, _ = lax.scan(body, jnp.zeros((A.d, A.d), acc), (wc, vc, cc))
+    return H
+
+
+# ---------------------------------------------------------------------------
+# Pallas blocked-ELL SpMM
+# ---------------------------------------------------------------------------
+
+#: rows per grid step of the Pallas kernel — one (R, k) values/cols tile
+#: plus the replicated operand vector resident in VMEM per step
+_SPMV_BLK = 256
+
+
+def _use_pallas(A: SparseRows, kernel: str) -> bool:
+    if kernel == "xla":
+        return False
+    n = int(A.values.shape[0])
+    tiles = n >= 1 and n % min(n, _SPMV_BLK) == 0
+    if kernel == "pallas":
+        if not tiles:
+            raise ValueError(
+                f"pallas spmv needs the row count ({n}) to tile by "
+                f"{min(n, _SPMV_BLK)}; stage through the bucketing layer "
+                "or use kernel='xla'")
+        return True
+    if kernel != "auto":
+        raise ValueError(f"kernel must be 'auto', 'xla' or 'pallas', "
+                         f"got {kernel!r}")
+    # auto: the hand-scheduled path only where it can win — on TPU, with
+    # tiling row counts (every bucketed staging tiles). Off-TPU pallas
+    # only interprets (CI correctness, not speed).
+    return jax.default_backend() == "tpu" and tiles
+
+
+@jax.custom_vjp
+def spmv(A: SparseRows, v):
+    """Blocked-ELL SpMM ``A @ v`` as a Pallas kernel: the grid walks
+    (R, k) row tiles; each step gathers the operand entries its tile's
+    column indices name from the VMEM-resident ``v`` and reduces the
+    products in f32 — the epilogue never leaves VMEM (the
+    ``ops/fused_distance.py`` family's discipline). Off-TPU the kernel
+    runs in interpret mode (CPU CI). The custom VJP's backward pass is the
+    segment-sum :func:`pullback` (w.r.t. ``v``) and the slot-wise gather
+    product (w.r.t. ``values``), so the Pallas path is usable inside
+    differentiated objectives with gradients identical to the XLA
+    reference path."""
+    return _spmv_impl(A, v)
+
+
+def _spmv_impl(A: SparseRows, v):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_true, k = A.values.shape
+    blk = min(n_true, _SPMV_BLK)
+    pad = (-n_true) % max(blk, 1)
+    if pad:
+        # non-tiling row counts pad up to the grid (value-0 slots are
+        # inert) and slice back — the public entry point must be correct
+        # for EVERY n, not only the bucketed sizes the auto path admits
+        A = SparseRows(jnp.pad(A.values, [(0, pad), (0, 0)]),
+                       jnp.pad(A.cols, [(0, pad), (0, 0)]), A.d)
+    n, k = A.values.shape
+    acc = _accum_dtype(A)
+    v2 = v.astype(A.dtype).reshape(-1, 1)
+    d_op = int(v2.shape[0])
+
+    def kern(val_ref, col_ref, v_ref, out_ref):
+        vals = val_ref[:]                       # (blk, k)
+        cidx = col_ref[:]                       # (blk, k)
+        g = v_ref[:, 0][cidx]                   # gather (blk, k)
+        prods = (vals.astype(acc) * g.astype(acc))
+        out_ref[:] = jnp.sum(prods, axis=1, keepdims=True)
+
+    out = pl.pallas_call(
+        kern,
+        grid=(n // blk,),
+        in_specs=[
+            pl.BlockSpec((blk, k), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((blk, k), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((d_op, 1), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((blk, 1), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, 1), acc),
+        interpret=jax.default_backend() != "tpu",
+    )(A.values, A.cols, v2)
+    return out[:n_true, 0]
+
+
+def _spmv_fwd(A, v):
+    return _spmv_impl(A, v), (A, v)
+
+
+def _spmv_bwd(res, g):
+    A, v = res
+    dvalues = (g.astype(A.dtype)[:, None] * v.astype(A.dtype)[A.cols])
+    dcols = np.zeros(A.cols.shape, dtype=jax.dtypes.float0)
+    dv = pullback(A, g).astype(v.dtype)
+    return SparseRows(dvalues, dcols, A.d), dv
+
+
+spmv.defvjp(_spmv_fwd, _spmv_bwd)
+
+
+# ---------------------------------------------------------------------------
+# column moments (the sparse StandardScaler reduction)
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def column_moments(A: SparseRows, w):
+    """Weighted per-column first/second moments from the NONZEROS only:
+    ``(sum_i w_i x_ij, sum_i w_i x_ij^2, sum_i w_i)`` in O(nnz) (zeros
+    contribute nothing to either sum). f32 scatter accumulation; padding
+    rows carry weight 0 like everywhere else. Like
+    :func:`column_mean_var`, the quadratic sum assumes at most one stored
+    entry per (row, column): duplicate slots contribute ``v1^2 + v2^2``
+    where the summed-duplicate semantics would need ``(v1 + v2)^2``
+    (canonical CSR — every scipy input — has no duplicates)."""
+    acc = _accum_dtype(A)
+    vals = A.values.astype(acc)
+    wv = w.astype(acc)[:, None]
+    flat_cols = A.cols.ravel()
+    s1 = jax.ops.segment_sum((wv * vals).ravel(), flat_cols,
+                             num_segments=A.d)
+    s2 = jax.ops.segment_sum((wv * vals * vals).ravel(), flat_cols,
+                             num_segments=A.d)
+    return s1, s2, jnp.sum(w.astype(acc))
+
+
+@jax.jit
+def column_mean_var(A: SparseRows, w):
+    """Weighted per-column ``(mean, var, sum_w)`` by the numerically
+    stable TWO-PASS form — the sparse ``StandardScaler`` reduction.
+
+    The one-pass ``E[x^2] - mean^2`` identity cancels catastrophically in
+    f32 for columns whose mean dwarfs their spread (count/offset features:
+    mean ~1e3, var ~1 → both terms ~1e6, difference below f32 resolution).
+    Here pass 1 takes the mean, pass 2 sums ``w·(x - mean)^2`` over the
+    stored entries PLUS the closed-form zero contribution
+    ``(sum_w - nnz_w_j)·mean_j^2`` (``nnz_w_j`` = weighted count of stored
+    entries in column j, masked on ``value != 0`` so padded slots and
+    explicit stored zeros both land in the zero term). Still O(nnz), two
+    passes. Assumes at most one stored entry per (row, column) — the
+    canonical-CSR case; duplicate slots are supported by the LINEAR
+    contractions but not by quadratic moments."""
+    acc = _accum_dtype(A)
+    vals = A.values.astype(acc)
+    wv = w.astype(acc)[:, None]
+    flat_cols = A.cols.ravel()
+    sw = jnp.sum(w.astype(acc))
+    s1 = jax.ops.segment_sum((wv * vals).ravel(), flat_cols,
+                             num_segments=A.d)
+    denom = jnp.maximum(sw, 1.0)
+    mean = s1 / denom
+    stored = (vals != 0).astype(acc)
+    nnz_w = jax.ops.segment_sum((wv * stored).ravel(), flat_cols,
+                                num_segments=A.d)
+    dev2 = jax.ops.segment_sum(
+        (wv * stored * (vals - mean[A.cols]) ** 2).ravel(), flat_cols,
+        num_segments=A.d)
+    var = (dev2 + (sw - nnz_w) * mean * mean) / denom
+    return mean, jnp.maximum(var, 0.0), sw
+
+
+@jax.jit
+def has_duplicate_slots(A: SparseRows):
+    """True if any row stores the SAME column index in two nonzero slots.
+    The linear contractions sum duplicates correctly (scipy semantics),
+    but the QUADRATIC moment reductions (:func:`column_moments` /
+    :func:`column_mean_var`) cannot be computed slot-wise over them —
+    the sparse ``StandardScaler`` uses this O(nnz log k) device check to
+    reject such containers loudly instead of returning silently wrong
+    variances. Unstored (value-0) slots never count as duplicates."""
+    n, k = A.values.shape
+    # stored slots keep their column id; unstored slots get a unique
+    # per-slot negative sentinel so they can never collide
+    sentinel = -1 - jnp.arange(k, dtype=A.cols.dtype)[None, :]
+    c = jnp.where(A.values != 0, A.cols, sentinel)
+    c = jnp.sort(c, axis=1)
+    if k < 2:
+        return jnp.asarray(False)
+    return jnp.any(c[:, 1:] == c[:, :-1])
+
+
+@jax.jit
+def scale_columns(A: SparseRows, scale):
+    """Divide each nonzero by its column's scale factor (the sparse
+    ``StandardScaler.transform``): a pure gather + elementwise multiply —
+    the container's layout (and therefore its compiled-program bucket) is
+    unchanged."""
+    inv = (1.0 / scale).astype(_accum_dtype(A))
+    out = (A.values.astype(inv.dtype) * inv[A.cols]).astype(A.dtype)
+    return SparseRows(out, A.cols, A.d)

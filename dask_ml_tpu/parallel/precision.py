@@ -349,17 +349,25 @@ def cast_wire(block: tuple, storage) -> tuple:
     Never upcasts (an f16 input is not widened to bf16's byte width), so
     ``storage=None`` or an already-narrow block is a no-op returning the
     same tuple.
+
+    A sparse block element (:class:`~dask_ml_tpu.ops.sparse.SparseRows`,
+    docs/sparse.md) is a registered pytree whose leaves follow the SAME
+    per-leaf rule: the float (n, k) values narrow, the int32 column
+    indices are exact coordinates and never do — the sparse wire is
+    values-at-storage-dtype + exact indices.
     """
     if storage is None:
         return tuple(block)
+    import jax
     import numpy as np
 
     st = jnp.dtype(storage)
-    out = []
-    for a in block:
-        a = np.asarray(a)
-        if (a.ndim >= 2 and np.issubdtype(a.dtype, np.floating)
-                and a.dtype.itemsize > st.itemsize):
-            a = a.astype(st)
-        out.append(a)
-    return tuple(out)
+
+    def cast_leaf(leaf):
+        leaf = np.asarray(leaf)
+        if (leaf.ndim >= 2 and np.issubdtype(leaf.dtype, np.floating)
+                and leaf.dtype.itemsize > st.itemsize):
+            leaf = leaf.astype(st)
+        return leaf
+
+    return tuple(jax.tree_util.tree_map(cast_leaf, a) for a in block)

@@ -64,6 +64,7 @@ __all__ = [
     "DEFAULT_POLICY",
     "active_policy",
     "bucket_rows",
+    "bucket_nnz",
     "pad_tail",
     "compile_stats",
     "reset_compile_stats",
@@ -161,6 +162,32 @@ def bucket_rows(n: int, align: int = 1,
     return padded
 
 
+def bucket_nnz(k: int, min_slots: int = 1, record: bool = True) -> int:
+    """Padded per-row nonzero budget for an ELL width of ``k`` true slots:
+    the next power of two (PR-4-style buckets, <= 2x slot waste), floored
+    at ``min_slots``. This is the SECOND half of the sparse compile-once
+    key — a staged :class:`~dask_ml_tpu.ops.sparse.SparseRows` compiles one
+    program per ``(row bucket, nnz bucket)`` pair, so mixed batches whose
+    max row-nnz lands in the same power of two share their executables
+    exactly like mixed sample counts sharing a row bucket do.
+
+    Unlike row padding (weight-0 rows), a padded SLOT is inert by value:
+    it carries ``value=0`` at ``col=0``, contributing exactly 0.0 to every
+    contraction — no mask needed. ``record=True`` notes the ``(bucket, k)``
+    pair into ``compile_stats()['nnz_buckets']``; size queries pass
+    ``record=False``."""
+    k = int(k)
+    if k < 0:
+        raise ValueError(f"k must be >= 0, got {k}")
+    b = max(int(min_slots), 1)
+    target = max(k, 1)
+    bucket = max(1 << (target - 1).bit_length(), b)
+    if record:
+        with _stats_lock:
+            _nnz_buckets.setdefault(int(bucket), set()).add(k)
+    return bucket
+
+
 def pad_tail(arrays: Sequence[np.ndarray], rows: int) -> tuple:
     """Zero-pad every array of a block tuple along axis 0 up to ``rows``.
 
@@ -170,8 +197,9 @@ def pad_tail(arrays: Sequence[np.ndarray], rows: int) -> tuple:
     weight row is weight 0 — the padding is inert in every weighted
     reduction. A consumer without a weight array must not use this.
     """
-    out = []
-    for a in arrays:
+    import jax
+
+    def pad_one(a):
         a = np.asarray(a)
         if a.shape[0] > rows:
             raise ValueError(
@@ -179,8 +207,12 @@ def pad_tail(arrays: Sequence[np.ndarray], rows: int) -> tuple:
         if a.shape[0] < rows:
             pad = np.zeros((rows - a.shape[0],) + a.shape[1:], a.dtype)
             a = np.concatenate([a, pad], axis=0)
-        out.append(a)
-    return tuple(out)
+        return a
+
+    # leaf-wise over each element: a plain array is its own leaf; a sparse
+    # container (a registered pytree, docs/sparse.md) pads BOTH its leaves
+    # — padded rows hold zero values at col 0, inert by value
+    return tuple(jax.tree_util.tree_map(pad_one, a) for a in arrays)
 
 
 # ---------------------------------------------------------------------------
@@ -202,6 +234,8 @@ _stats = {
 }
 # padded bucket size -> set of distinct true row counts staged into it
 _buckets: dict = {}
+# padded ELL width -> set of distinct true max-row-nnz values staged into it
+_nnz_buckets: dict = {}
 _listeners_installed = False
 
 
@@ -285,6 +319,8 @@ def compile_stats() -> dict:
     with _stats_lock:
         out = dict(_stats)
         out["shape_buckets"] = {k: sorted(v) for k, v in _buckets.items()}
+        out["nnz_buckets"] = {k: sorted(v)
+                              for k, v in _nnz_buckets.items()}
     return out
 
 
@@ -295,9 +331,12 @@ def reset_compile_stats() -> dict:
     with _stats_lock:
         out = dict(_stats)
         out["shape_buckets"] = {k: sorted(v) for k, v in _buckets.items()}
+        out["nnz_buckets"] = {k: sorted(v)
+                              for k, v in _nnz_buckets.items()}
         _stats.update(n_compiles=0, compile_seconds=0.0,
                       n_traces=0, trace_seconds=0.0)
         _buckets.clear()
+        _nnz_buckets.clear()
     return out
 
 

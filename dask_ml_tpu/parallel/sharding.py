@@ -153,6 +153,22 @@ def _padded_rows(n: int, mesh) -> int:
     return shapes.bucket_rows(n, align=mesh_lib.n_data_shards(mesh))
 
 
+def is_sparse_input(x) -> bool:
+    """True for inputs that stage through the SPARSE tier: a scipy sparse
+    matrix, or an already-encoded :class:`~dask_ml_tpu.ops.sparse.SparseRows`
+    container (host or device)."""
+    from dask_ml_tpu.ops.sparse import SparseRows
+
+    if isinstance(x, SparseRows):
+        return True
+    try:
+        import scipy.sparse
+
+        return scipy.sparse.issparse(x)
+    except ImportError:  # pragma: no cover - scipy is a hard dep in practice
+        return False
+
+
 def shard_rows(
     x: ArrayLike,
     mesh: Optional[Mesh] = None,
@@ -165,8 +181,24 @@ def shard_rows(
 
     Padding rows are zeros; callers must mask them via weights from
     :func:`row_weights` (or :func:`prepare_data`, which does both).
+
+    Sparse inputs (scipy CSR, or a
+    :class:`~dask_ml_tpu.ops.sparse.SparseRows` container) stage through
+    :func:`shard_sparse_rows` — same row bucketing, same sharding spec on
+    both container leaves, plus per-row nonzero-slot padding to a
+    power-of-two bucket (``shapes.bucket_nnz``) so nearby nnz widths share
+    compiled programs the way nearby sample counts do.
     """
     mesh = mesh or mesh_lib.default_mesh()
+    if is_sparse_input(x):
+        memo = _current_memo()
+        if memo is not None:
+            return memo.get_or_stage(
+                ("sparse-rows", id(x), id(mesh), str(dtype), _policy_sig()),
+                (x, mesh),
+                lambda: shard_sparse_rows(x, mesh, dtype),
+            )
+        return shard_sparse_rows(x, mesh, dtype)
     memo = _current_memo()
     if memo is not None:
         return memo.get_or_stage(
@@ -175,6 +207,50 @@ def shard_rows(
             lambda: _shard_rows_impl(x, mesh, dtype),
         )
     return _shard_rows_impl(x, mesh, dtype)
+
+
+def shard_sparse_rows(x, mesh=None, dtype=None):
+    """Stage a sparse row matrix onto the mesh as a sharded blocked-ELL
+    :class:`~dask_ml_tpu.ops.sparse.SparseRows`. Returns
+    ``(container, n_valid)``.
+
+    The sample axis pads to the SAME shape bucket dense staging uses
+    (weight-0 rows downstream); the per-row nonzero axis pads to a
+    power-of-two slot bucket (:func:`~dask_ml_tpu.parallel.shapes.bucket_nnz`
+    — padded slots are value-0 and inert with no mask). Both leaves place
+    ``P('data', None)``, so the container shards exactly like a dense row
+    matrix and every consumer of the sharded layout takes it unchanged.
+    ``dtype`` casts the VALUES only (the wire dtype under a bf16 policy);
+    column indices stay int32 exact.
+    """
+    import scipy.sparse
+
+    from dask_ml_tpu.ops.sparse import SparseRows, ell_from_csr
+    from dask_ml_tpu.parallel import shapes
+
+    mesh = mesh or mesh_lib.default_mesh()
+    if scipy.sparse.issparse(x):
+        x = ell_from_csr(x, dtype=dtype)
+    elif not isinstance(x, SparseRows):
+        raise TypeError(
+            f"shard_sparse_rows expects a scipy sparse matrix or a "
+            f"SparseRows container, got {type(x).__name__}")
+    n = int(x.values.shape[0])
+    k = int(x.values.shape[1])
+    k_pad = shapes.bucket_nnz(k) - k
+    pad = _padded_rows(n, mesh) - n
+    vals, cols = x.values, x.cols
+    on_host = isinstance(vals, np.ndarray)
+    xp = np if on_host else jnp
+    if dtype is not None and vals.dtype != jnp.dtype(dtype):
+        vals = vals.astype(dtype)
+    if k_pad or pad:
+        vals = xp.pad(vals, [(0, pad), (0, k_pad)])
+        cols = xp.pad(cols, [(0, pad), (0, k_pad)])
+    sharding = mesh_lib.data_sharding(mesh, ndim=2)
+    staged = SparseRows(jax.device_put(vals, sharding),
+                        jax.device_put(cols, sharding), x.d)
+    return staged, n
 
 
 def _shard_rows_impl(x, mesh, dtype):
@@ -262,7 +338,12 @@ def shard_2d(
 
 
 def unpad_rows(x: ArrayLike, n_valid: int) -> jax.Array:
-    """Drop padding rows from a padded per-row result (labels, transforms)."""
+    """Drop padding rows from a padded per-row result (labels, transforms).
+    Dispatches on sparse containers (row-slices both leaves)."""
+    from dask_ml_tpu.ops.sparse import SparseRows
+
+    if isinstance(x, SparseRows):
+        return SparseRows(x.values[:n_valid], x.cols[:n_valid], x.d)
     return jnp.asarray(x)[:n_valid]
 
 
@@ -369,12 +450,23 @@ def prepare_data(
 
 def _prepare_data_impl(X, y, sample_weight, mesh, dtype, y_dtype,
                        shard_features=False, append_ones=False):
-    if append_ones:
+    sparse_in = is_sparse_input(X)
+    if append_ones and not sparse_in:
         Xa = np.asarray(X)
         X = np.concatenate(
             [Xa, np.ones((Xa.shape[0], 1), Xa.dtype)], axis=1)
     d = None
-    if shard_features and mesh_lib.n_model_shards(mesh) > 1:
+    if sparse_in:
+        # sparse staging: feature sharding is declined (the sparse tier is
+        # sample-parallel; the facade forces the data-parallel path), and
+        # the intercept — when requested — joins as one extra nonzero slot
+        # per row, the sparse analogue of the true ones column
+        Xs, n = shard_sparse_rows(X, mesh=mesh, dtype=dtype)
+        if append_ones:
+            from dask_ml_tpu.ops.sparse import add_intercept_ell
+
+            Xs = add_intercept_ell(Xs)
+    elif shard_features and mesh_lib.n_model_shards(mesh) > 1:
         Xs, n, d = shard_2d(X, mesh=mesh, dtype=dtype)
     else:
         Xs, n = shard_rows(X, mesh=mesh, dtype=dtype)

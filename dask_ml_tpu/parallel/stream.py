@@ -60,6 +60,29 @@ from dask_ml_tpu.parallel.faults import BlockFetchError, Preempted
 __all__ = ["HostBlockSource", "prefetched_scan"]
 
 
+def _is_scipy_sparse(a) -> bool:
+    try:
+        import scipy.sparse
+
+        return scipy.sparse.issparse(a)
+    except ImportError:  # pragma: no cover
+        return False
+
+
+def _logical_nbytes(a) -> int:
+    """What this block element would weigh DENSE and uncast — the
+    baseline both wire wins (precision cast AND sparse encoding) are
+    measured against. A sparse element's logical bytes are its dense
+    n*d*itemsize equivalent; for dense arrays this is plain ``.nbytes``
+    (the pre-sparse behavior, unchanged)."""
+    from dask_ml_tpu.ops.sparse import SparseRows
+
+    if isinstance(a, SparseRows):
+        n, d = a.shape
+        return int(n) * int(d) * int(np.dtype(a.values.dtype).itemsize)
+    return int(a.nbytes)
+
+
 class _Compose:
     """Composition of two block transforms with stable hash/eq, so the
     consuming jitted step (which takes the transform as a static argument)
@@ -187,8 +210,30 @@ class HostBlockSource:
         self._arrays: Optional[tuple] = None
         # common per-block row count; loader mode learns it from block 0
         self._rows = None
+        # per-position ELL slot buckets for sparse block elements: fixed
+        # ONCE (arrays mode: from the whole matrix; loader mode: from the
+        # first block seen), so every block shares one (rows, k) shape and
+        # the consuming per-block program compiles once per epoch
+        # (docs/sparse.md)
+        self._ell_k: dict = {}
         if arrays is not None:
-            arrays = tuple(np.ascontiguousarray(a) for a in arrays)
+            from dask_ml_tpu.ops.sparse import SparseRows
+            from dask_ml_tpu.parallel import shapes as shapes_lib
+
+            prepped = []
+            for i, a in enumerate(arrays):
+                if _is_scipy_sparse(a):
+                    a = a.tocsr()
+                    row_nnz = np.diff(a.indptr)
+                    self._ell_k[i] = shapes_lib.bucket_nnz(
+                        int(row_nnz.max()) if a.shape[0] else 0)
+                elif isinstance(a, SparseRows):
+                    a = SparseRows(np.ascontiguousarray(a.values),
+                                   np.ascontiguousarray(a.cols), a.d)
+                else:
+                    a = np.ascontiguousarray(a)
+                prepped.append(a)
+            arrays = tuple(prepped)
             n = arrays[0].shape[0]
             for a in arrays[1:]:
                 if a.shape[0] != n:
@@ -244,13 +289,45 @@ class HostBlockSource:
             raise IndexError(f"block {b} out of range [0, {self.n_blocks})")
 
         def read():
+            from dask_ml_tpu.ops.sparse import SparseRows, ell_from_csr
+            from dask_ml_tpu.parallel import shapes as shapes_lib
+
+            def coerce(i, a):
+                if isinstance(a, SparseRows):
+                    return a
+                if _is_scipy_sparse(a):
+                    # loader-emitted sparse block: ELL-encode at a slot
+                    # bucket learned from the FIRST block seen for this
+                    # tuple position (all blocks must share it — a later
+                    # block with a wider row raises ell_from_csr's loud
+                    # "widen k" instead of silently recompiling per block)
+                    a = a.tocsr()
+                    key = ("loader", i)
+                    k = self._ell_k.get(key)
+                    if k is None:
+                        row_nnz = np.diff(a.indptr)
+                        k = shapes_lib.bucket_nnz(
+                            int(row_nnz.max()) if a.shape[0] else 0)
+                        self._ell_k[key] = k
+                    return ell_from_csr(a, k=k)
+                return np.asarray(a)
+
             if self.fault_injector is not None:
                 self.fault_injector.on_load(b)
             if self._arrays is not None:
                 s = b * self._rows
-                blk = tuple(a[s:s + self._rows] for a in self._arrays)
+                blk = []
+                for i, a in enumerate(self._arrays):
+                    part = a[s:s + self._rows]
+                    if _is_scipy_sparse(part):
+                        # the block's wire encoding: the CSR slice as ELL
+                        # indices+values at the SOURCE-WIDE slot bucket
+                        part = ell_from_csr(part, k=self._ell_k[i])
+                    blk.append(part)
+                blk = tuple(blk)
             else:
-                blk = tuple(np.asarray(a) for a in self._loader(b))
+                blk = tuple(coerce(i, a)
+                            for i, a in enumerate(self._loader(b)))
             return self._pad_block(b, blk)
 
         if self.retry_policy is None:
@@ -281,7 +358,9 @@ class HostBlockSource:
                 # the peek is a real block-0 load: keep the deterministic
                 # drill's load schedule honest
                 self.fault_injector.on_load(0)
-            self._rows = int(np.asarray(self._loader(0)[0]).shape[0])
+            first = self._loader(0)[0]
+            self._rows = int(first.shape[0] if hasattr(first, "shape")
+                             else np.asarray(first).shape[0])
         if rows == self._rows:
             return blk
         if rows > self._rows:
@@ -309,8 +388,10 @@ class HostBlockSource:
         cached = getattr(self, "_out_struct", None)
         if cached is not None:
             return cached
-        structs = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
-                        for a in self._cast_wire(self.host_block(0)))
+        structs = tuple(
+            jax.tree_util.tree_map(
+                lambda leaf: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype), a)
+            for a in self._cast_wire(self.host_block(0)))
         if self.transform is not None:
             structs = jax.eval_shape(self.transform, structs)
         self._out_struct = tuple(structs)
@@ -329,7 +410,11 @@ class HostBlockSource:
             return
         with telemetry.span("stream.transfer", block=b):
             blk = self.host_block(b)
-            logical = sum(int(a.nbytes) for a in blk)
+            # logical = dense-and-uncast equivalent bytes: for sparse
+            # elements the dense n*d*itemsize the same block would have
+            # weighed, so logical/wire IS the combined sparse+precision
+            # wire win the bench gates on (docs/sparse.md)
+            logical = sum(_logical_nbytes(a) for a in blk)
             # the wire cast happens HERE, after the (exact) host read and
             # before the transfer: wire bytes are what actually cross the
             # link

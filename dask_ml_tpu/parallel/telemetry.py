@@ -610,9 +610,12 @@ def telemetry_report() -> dict:
 
     compile_ = dict(compile_stats())
     # json object keys are strings; stringify the bucket sizes here so the
-    # report round-trips through json unchanged
+    # report round-trips through json unchanged (nnz_buckets are the
+    # sparse tier's ELL-width buckets — docs/sparse.md)
     compile_["shape_buckets"] = {
         str(k): v for k, v in compile_["shape_buckets"].items()}
+    compile_["nnz_buckets"] = {
+        str(k): v for k, v in compile_.get("nnz_buckets", {}).items()}
     with _lock:
         n_recorded, n_dropped, cap = len(_ring), _dropped, _ring.maxlen
     return {

@@ -6,6 +6,7 @@ from dask_ml_tpu.preprocessing.data import (  # noqa: F401
     Categorizer,
     DummyEncoder,
     MinMaxScaler,
+    OneHotEncoder,
     OrdinalEncoder,
     QuantileTransformer,
     RobustScaler,
@@ -20,6 +21,7 @@ __all__ = [
     "QuantileTransformer",
     "Categorizer",
     "DummyEncoder",
+    "OneHotEncoder",
     "OrdinalEncoder",
     "LabelEncoder",
 ]

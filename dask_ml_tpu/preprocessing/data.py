@@ -77,13 +77,59 @@ def _valid_rows(data):
     return data.X[: data.n]
 
 
+@jax.jit
+def _sparse_mean_var(A, w):
+    """Sparse column mean/variance from the nnz moments
+    (``ops.sparse.column_mean_var`` — the stable two-pass form; the
+    one-pass E[x^2]-mean^2 identity cancels in f32 for large-mean
+    columns), O(nnz) where the dense reduction is O(n*d). Same
+    handle-zeros-in-scale rule as the dense program."""
+    from dask_ml_tpu.ops import sparse as sparse_ops
+
+    mean, var, _ = sparse_ops.column_mean_var(A, w)
+    scale = jnp.sqrt(jnp.where(var == 0.0, 1.0, var))
+    return mean, var, scale
+
+
 class StandardScaler(skdata.StandardScaler):
     __doc__ = skdata.StandardScaler.__doc__
 
     def fit(self, X, y=None):
         from dask_ml_tpu.config import get_config
+        from dask_ml_tpu.ops import sparse as sparse_ops
+        from dask_ml_tpu.parallel.sharding import is_sparse_input
 
         self._reset()
+        if is_sparse_input(X):
+            # sparse tier (docs/sparse.md): centering would densify (every
+            # zero becomes -mean), so it is rejected exactly like sklearn
+            # rejects it; the variance comes from the nnz moments
+            if self.with_mean:
+                raise ValueError(
+                    "Cannot center sparse data (with_mean=True would "
+                    "densify every zero to -mean); construct "
+                    "StandardScaler(with_mean=False) for sparse inputs")
+            X = check_array(X, accept_sparse=True)
+            data = prepare_data(X)
+            if bool(sparse_ops.has_duplicate_slots(data.X)):
+                raise ValueError(
+                    "this sparse container stores some column twice in "
+                    "one row (duplicate slots sum in the linear "
+                    "contractions, but per-column VARIANCE cannot be "
+                    "computed slot-wise over them); re-canonicalize "
+                    "through scipy first: csr.sum_duplicates()")
+            mean, var, scale = _sparse_mean_var(data.X, data.weights)
+            if not get_config()["device_outputs"]:
+                var, scale = np.asarray(var), np.asarray(scale)
+            self.mean_ = None
+            if self.with_std:
+                self.var_ = var
+                self.scale_ = scale
+            else:
+                self.var_ = None
+                self.scale_ = None
+            self.n_samples_seen_ = data.n
+            return self
         X = check_array(X)
         data = prepare_data(X)
         mean, var, scale = _mean_var(data.X, data.weights)
@@ -113,7 +159,24 @@ class StandardScaler(skdata.StandardScaler):
         )
 
     def transform(self, X, y=None, copy=None):
+        from dask_ml_tpu.ops import sparse as sparse_ops
+        from dask_ml_tpu.parallel.sharding import is_sparse_input
+
         check_is_fitted(self, "n_samples_seen_")
+        if is_sparse_input(X):
+            if self.with_mean:
+                raise ValueError(
+                    "Cannot center sparse data; this scaler was "
+                    "constructed with with_mean=True")
+            X = check_array(X, accept_sparse=True)
+            Xs, n = shard_rows(X)
+            if self.with_std:
+                Xs = sparse_ops.scale_columns(
+                    Xs, jnp.asarray(self.scale_, jnp.float32))
+            # stays SPARSE: the sharded container feeds the GLM/search
+            # tier directly — the one-hot -> scale -> fit pipeline never
+            # materializes dense (docs/sparse.md)
+            return unpad_rows(Xs, n)
         X = check_array(X)
         Xs, n = shard_rows(X)
         if self.with_mean and self.with_std:
@@ -471,6 +534,121 @@ class DummyEncoder(BaseEstimator, TransformerMixin):
                 codes, dtype.categories, ordered=dtype.ordered)
         out = non_cat.assign(**cats)
         return out[list(self.columns_)]
+
+
+class OneHotEncoder(BaseEstimator, TransformerMixin):
+    """One-hot encode categorical feature columns — emitting the SHARDED
+    SPARSE container directly (docs/sparse.md).
+
+    The dense one-hot of d_in categorical columns with C total categories
+    is an (n, C) matrix that is exactly d_in/C dense (~0.1% at CTR-style
+    cardinalities) — the canonical way the "impossible dense" sparse GLM
+    inputs arise. ``transform`` therefore emits a host
+    :class:`~dask_ml_tpu.ops.sparse.SparseRows` in blocked-ELL layout with
+    k = d_in slots per row (every row has EXACTLY one nonzero per input
+    column — the ELL layout's best case, zero slot waste before
+    bucketing): the GLMs, the sparse ``StandardScaler`` and the search
+    driver consume it natively, so the one-hot -> (scale) -> fit pipeline
+    never materializes a dense row. ``sparse_output=False`` returns the
+    dense numpy one-hot for small/debug use.
+
+    ``handle_unknown='ignore'`` maps unseen categories to an inert slot
+    (value 0 — the row simply lacks that column's indicator, exactly like
+    sklearn's all-zero block); ``'error'`` (default) raises.
+    """
+
+    def __init__(self, categories="auto", dtype=np.float32,
+                 handle_unknown="error", sparse_output=True):
+        self.categories = categories
+        self.dtype = dtype
+        self.handle_unknown = handle_unknown
+        self.sparse_output = sparse_output
+
+    def _check_X(self, X):
+        if hasattr(X, "iloc"):
+            X = X.values
+        X = np.asarray(X)
+        if X.ndim != 2:
+            raise ValueError(
+                f"Expected 2D array of categorical columns, got {X.ndim}D")
+        return X
+
+    def fit(self, X, y=None):
+        if self.handle_unknown not in ("error", "ignore"):
+            raise ValueError(
+                f"handle_unknown must be 'error' or 'ignore', got "
+                f"{self.handle_unknown!r}")
+        X = self._check_X(X)
+        n_cols = X.shape[1]
+        # isinstance first: an ndarray `categories` would broadcast the
+        # == "auto" comparison elementwise and raise on truth-testing
+        if isinstance(self.categories, str) and self.categories == "auto":
+            cats = [np.unique(X[:, j]) for j in range(n_cols)]
+        else:
+            if len(self.categories) != n_cols:
+                raise ValueError(
+                    f"categories has {len(self.categories)} entries for "
+                    f"{n_cols} columns")
+            cats = [np.asarray(c) for c in self.categories]
+        self.categories_ = cats
+        self.n_features_in_ = n_cols
+        # column j's indicators occupy feature ids offset_[j] ..
+        # offset_[j] + len(cats[j]) - 1 in the encoded space
+        sizes = np.array([len(c) for c in cats], dtype=np.int64)
+        self._offsets = np.concatenate([[0], np.cumsum(sizes)[:-1]])
+        self._n_out = int(sizes.sum())
+        self._sorters = [np.argsort(c, kind="stable") for c in cats]
+        return self
+
+    def _column_codes(self, col, j):
+        """Codes of one raw column against the fitted categories; -1 marks
+        unknown (inert slot under handle_unknown='ignore')."""
+        cat, sorter = self.categories_[j], self._sorters[j]
+        pos = np.searchsorted(cat, col, sorter=sorter)
+        pos = np.clip(pos, 0, len(cat) - 1)
+        code = sorter[pos]
+        found = cat[code] == col
+        if not found.all():
+            if self.handle_unknown == "error":
+                bad = np.unique(np.asarray(col)[~found])[:5]
+                raise ValueError(
+                    f"Found unknown categories {bad.tolist()} in column "
+                    f"{j} during transform")
+            code = np.where(found, code, -1)
+        return code
+
+    def transform(self, X, y=None):
+        check_is_fitted(self, "categories_")
+        X = self._check_X(X)
+        if X.shape[1] != self.n_features_in_:
+            raise ValueError(
+                f"X has {X.shape[1]} features, but OneHotEncoder was "
+                f"fitted with {self.n_features_in_}")
+        from dask_ml_tpu.ops.sparse import SparseRows
+
+        n, k = X.shape
+        values = np.ones((n, k), np.dtype(self.dtype))
+        cols = np.zeros((n, k), np.int32)
+        for j in range(k):
+            code = self._column_codes(X[:, j], j)
+            known = code >= 0
+            cols[:, j] = np.where(known, self._offsets[j] + code, 0)
+            if not known.all():
+                values[:, j] = np.where(known, values[:, j], 0)
+        out = SparseRows(values, cols, self._n_out)
+        if self.sparse_output:
+            return out
+        dense = np.zeros((n, self._n_out), values.dtype)
+        np.add.at(dense, (np.arange(n)[:, None], cols), values)
+        return dense
+
+    def get_feature_names_out(self, input_features=None):
+        names = []
+        for j, cat in enumerate(self.categories_):
+            base = (input_features[j] if input_features is not None
+                    else f"x{j}")
+            names.extend(f"{base}_{c}" for c in cat)
+        return np.asarray(names, dtype=object)
 
 
 class OrdinalEncoder(BaseEstimator, TransformerMixin):

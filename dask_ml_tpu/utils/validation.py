@@ -26,6 +26,7 @@ def check_array(
     force_all_finite: bool = True,
     dtype: Optional[jnp.dtype] = None,
     min_samples: int = 1,
+    accept_sparse: bool = False,
 ):
     """Validate an input array and return it staging-ready.
 
@@ -35,6 +36,15 @@ def check_array(
     once per distinct ``(n, d)``) ever runs for them. Device
     (``jax.Array``) inputs keep the fused on-device scan, so
     ``device_outputs`` pipelines never materialize to host here.
+
+    ``accept_sparse=True`` (the sparse-capable callers: GLMs, the sparse
+    scaler/encoder, the search prep) passes scipy CSR through WITHOUT
+    densifying: validation runs over ``.data`` only (finiteness, dtype
+    coercion — indices are exact ints and stay untouched), in O(nnz).
+    CSC — column-major, the wrong layout for sample-axis sharding — is
+    rejected with the conversion spelled out rather than silently
+    transposed or densified. The default (``False``) keeps the loud
+    dense-only error for estimators whose kernels have no sparse path.
 
     Dtype policy (TPU-first): integer and float64 inputs are converted to
     float32 unless an explicit ``dtype`` is given — the reference similarly
@@ -56,13 +66,14 @@ def check_array(
     if memo is not None:
         return memo.get_or_stage(
             ("check", id(X), ensure_2d, allow_nd, force_all_finite,
-             str(dtype), min_samples),
+             str(dtype), min_samples, accept_sparse),
             (X,),
             lambda: _check_array_impl(X, ensure_2d, allow_nd,
-                                      force_all_finite, dtype, min_samples),
+                                      force_all_finite, dtype, min_samples,
+                                      accept_sparse),
         )
     return _check_array_impl(X, ensure_2d, allow_nd, force_all_finite, dtype,
-                             min_samples)
+                             min_samples, accept_sparse)
 
 
 def staging_dtype(np_dtype):
@@ -81,18 +92,125 @@ def staging_dtype(np_dtype):
 
 
 def _check_array_impl(X, ensure_2d, allow_nd, force_all_finite, dtype,
-                      min_samples):
+                      min_samples, accept_sparse=False):
     import scipy.sparse
 
+    from dask_ml_tpu.ops.sparse import SparseRows
+
+    if isinstance(X, SparseRows):
+        # an already-encoded sparse container (our own encoders, or
+        # user-built): validated like every other input — dtype coercion
+        # and finiteness run over the VALUES leaf only, O(nnz)
+        if not accept_sparse:
+            raise TypeError(
+                "this estimator has no sparse kernel path; SparseRows "
+                "containers are accepted by the GLMs, StandardScaler"
+                "(with_mean=False), and OneHotEncoder (docs/sparse.md)")
+        if X.shape[0] < min_samples:
+            raise ValueError(
+                f"Found array with {X.shape[0]} sample(s) while a minimum "
+                f"of {min_samples} is required")
+        vals = X.values
+        if int(np.prod(X.cols.shape)):
+            # structural validity of the indices leaf: an out-of-range
+            # column would not raise downstream — XLA gathers clamp and
+            # segment_sum drops — silently fitting wrong coefficients.
+            # Host leaves reduce in numpy; device leaves through one
+            # fused jitted reduction (two scalars fetched, never the leaf)
+            if isinstance(X.cols, np.ndarray):
+                cmin, cmax = int(X.cols.min()), int(X.cols.max())
+            else:
+                cmin, cmax = (int(v) for v in _min_max_scalar(X.cols))
+            if cmin < 0 or cmax >= X.d:
+                raise ValueError(
+                    f"SparseRows column indices must lie in [0, {X.d}); "
+                    f"found range [{cmin}, {cmax}]")
+        if isinstance(vals, np.ndarray):
+            kind = np.dtype(vals.dtype).kind
+            if dtype is None:
+                if kind not in "fiub":
+                    raise ValueError(f"Unsupported dtype {vals.dtype}")
+                dtype = staging_dtype(vals.dtype)
+            if dtype is not None and vals.dtype != np.dtype(dtype):
+                # e.g. an integer-valued OneHotEncoder(dtype=int) output:
+                # without the cast, matvec would truncate the f32
+                # coefficient vector to the values' integer dtype
+                vals = vals.astype(dtype)
+            if force_all_finite and np.dtype(vals.dtype).kind == "f":
+                try:
+                    finite = bool(np.isfinite(vals).all())
+                except TypeError:  # exotic float without ufunc support
+                    finite = bool(np.isfinite(
+                        vals.astype(np.float32, copy=False)).all())
+                if not finite:
+                    raise ValueError("Input contains NaN or infinity")
+            if vals is X.values:
+                return X
+            return SparseRows(vals, X.cols, X.d)
+        # device-staged container (scaler output, staged data): coerce
+        # low-precision-safe dtype and keep the fused finite scan
+        if dtype is None and jnp.dtype(vals.dtype).kind in "iub":
+            vals = vals.astype(jnp.float32)
+        elif dtype is not None and vals.dtype != jnp.dtype(dtype):
+            vals = vals.astype(dtype)
+        if force_all_finite and jnp.dtype(vals.dtype).kind == "f":
+            if not bool(_all_finite(vals)):
+                raise ValueError("Input contains NaN or infinity")
+        if vals is X.values:
+            return X
+        return SparseRows(vals, X.cols, X.d)
     if scipy.sparse.issparse(X):
-        # np.asarray on a scipy matrix yields a 0-d object array and a
-        # baffling downstream crash; fail with the real story instead
-        raise TypeError(
-            "scipy.sparse input is not supported by jax-native estimators "
-            "(dense device staging only); densify with .toarray(), or keep "
-            "a scikit-learn estimator for sparse data — the search driver "
-            "and wrappers pass sparse through to foreign estimators"
-        )
+        if not accept_sparse:
+            # np.asarray on a scipy matrix yields a 0-d object array and a
+            # baffling downstream crash; fail with the real story instead
+            raise TypeError(
+                "scipy.sparse input is not supported by this estimator "
+                "(dense device staging only); densify with .toarray(), or "
+                "keep a scikit-learn estimator for sparse data — the "
+                "search driver and wrappers pass sparse through to foreign "
+                "estimators. The GLMs, StandardScaler(with_mean=False) and "
+                "OneHotEncoder accept CSR natively (docs/sparse.md)"
+            )
+        if X.format != "csr":
+            raise TypeError(
+                f"sparse input must be CSR (row-major — the layout the "
+                f"sample-axis sharding and the blocked-ELL wire encoding "
+                f"need); got {X.format.upper()}. Convert with X.tocsr() "
+                "(an O(nnz) host-side re-index, done once, never a "
+                "densify)")
+        if X.ndim != 2:  # pragma: no cover - scipy matrices are always 2-D
+            raise ValueError(f"Expected 2D sparse matrix, got {X.ndim}D")
+        if X.shape[0] < min_samples:
+            raise ValueError(
+                f"Found array with {X.shape[0]} sample(s) while a minimum "
+                f"of {min_samples} is required")
+        if X.indices.size and (int(X.indices.min()) < 0
+                               or int(X.indices.max()) >= X.shape[1]):
+            # scipy's constructor does not bounds-check index CONTENTS;
+            # downstream XLA gathers would clamp and segment_sum would
+            # drop out-of-range ids — fitting wrong coefficients silently
+            raise ValueError(
+                f"CSR column indices must lie in [0, {X.shape[1]}); "
+                f"found range [{int(X.indices.min())}, "
+                f"{int(X.indices.max())}]")
+        data = X.data
+        kind = np.dtype(data.dtype).kind
+        if dtype is None:
+            if kind not in "fiub":
+                raise ValueError(f"Unsupported dtype {data.dtype}")
+            dtype = staging_dtype(data.dtype)
+        if dtype is not None and data.dtype != np.dtype(dtype):
+            data = data.astype(dtype)
+        # finiteness over the NONZEROS only — O(nnz), the whole point of
+        # accepting sparse (explicit zeros are finite by construction);
+        # post-cast, so a narrowing-cast overflow is still caught
+        if force_all_finite and np.dtype(data.dtype).kind == "f":
+            if not bool(np.isfinite(data).all()):
+                raise ValueError("Input contains NaN or infinity")
+        if data is X.data:
+            return X
+        return scipy.sparse.csr_matrix(
+            (data, X.indices, X.indptr), shape=X.shape)
     arr = np.asarray(X) if not isinstance(X, jax.Array) else X
     if ensure_2d and arr.ndim != 2:
         raise ValueError(
@@ -156,6 +274,11 @@ def _check_array_impl(X, ensure_2d, allow_nd, force_all_finite, dtype,
 @jax.jit
 def _all_finite(x):
     return jnp.isfinite(x).all()
+
+
+@jax.jit
+def _min_max_scalar(x):
+    return jnp.min(x), jnp.max(x)
 
 
 KeyArray = jax.Array

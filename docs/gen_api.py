@@ -58,6 +58,15 @@ SECTIONS = [
      "scale with buckets instead of distinct shapes — plus jax.monitoring "
      "compile counters and the persistent-compilation-cache hook; see "
      "docs/compile.md for the policy and the CI gate."),
+    ("dask_ml_tpu.ops.sparse", "Sparse kernels & container",
+     "The sparse execution tier's kernel layer: the sharded blocked-ELL "
+     "SparseRows container (values+indices, per-row nnz slots padded to "
+     "power-of-two buckets), the XLA gather/segment-sum reference "
+     "contractions (matvec/matmat/pullback/weighted Gram, f32 "
+     "accumulation), the Pallas blocked-ELL SpMM with its segment-sum "
+     "custom VJP, and the per-trace collective metering scope — see "
+     "docs/sparse.md for the layout, bucketing, wire format, and when "
+     "sparse wins."),
     ("dask_ml_tpu.parallel.precision", "Mixed precision",
      "The bf16-wire/bf16-compute/f32-accumulation execution policy "
      "(storage, compute, and accumulation dtypes plus per-op overrides), "
