@@ -2693,6 +2693,380 @@ def bench_serving(_rtt):
             + ", ".join(g for g, v in gates.items() if not v))
 
 
+def bench_fleet(_rtt):
+    """Serving-fleet drill (docs/serving.md, "The serving fleet"): the
+    closed-loop load generator against a replicated, health-checked,
+    SLO-routed :class:`ServingFleet` — with a mid-run hot-swap, a mid-run
+    replica kill, an injected over-capacity burst, and a graceful drain,
+    all in ONE run (ROADMAP item 2's kill-drill gate).
+
+    Phases:
+    1. fit four families, register on a fleet of ``FLEET_REPLICAS``
+       replicas over disjoint device subsets, ``warmup()`` everywhere;
+    2. identity phase: fleet results pinned bit-for-bit against the
+       direct paths across ragged sizes (whichever replica answers), and
+       a wire-protocol client round-trip pinned the same way;
+    3. steady state: C closed-loop clients x R mixed-priority requests
+       (1/3 high-priority with a deadline, 1/3 deadline-only, 1/3
+       best-effort) from a seeded trace. Mid-run, a coordinator
+       (a) HOT-SWAPS the logistic model to a differently-regularized
+       refit — new version pre-warmed, then atomically installed — and
+       (b) KILLS one replica via ``FaultInjector.kill_replica`` once a
+       third of traffic has completed / half completed respectively;
+    4. over-capacity burst: ``FLEET_BURST`` requests whose deadline is
+       already past — every one must shed with ``DeadlineExceeded``,
+       and ONLY those may shed;
+    5. drain: ``GracefulDrain.request()`` (the deterministic SIGTERM) —
+       every surviving replica flushes and stops, later submits are
+       rejected.
+
+    Gates (nonzero exit on failure):
+    (a) >= 3 replicas (2 allowed only under the CI scale-down env);
+    (b) every steady-state result bit-identical to the direct path —
+        for the swapped model, to the OLD or NEW version's direct path,
+        with BOTH versions observed;
+    (c) replica kill delivered exactly once, the fleet ends the run with
+        exactly one replica down, and ZERO requests dropped (every
+        future resolved: a result or the burst's DeadlineExceeded);
+    (d) p99 latency of non-shed traffic within the SLO
+        (``FLEET_P99_SLO_MS``, default 5000) and within 10x the
+        committed FLEET_r01.json p99 (500 ms floor) when one exists;
+    (e) shed count EXACTLY equals the injected burst (fleet counter and
+        telemetry mirror agree);
+    (f) drain leaves every surviving replica stopped with an empty
+        queue and post-drain submits rejected.
+    """
+    import threading
+
+    import jax
+
+    from dask_ml_tpu import config as config_lib
+    from dask_ml_tpu.cluster import KMeans
+    from dask_ml_tpu.decomposition import PCA
+    from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.parallel import telemetry
+    from dask_ml_tpu.parallel.faults import FaultInjector, GracefulDrain
+    from dask_ml_tpu.parallel.fleet import (
+        FleetClient,
+        FleetServer,
+        ServingFleet,
+    )
+    from dask_ml_tpu.parallel.serving import (
+        DeadlineExceeded,
+        ServingClosed,
+    )
+
+    n_fit, d = 4096, 32
+    replicas = int(os.environ.get("FLEET_REPLICAS", "3"))
+    clients = int(os.environ.get("FLEET_CLIENTS", "24"))
+    reqs_per_client = int(os.environ.get("FLEET_REQS", "24"))
+    burst = int(os.environ.get("FLEET_BURST", "40"))
+    slo_budget_s = float(os.environ.get("FLEET_SLO_S", "30.0"))
+    p99_slo_ms = float(os.environ.get("FLEET_P99_SLO_MS", "5000.0"))
+    max_batch_rows = 1024
+
+    rng = np.random.RandomState(0)
+    X = rng.standard_normal((n_fit, d)).astype(np.float32)
+    y = (X @ rng.standard_normal(d).astype(np.float32) > 0).astype(np.int32)
+
+    km = KMeans(n_clusters=16, random_state=0, max_iter=10).fit(X)
+    lr = LogisticRegression(max_iter=30).fit(X, y)
+    lr2 = LogisticRegression(max_iter=60, C=0.3).fit(X, y)  # the swap-in
+    pca = PCA(n_components=8, random_state=0).fit(X)
+    direct = {
+        ("kmeans", "predict"): km.predict,
+        ("logistic", "predict"): lr.predict,
+        ("logistic", "predict_proba"): lr.predict_proba,
+        ("pca", "transform"): pca.transform,
+    }
+    direct_new = {
+        ("logistic", "predict"): lr2.predict,
+        ("logistic", "predict_proba"): lr2.predict_proba,
+    }
+
+    keys = sorted(direct)
+    size_choices = [1, 2, 4, 8, 16, 24, 32, 48, 64, 96, 128]
+    trng = np.random.RandomState(42)
+    trace = []
+    for c in range(clients):
+        rows = []
+        for r in range(reqs_per_client):
+            key = keys[trng.randint(len(keys))]
+            size = int(size_choices[trng.randint(len(size_choices))])
+            # mixed priorities: 1/3 high-priority + deadline, 1/3
+            # deadline-only, 1/3 best-effort
+            tier = (c * reqs_per_client + r) % 3
+            rows.append((key, int(trng.randint(0, n_fit - size)), size,
+                         tier))
+        trace.append(rows)
+    total_requests = clients * reqs_per_client
+
+    fi = FaultInjector()
+    drain = GracefulDrain()
+    identity_failures = []
+    wire_failures = []
+    swap_info = {}
+    kill_info = {}
+
+    with config_lib.config_context(telemetry=True):
+        telemetry.reset_telemetry(ring_capacity=65_536)
+        fleet = ServingFleet(
+            n_replicas=replicas, max_batch_rows=max_batch_rows,
+            fault_injector=fi, drain=drain,
+            heartbeat_interval_s=0.02).start()
+        fleet.register("kmeans", km)
+        fleet.register("logistic", lr)
+        fleet.register("pca", pca)
+        warm = fleet.warmup()
+
+        # -- identity gate: fleet + wire vs the direct paths --------------
+        for (name, method), fn in direct.items():
+            for nreq in (1, 3, 32, 33, 100, 255, 256, 500):
+                served = fleet.submit(
+                    name, X[:nreq], method=method).result(300)
+                if not np.array_equal(served, fn(X[:nreq])):
+                    identity_failures.append((name, method, nreq))
+        server = FleetServer(fleet).start()
+        with FleetClient(server.address) as cli:
+            for (name, method), fn in direct.items():
+                for nreq in (1, 33, 200):
+                    out = cli.call(name, X[:nreq], method=method,
+                                   timeout=300)
+                    if not np.array_equal(out, fn(X[:nreq])):
+                        wire_failures.append((name, method, nreq))
+        server.stop()
+
+        # -- steady state: mixed-priority closed loop + mid-run events ----
+        completed = [0]
+        clock = threading.Lock()
+        lat: list = []
+        outcomes: list = []  # (key, off, size, ndarray result)
+        errors: list = []
+        start_evt = threading.Event()
+
+        def client(rows):
+            mine_lat, mine_out = [], []
+            start_evt.wait()
+            for key, off, size, tier in rows:
+                name, method = key
+                kw = {}
+                if tier == 0:
+                    kw = {"priority": 5, "deadline": slo_budget_s}
+                elif tier == 1:
+                    kw = {"deadline": slo_budget_s}
+                t0 = time.perf_counter()
+                try:
+                    out = fleet.submit(
+                        name, X[off:off + size], method=method,
+                        **kw).result(300)
+                except Exception as e:  # noqa: BLE001 — gate on these
+                    errors.append((key, off, size, repr(e)))
+                    continue
+                mine_lat.append(time.perf_counter() - t0)
+                mine_out.append((key, off, size, out))
+                with clock:
+                    completed[0] += 1
+            with clock:
+                lat.extend(mine_lat)
+                outcomes.extend(mine_out)
+
+        def coordinator():
+            # hot-swap at ~1/3 of traffic
+            while completed[0] < total_requests // 3:
+                time.sleep(0.002)
+            t0 = time.perf_counter()
+            new_version = fleet.swap("logistic", lr2)
+            swap_info.update(
+                version=new_version,
+                at_completed=completed[0],
+                swap_seconds=round(time.perf_counter() - t0, 4))
+            # replica kill at ~1/2: arm the injector for the busiest
+            # live replica's NEXT batch
+            while completed[0] < total_requests // 2:
+                time.sleep(0.002)
+            victim = max(
+                (r for r in fleet._replicas if not r.dead
+                 and r.loop.alive()),
+                key=lambda r: r.loop.n_batches)
+            fi.kill_replica(victim.name,
+                            after_batches=victim.loop.n_batches)
+            kill_info.update(victim=victim.name,
+                             at_completed=completed[0])
+
+        threads = [threading.Thread(target=client, args=(rows,))
+                   for rows in trace]
+        coord = threading.Thread(target=coordinator)
+        for t in threads:
+            t.start()
+        coord.start()
+        t0 = time.perf_counter()
+        start_evt.set()
+        for t in threads:
+            t.join()
+        serve_elapsed = time.perf_counter() - t0
+        coord.join(30)
+
+        # wait out the monitor's death detection
+        deadline_t = time.monotonic() + 10.0
+        while fleet.replicas_up() > replicas - 1 \
+                and time.monotonic() < deadline_t:
+            time.sleep(0.02)
+        kill_info.update(replicas_up_after=fleet.replicas_up(),
+                         injected=fi.injected["replica_kill"],
+                         deaths=fleet.n_replica_deaths,
+                         reroutes=fleet.n_reroutes)
+
+        # -- over-capacity burst: every request past-deadline, all shed --
+        shed_before = fleet.n_shed
+        burst_shed = 0
+        for _ in range(burst):
+            try:
+                fleet.submit("kmeans", X[:8], deadline=-1.0)
+            except DeadlineExceeded:
+                burst_shed += 1
+        shed_total = fleet.n_shed
+
+        # -- graceful drain: flush, stop, reject ---------------------------
+        drain.request()
+        deadline_t = time.monotonic() + 15.0
+        survivors = [r for r in fleet._replicas if not r.dead]
+        while time.monotonic() < deadline_t and not all(
+                r.loop.stopped for r in survivors):
+            time.sleep(0.02)
+        drain_stopped = all(r.loop.stopped for r in survivors)
+        drain_queues_empty = all(
+            r.loop.queue_depth() == 0 for r in survivors)
+        try:
+            fleet.submit("kmeans", X[:8])
+            drain_rejects = False
+        except ServingClosed:  # ServingStopped is a subclass
+            drain_rejects = True
+        fleet_stats = fleet.stats()
+        fleet.stop()
+        report = telemetry.telemetry_report()
+
+    # -- verification ------------------------------------------------------
+    n_old = n_new = n_mismatch = 0
+    direct_cache: dict = {}
+    for key, off, size, out in outcomes:
+        ck = (key, off, size)
+        if ck not in direct_cache:
+            old = direct[key](X[off:off + size])
+            new = (direct_new[key](X[off:off + size])
+                   if key in direct_new else None)
+            direct_cache[ck] = (old, new)
+        old, new = direct_cache[ck]
+        if np.array_equal(out, old):
+            n_old += 1
+        elif new is not None and np.array_equal(out, new):
+            n_new += 1
+        else:
+            n_mismatch += 1
+    resolved = len(outcomes)
+    dropped = total_requests - resolved - len(errors)
+
+    qps = resolved / serve_elapsed
+    p50_ms, p99_ms = (float(v) * 1e3
+                      for v in np.percentile(lat, [50, 99]))
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "FLEET_r01.json")
+    committed_p99 = None
+    if os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                committed_p99 = json.load(f).get("p99_ms")
+        except Exception:
+            committed_p99 = None
+    p99_budget_ms = p99_slo_ms
+    if committed_p99 is not None:
+        p99_budget_ms = min(p99_budget_ms,
+                            max(10.0 * committed_p99, 500.0))
+
+    counters = report["metrics"]["counters"]
+    mirror_shed = sum(v for k, v in counters.items()
+                      if k.startswith("fleet.shed"))
+    scaled_down = "FLEET_REPLICAS" in os.environ
+    gates = {
+        "fleet_of_three_replicas":
+            replicas >= (2 if scaled_down else 3),
+        "served_bit_identical_to_direct":
+            not identity_failures and not wire_failures
+            and n_mismatch == 0,
+        "hot_swap_no_request_lost":
+            bool(swap_info.get("version")) and n_old > 0 and n_new > 0
+            and not errors,
+        "replica_kill_failover":
+            kill_info.get("injected") == 1
+            and kill_info.get("deaths") == 1
+            and kill_info.get("replicas_up_after") == replicas - 1,
+        "zero_dropped_requests":
+            dropped == 0 and not errors,
+        "p99_within_slo": p99_ms <= p99_budget_ms,
+        "shed_exactly_the_burst":
+            burst_shed == burst
+            and shed_total - shed_before == burst
+            and mirror_shed == shed_total,
+        "drain_flushes_and_rejects":
+            drain_stopped and drain_queues_empty and drain_rejects,
+    }
+    rec = {
+        "metric": "fleet_drill",
+        "value": round(qps, 1),
+        "unit": "sustained QPS across the fleet (mixed-priority, with "
+                "mid-run swap + kill)",
+        "vs_baseline": None,  # robustness drill: the gates ARE the result
+        "backend": jax.default_backend(),
+        "all_gates_pass": all(gates.values()),
+        "gates": gates,
+        "replicas": replicas,
+        "devices_per_replica": [
+            int(np.prod(list(r.mesh.shape.values())))
+            for r in fleet._replicas],
+        "clients": clients, "reqs_per_client": reqs_per_client,
+        "total_requests": total_requests,
+        "resolved": resolved, "dropped": dropped,
+        "errors": errors[:10],
+        "warmup": warm,
+        "qps": round(qps, 1),
+        "p50_ms": round(p50_ms, 3),
+        "p99_ms": round(p99_ms, 3),
+        "p99_budget_ms": p99_budget_ms,
+        "slo_budget_s": slo_budget_s,
+        "results_old_version": n_old,
+        "results_new_version": n_new,
+        "results_mismatched": n_mismatch,
+        "swap": swap_info,
+        "kill": kill_info,
+        "burst_injected": burst,
+        "burst_shed": burst_shed,
+        "shed_total": shed_total,
+        "telemetry_shed_mirror": mirror_shed,
+        "spillovers": fleet_stats["spillovers"],
+        "reroutes": fleet_stats["reroutes"],
+        "per_replica_batches": {
+            name: r["batches"]
+            for name, r in fleet_stats["replicas"].items()},
+        "identity_failures": identity_failures,
+        "wire_failures": wire_failures,
+        "replica_up_gauge": report["metrics"]["gauges"].get(
+            "fleet.replica_up"),
+        "note": "closed-loop mixed-priority clients; the logistic model "
+                "hot-swaps to a differently-regularized refit at ~1/3 of "
+                "traffic (old/new version counts prove both served), one "
+                "replica is killed via FaultInjector at ~1/2, the burst "
+                "arrives past-deadline so it must shed EXACTLY, and the "
+                "run ends in a GracefulDrain. Scaled down in CI via "
+                "FLEET_REPLICAS/FLEET_CLIENTS/FLEET_REQS.",
+    }
+    emit(rec)
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=1)
+    if not all(gates.values()):
+        raise SystemExit(
+            "fleet drill: failed gates: "
+            + ", ".join(g for g, v in gates.items() if not v))
+
+
 # ---------------------------------------------------------------------------
 # KDD-Cup'99 harness (the reference's flagship real-data benchmark,
 # benchmarks/k_means_kdd.py:95-125: KMeans(n_clusters=8,
@@ -3311,9 +3685,15 @@ if __name__ == "__main__":
         # online-serving drill (ISSUE 9); CI's serving job runs this
         # scaled down: identity + zero-recompile + QPS-speedup + p99
         # gates, nonzero exit on any gate failure (committed as
-        # SERVING_r01.json)
+        # SERVING_r01.json). With --fleet it instead runs the serving-
+        # FLEET kill drill (ISSUE 14): replica sharding + SLO routing +
+        # mid-run hot-swap + replica kill + exact-shed burst + drain,
+        # committed as FLEET_r01.json
         _enable_compilation_cache()
-        bench_serving(measure_rtt())
+        if "--fleet" in sys.argv:
+            bench_fleet(measure_rtt())
+        else:
+            bench_serving(measure_rtt())
         emit_summary()
     elif "--sparse" in sys.argv:
         # sparse-tier drill (ISSUE 13); CI's sparse job runs this scaled

@@ -30,11 +30,9 @@ truncation-tolerant reader, so a kill mid-write never corrupts a restart.
 
 from __future__ import annotations
 
-import hashlib
 import logging
 import os
 import pickle
-import struct
 import tempfile
 import threading
 from typing import Any, Optional
@@ -42,6 +40,15 @@ from typing import Any, Optional
 import numpy as np
 
 logger = logging.getLogger(__name__)
+
+
+def _framing():
+    """The shared frame codec, imported lazily: pulling the parallel
+    package at module scope would drag jax into every process that merely
+    imports this module (the faults layer's loader-process contract)."""
+    from dask_ml_tpu.parallel import framing
+
+    return framing
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -56,7 +63,9 @@ class CheckpointCorruptError(RuntimeError):
 # atomic pytree snapshots
 # ---------------------------------------------------------------------------
 
-#: framed snapshot header: magic + 8-byte payload length + sha256 digest.
+#: framed snapshot header: magic + 8-byte payload length + sha256 digest
+#: (the shared codec in :mod:`dask_ml_tpu.parallel.framing` — the serving
+#: wire protocol speaks the same frame layout under its own magic).
 #: The frame is what turns "atomic rename" into an end-to-end guarantee —
 #: rename protects against a kill mid-save, the checksum protects against
 #: everything else (a torn copy, a truncated transfer off shared storage,
@@ -89,15 +98,13 @@ def save_pytree(path: str, tree: Any, meta: Optional[dict] = None) -> None:
     """
     payload = {"tree": _to_host(tree), "meta": meta or {}}
     body = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
-    header = (_SNAPSHOT_MAGIC + struct.pack(">Q", len(body))
-              + hashlib.sha256(body).digest())
+    frame = _framing().encode_frame(body, magic=_SNAPSHOT_MAGIC)
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".ckpt.tmp")
     try:
         with os.fdopen(fd, "wb") as f:
-            f.write(header)
-            f.write(body)
+            f.write(frame)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
@@ -126,24 +133,14 @@ def load_pytree(path: str):
     with open(path, "rb") as f:
         head = f.read(len(_SNAPSHOT_MAGIC))
         if head == _SNAPSHOT_MAGIC:
-            rest = f.read()
-            if len(rest) < 8 + 32:
+            framing = _framing()
+            data = head + f.read()
+            try:
+                body = framing.decode_frame(data, magic=_SNAPSHOT_MAGIC)
+            except framing.FrameError as e:
                 raise CheckpointCorruptError(
-                    f"checkpoint {path}: truncated header "
-                    f"({len(head) + len(rest)} bytes) — the snapshot is "
-                    "torn; delete it to restart from scratch")
-            (length,) = struct.unpack(">Q", rest[:8])
-            digest, body = rest[8:40], rest[40:]
-            if len(body) != length:
-                raise CheckpointCorruptError(
-                    f"checkpoint {path}: payload is {len(body)} bytes but "
-                    f"the header recorded {length} — the snapshot is "
-                    "truncated; delete it to restart from scratch")
-            if hashlib.sha256(body).digest() != digest:
-                raise CheckpointCorruptError(
-                    f"checkpoint {path}: payload checksum mismatch — the "
-                    "snapshot is corrupt; delete it to restart from "
-                    "scratch")
+                    f"checkpoint {path}: {e} — the snapshot is torn or "
+                    "corrupt; delete it to restart from scratch") from e
             payload = pickle.loads(body)
         else:
             # legacy (pre-frame) snapshot: no digest to verify, but failures

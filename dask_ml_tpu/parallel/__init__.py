@@ -80,10 +80,17 @@ from dask_ml_tpu.parallel.stream import (  # noqa: F401
     prefetched_scan,
 )
 from dask_ml_tpu.parallel.serving import (  # noqa: F401
+    DeadlineExceeded,
     ModelRegistry,
     ServingClosed,
     ServingLoop,
     ServingQueueFull,
+    ServingStopped,
+)
+from dask_ml_tpu.parallel.fleet import (  # noqa: F401
+    FleetClient,
+    FleetServer,
+    ServingFleet,
 )
 from dask_ml_tpu.parallel.elastic import (  # noqa: F401
     BlockPlan,

@@ -53,7 +53,8 @@ logger = logging.getLogger(__name__)
 __all__ = [
     "RetryPolicy", "FaultInjector", "GracefulDrain", "ScanCheckpoint",
     "Preempted", "BlockFetchError", "InjectedFault", "InjectedLoaderError",
-    "InjectedTransferError", "scan_checkpoint_scope",
+    "InjectedTransferError", "SimulatedReplicaDeath",
+    "scan_checkpoint_scope",
 ]
 
 
@@ -91,6 +92,16 @@ class InjectedLoaderError(InjectedFault, OSError):
 
 class InjectedTransferError(InjectedFault, RuntimeError):
     """Simulated ``device_put`` failure transferring a block."""
+
+
+class SimulatedReplicaDeath(RuntimeError):
+    """A :meth:`FaultInjector.kill_replica` plan fired: the serving
+    replica's dispatch thread dies abruptly — no drain, no flush — the
+    in-process stand-in for kill -9 of a replica process. Deliberately NOT
+    an :class:`InjectedFault`: a dead replica is terminal for that
+    replica, never something its own retry policy should paper over; the
+    FLEET survives it by re-routing and replaying
+    (``parallel/fleet.py``)."""
 
 
 # ---------------------------------------------------------------------------
@@ -481,10 +492,14 @@ class FaultInjector:
         self._load_delay: dict = {}      # block -> [times_left, seconds]
         self._preempt: set = set()       # {(epoch, block)}
         self._die: set = set()           # {(epoch, block)}
+        self._dispatch_delay: dict = {}  # batch -> [times_left, seconds]
+        self._slow_replica: dict = {}    # replica -> [batches_left, seconds]
+        self._kill_replica: dict = {}    # replica -> after_batches
         self._p_load = 0.0
         self._p_exc = InjectedLoaderError
         self.injected = {"load": 0, "transfer": 0, "delay": 0, "preempt": 0,
-                         "die": 0}
+                         "die": 0, "dispatch_delay": 0, "slow_replica": 0,
+                         "replica_kill": 0}
 
     # -- planning ----------------------------------------------------------
 
@@ -525,6 +540,41 @@ class FaultInjector:
         :class:`~dask_ml_tpu.parallel.elastic.SimulatedHostDeath`; the
         bench worker turns that into ``os._exit``."""
         self._die.add((int(epoch), int(block)))
+        return self
+
+    def delay_dispatch(self, batch: int, seconds: float, *,
+                       times: int = 1) -> "FaultInjector":
+        """Sleep ``seconds`` before the serving loop dispatches batch
+        number ``batch`` (0-based sequence number on that loop) — a REAL
+        wall-clock straggler, for drills that need genuine skew. For
+        router-failover tests prefer :meth:`slow_replica`, whose synthetic
+        penalty needs no sleeping."""
+        self._dispatch_delay[int(batch)] = [int(times), float(seconds)]
+        return self
+
+    def slow_replica(self, replica: str, seconds: float, *,
+                     batches: Optional[int] = None) -> "FaultInjector":
+        """Mark serving replica ``replica`` a straggler: every batch it
+        dispatches reports ``seconds`` of SYNTHETIC extra latency — the
+        loop adds the penalty to the latency surface its router reads
+        (gauges/EWMA) without actually sleeping, so slow-replica failover
+        is deterministic and wall-clock-free in tests. ``batches`` bounds
+        how many dispatches are penalized (default: until cleared)."""
+        self._slow_replica[str(replica)] = [
+            -1 if batches is None else int(batches), float(seconds)]
+        return self
+
+    def kill_replica(self, replica: str, *,
+                     after_batches: int = 0) -> "FaultInjector":
+        """Kill serving replica ``replica`` once it has dispatched
+        ``after_batches`` batches: the next dispatch raises
+        :class:`SimulatedReplicaDeath` and the replica's loop dies
+        abruptly — queued and in-flight requests fail with the death
+        error (in-process we cannot suppress Python's unwinding the way a
+        real SIGKILL would, so the loop's crash hygiene still runs), and
+        the fleet's router re-routes + replays them
+        (``parallel/fleet.py``). One-shot per replica."""
+        self._kill_replica[str(replica)] = int(after_batches)
         return self
 
     def random_load_failures(self, p: float,
@@ -588,3 +638,53 @@ class FaultInjector:
                 self.injected["die"] += 1
                 return True
         return False
+
+    # -- serving-loop hooks (called by ServingLoop/ServingFleet) -----------
+
+    def _mirror(self, kind: str) -> None:
+        """Registry mirror of the injector's own counter, at the same
+        increment site (docs/observability.md mirror discipline)."""
+        from dask_ml_tpu.parallel import telemetry
+
+        if telemetry.enabled():
+            telemetry.metrics().counter("faults.injected", kind=kind).inc()
+
+    def on_dispatch(self, batch: int) -> None:
+        """Real straggler: sleep per a :meth:`delay_dispatch` plan before
+        the loop dispatches batch ``batch``."""
+        with self._lock:
+            plan = self._dispatch_delay.get(int(batch))
+            delay = None
+            if plan and plan[0] > 0:
+                plan[0] -= 1
+                delay = plan[1]
+                self.injected["dispatch_delay"] += 1
+        if delay:
+            self._mirror("dispatch_delay")
+            time.sleep(delay)
+
+    def dispatch_penalty(self, replica: str) -> float:
+        """Synthetic straggler: extra seconds replica ``replica`` must
+        REPORT for this dispatch (no sleep happens anywhere) — the loop
+        adds it to the latency its router balances on."""
+        with self._lock:
+            plan = self._slow_replica.get(str(replica))
+            if not plan or plan[0] == 0:
+                return 0.0
+            if plan[0] > 0:
+                plan[0] -= 1
+            self.injected["slow_replica"] += 1
+        self._mirror("slow_replica")
+        return plan[1]
+
+    def should_kill_replica(self, replica: str, n_batches: int) -> bool:
+        """True exactly once, when ``replica`` has dispatched
+        ``after_batches`` batches (see :meth:`kill_replica`)."""
+        with self._lock:
+            after = self._kill_replica.get(str(replica))
+            if after is None or int(n_batches) < after:
+                return False
+            del self._kill_replica[str(replica)]
+            self.injected["replica_kill"] += 1
+        self._mirror("replica_kill")
+        return True

@@ -1,0 +1,1024 @@
+"""Fault-tolerant serving fleet: replica sharding, SLO routing, hot-swap.
+
+PR 9's :class:`~dask_ml_tpu.parallel.serving.ServingLoop` made online
+inference continuously-batched and compile-once — on ONE loop over ONE
+mesh, which is a single point of failure and a single queue. This module
+is the production tier above it (ROADMAP north-star item 2: keep
+answering when a replica dies, a model is swapped mid-flight, or traffic
+exceeds capacity):
+
+- :class:`ServingFleet` runs N ``ServingLoop`` replicas over DISJOINT
+  device subsets (each replica gets its own 1-D data mesh over its slice
+  of ``jax.devices()``; with fewer devices than replicas they share)
+  behind a host-side router. Routing balances on the queue-depth and
+  batch-latency signals each loop already exports through the PR-7
+  telemetry layer (read via :meth:`ServingLoop.queue_depth` /
+  :meth:`ServingLoop.latency_s`, the loop-side mirrors of the
+  ``serving.queue_depth`` gauge and ``serving.batch_seconds`` surface, so
+  balancing also works with telemetry off).
+- **Health**: every replica's dispatch thread heartbeats each collect
+  iteration; a monitor thread declares a replica dead when the heartbeat
+  stalls past ``heartbeat_timeout_s`` or the thread is gone, and a
+  consecutive-failure circuit breaker takes an erroring replica out of
+  rotation for ``breaker_cooldown_s`` (half-open probe after cooldown).
+- **Re-route + replay**: when a replica dies or drains, its in-flight
+  requests are replayed on a survivor from the fleet's own host-side
+  copy. Completion is idempotent BY REQUEST ID — the first resolution of
+  a fleet future wins, so a false-positive death costs duplicate
+  compute, never a dropped or double-resolved future.
+- **SLO-aware admission**: ``submit(priority=, deadline=)`` flows into
+  the loops' earliest-deadline-first coalescer; past-deadline requests
+  are shed with :class:`~dask_ml_tpu.parallel.serving.DeadlineExceeded`
+  instead of queueing to death, and a replica's
+  :class:`~dask_ml_tpu.parallel.serving.ServingQueueFull` triggers
+  router SPILLOVER to a sibling before backpressure ever reaches the
+  caller.
+- **Zero-downtime hot-swap**: :meth:`ServingFleet.swap` builds the new
+  :class:`~dask_ml_tpu.parallel.serving.ServedModel`, pre-compiles its
+  programs on every replica through the exact serving staging path
+  (``warmup_model``), THEN atomically installs it with a bumped
+  monotonic version — in-flight batches finish on the old program
+  (dispatch resolves the registry entry per batch), new batches take the
+  new one, and no request is lost or served a half-updated model.
+- **Wire protocol**: :class:`FleetServer` accepts out-of-process clients
+  over a socket speaking the shared length-prefixed magic+length+sha256
+  frame codec (:mod:`dask_ml_tpu.parallel.framing` — the same frame
+  layout PR 8's checkpoints use). One frame = one request; responses
+  return out of order tagged by id, and a request that fails validation
+  fails ITS caller's frame only — never a batch another client shares.
+
+Telemetry (all at their increment sites, mirror discipline of
+docs/observability.md): ``fleet.reroutes``, ``fleet.spillover``,
+``fleet.shed``, ``fleet.swaps``, ``fleet.replica_deaths`` counters and
+the ``fleet.replica_up`` gauge. ``bench.py --serving --fleet`` drills the
+whole tier — mixed-priority traffic, a mid-run hot-swap, a replica kill,
+zero dropped requests, bit-identity to the direct path — and commits the
+gates as FLEET_r01.json (docs/serving.md, "The serving fleet").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pickle
+import socket
+import threading
+import time
+import uuid
+from concurrent.futures import Future
+from typing import Optional
+
+import numpy as np
+
+from dask_ml_tpu.parallel import framing
+from dask_ml_tpu.parallel.serving import (
+    DeadlineExceeded,
+    ModelRegistry,
+    ServedModel,
+    ServingClosed,
+    ServingError,
+    ServingLoop,
+    ServingQueueFull,
+    ServingStopped,
+    _fail_future,
+)
+
+__all__ = [
+    "ServingFleet",
+    "FleetServer",
+    "FleetClient",
+]
+
+
+def _set_future(fut: Future, result) -> bool:
+    """Idempotent result delivery: claims and resolves ``fut`` unless a
+    racing path (duplicate completion after a false-positive death) got
+    there first. First resolution wins; returns True when it was this
+    one."""
+    if fut.done():
+        return False  # the other completion won (duplicate compute only)
+    try:
+        if not fut.set_running_or_notify_cancel():
+            return False  # client cancelled
+    except RuntimeError:
+        pass  # already claimed (e.g. by a replay in flight)
+    try:
+        fut.set_result(result)
+        return True
+    except Exception:
+        return False  # already resolved — duplicate compute, not an error
+
+
+@dataclasses.dataclass
+class _Replica:
+    name: str
+    loop: ServingLoop
+    mesh: object
+    consecutive_failures: int = 0
+    breaker_open_until: float = 0.0  # monotonic instant
+    dead: bool = False
+
+    def breaker_open(self) -> bool:
+        return time.monotonic() < self.breaker_open_until
+
+
+@dataclasses.dataclass(eq=False)
+class _FleetRequest:
+    """The fleet's own host-side copy of one request — everything needed
+    to replay it on a survivor when its replica dies."""
+
+    rid: str
+    model: str
+    method: str
+    X: np.ndarray
+    priority: int
+    deadline_abs: Optional[float]  # absolute perf_counter instant
+    future: Future
+    attempts: int = 0
+    replica: Optional[str] = None
+
+    def remaining(self) -> Optional[float]:
+        if self.deadline_abs is None:
+            return None
+        return self.deadline_abs - time.perf_counter()
+
+
+class ServingFleet:
+    """N serving replicas behind a health-checked, SLO-aware router
+    (module docstring has the architecture).
+
+    Parameters
+    ----------
+    registry : ModelRegistry, optional
+        ONE registry shared by every replica (hot-swap publishes once);
+        a private one is created by default.
+    n_replicas : int
+        Replica count. Each replica gets ``len(jax.devices())//n`` devices
+        (disjoint, in device order); when devices are scarcer than
+        replicas they round-robin single devices.
+    meshes : sequence of Mesh, optional
+        Explicit per-replica meshes (overrides ``n_replicas`` slicing).
+    policy, max_batch_rows, max_queue, coalesce_window_s, retry_policy
+        Forwarded to every :class:`ServingLoop`.
+    heartbeat_interval_s, heartbeat_timeout_s
+        Monitor cadence and the heartbeat stall past which a replica is
+        declared dead (its in-flight requests replay on survivors).
+    max_consecutive_failures, breaker_cooldown_s
+        Circuit breaker: after this many consecutive request failures a
+        replica leaves rotation for the cooldown, then gets a half-open
+        probe.
+    max_replays : int, optional
+        Re-route budget per request (default: replica count) — a request
+        is failed with its last cause rather than bouncing forever.
+    drain : GracefulDrain, optional
+        Shared drain scope: on SIGTERM (or ``drain.request()``) every
+        replica stops accepting, flushes its queue, and resolves every
+        future; the fleet stops admitting (new submits raise
+        :class:`ServingStopped`).
+    fault_injector : FaultInjector, optional
+        Forwarded to every replica — ``kill_replica``/``slow_replica``/
+        ``delay_dispatch`` plans address replicas by name
+        (``"{name}-r{i}"``).
+    """
+
+    def __init__(self, registry: Optional[ModelRegistry] = None, *,
+                 n_replicas: int = 2,
+                 meshes=None,
+                 policy=None,
+                 max_batch_rows: int = 2048,
+                 max_queue: int = 4096,
+                 coalesce_window_s: float = 0.0,
+                 heartbeat_interval_s: float = 0.05,
+                 heartbeat_timeout_s: float = 2.0,
+                 max_consecutive_failures: int = 3,
+                 breaker_cooldown_s: float = 1.0,
+                 max_replays: Optional[int] = None,
+                 drain=None,
+                 retry_policy=None,
+                 fault_injector=None,
+                 name: str = "fleet"):
+        self.registry = registry if registry is not None else ModelRegistry()
+        self.n_replicas = int(n_replicas)
+        self._meshes = list(meshes) if meshes is not None else None
+        self.policy = policy
+        self.max_batch_rows = int(max_batch_rows)
+        self.max_queue = int(max_queue)
+        self.coalesce_window_s = float(coalesce_window_s)
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.max_consecutive_failures = int(max_consecutive_failures)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self.max_replays = max_replays
+        self.name = str(name)
+        self._drain = drain
+        self._retry_policy = retry_policy
+        self._fault_injector = fault_injector
+
+        self._lock = threading.Lock()
+        self._replicas: list = []
+        self._inflight: dict = {}  # rid -> _FleetRequest
+        self._closing = False
+        self._started = False
+        self._monitor_stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self._rr = 0  # round-robin tiebreak cursor
+        # operational counters (telemetry mirrors at the increment sites)
+        self.n_reroutes = 0
+        self.n_spillovers = 0
+        self.n_shed = 0
+        self.n_swaps = 0
+        self.n_replica_deaths = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _build_meshes(self) -> list:
+        import jax
+
+        from dask_ml_tpu.parallel import mesh as mesh_lib
+
+        if self._meshes is not None:
+            if len(self._meshes) < 1:
+                raise ValueError("meshes must name at least one mesh")
+            return self._meshes
+        devs = list(jax.devices())
+        n = self.n_replicas
+        if n < 1:
+            raise ValueError("n_replicas must be >= 1")
+        if len(devs) >= n:
+            per = len(devs) // n
+            groups = [devs[i * per:(i + 1) * per] for i in range(n)]
+        else:
+            # scarcer devices than replicas: round-robin single devices
+            # (replicas share hardware but keep independent queues/meshes
+            # — still the right shape for failover/drain logic off-TPU)
+            groups = [[devs[i % len(devs)]] for i in range(n)]
+        return [mesh_lib.make_mesh(devices=g) for g in groups]
+
+    def start(self) -> "ServingFleet":
+        if self._started:
+            return self
+        meshes = self._build_meshes()
+        self._replicas = []
+        for i, mesh in enumerate(meshes):
+            rname = f"{self.name}-r{i}"
+            loop = ServingLoop(
+                self.registry, policy=self.policy,
+                max_batch_rows=self.max_batch_rows,
+                max_queue=self.max_queue,
+                coalesce_window_s=self.coalesce_window_s,
+                mesh=mesh, drain=self._drain,
+                retry_policy=self._retry_policy,
+                fault_injector=self._fault_injector,
+                name=rname)
+            loop.start()
+            self._replicas.append(_Replica(name=rname, loop=loop, mesh=mesh))
+        self._closing = False
+        self._started = True
+        from dask_ml_tpu.parallel import telemetry
+
+        # like ServingLoop.start: the monitor thread inherits an ENABLED
+        # telemetry scope so its increment sites (replica_up gauge,
+        # replica_deaths/reroutes on death) mirror under
+        # config_context(telemetry=True) around start()
+        self._telemetry_inherit = telemetry.enabled()
+        self._monitor_stop.clear()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name=f"{self.name}-monitor",
+            daemon=True)
+        self._monitor.start()
+        self._set_replica_up()
+        return self
+
+    def __enter__(self) -> "ServingFleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = 30.0) -> None:
+        """Stop the fleet: stop admitting, stop every replica
+        (``drain=True`` flushes their queues and resolves every future),
+        then fail whatever replay bookkeeping remains so nothing is ever
+        left pending."""
+        with self._lock:
+            self._closing = True
+        self._monitor_stop.set()
+        m = self._monitor
+        if m is not None and m.is_alive() \
+                and m is not threading.current_thread():
+            m.join(timeout)
+        for rep in self._replicas:
+            rep.loop.stop(drain=drain, timeout=timeout)
+        # anything still inflight lost its completion callback's replay
+        # path (closing → no re-route); fail it rather than leak it
+        with self._lock:
+            leftovers = list(self._inflight.values())
+            self._inflight.clear()
+        for freq in leftovers:
+            _fail_future(freq.future, ServingStopped(
+                f"fleet {self.name!r} stopped"))
+
+    def warmup(self, buckets=None, models=None) -> dict:
+        """Pre-compile every (replica, model, method, bucket) program;
+        aggregated counts."""
+        out = {"n_programs": 0, "n_compiles": 0, "compile_seconds": 0.0}
+        for rep in self._replicas:
+            w = rep.loop.warmup(buckets=buckets, models=models)
+            out["n_programs"] += w["n_programs"]
+            out["n_compiles"] += w["n_compiles"]
+            out["compile_seconds"] = round(
+                out["compile_seconds"] + w["compile_seconds"], 3)
+        return out
+
+    # -- registry ----------------------------------------------------------
+
+    def register(self, name: str, estimator, *, methods=None) -> ServedModel:
+        return self.registry.register(name, estimator, methods=methods)
+
+    def swap(self, name: str, estimator, *, methods=None,
+             warmup: bool = True) -> int:
+        """Zero-downtime hot-swap: build the new ServedModel, pre-compile
+        its programs on every live replica (so the new version's first
+        batch pays no compile), then atomically install it with a bumped
+        version. In-flight batches finish on the old program; returns the
+        new version number."""
+        from dask_ml_tpu.parallel import telemetry
+
+        model = self.registry.build(name, estimator, methods=methods)
+        if warmup:
+            for rep in self._replicas:
+                if not rep.dead and rep.loop.alive():
+                    rep.loop.warmup_model(model)
+        self.registry.install(model)
+        with self._lock:
+            self.n_swaps += 1
+        if telemetry.enabled():
+            telemetry.metrics().counter("fleet.swaps", model=name).inc()
+        return model.version
+
+    # -- routing -----------------------------------------------------------
+
+    @property
+    def max_request_rows(self) -> int:
+        """Per-request row cap (the replica loops' batch budget) —
+        present so ``ParallelPostFit(serving=fleet)`` chunks exactly as
+        it would against a single loop."""
+        return self.max_batch_rows
+
+    def replicas_up(self) -> int:
+        return sum(1 for rep in self._replicas
+                   if not rep.dead and rep.loop.alive())
+
+    def _set_replica_up(self) -> None:
+        from dask_ml_tpu.parallel import telemetry
+
+        if telemetry.enabled():
+            telemetry.metrics().gauge("fleet.replica_up").set(
+                self.replicas_up())
+
+    def _eligible(self, exclude) -> list:
+        return [rep for rep in self._replicas
+                if rep.name not in exclude and not rep.dead
+                and rep.loop.alive()]
+
+    #: latency quantum for routing (seconds): EWMA differences below this
+    #: are noise (two healthy replicas jitter at the ms level), so the
+    #: round-robin tiebreak spreads load across them; a genuine straggler
+    #: (an injected slow_replica penalty, a contended device) exceeds a
+    #: bucket and is routed around.
+    LATENCY_QUANTUM_S = 0.1
+
+    def _pick(self, exclude) -> Optional[_Replica]:
+        """Least-loaded routing on (queue depth, quantized latency EWMA)
+        — the loop-side mirrors of the ``serving.queue_depth`` gauge and
+        ``serving.batch_seconds`` surface the telemetry layer exports —
+        with round-robin spread among equals. Breaker-open replicas are
+        skipped unless nothing else is live (half-open probe of the
+        soonest-expiring breaker)."""
+        live = self._eligible(exclude)
+        if not live:
+            return None
+        closed = [rep for rep in live if not rep.breaker_open()]
+        if not closed:
+            return min(live, key=lambda rep: rep.breaker_open_until)
+        with self._lock:
+            self._rr += 1
+            rr = self._rr
+        return min(
+            closed,
+            key=lambda rep: (rep.loop.queue_depth()
+                             + (1 if rep.loop.busy else 0),
+                             int(rep.loop.latency_s()
+                                 / self.LATENCY_QUANTUM_S),
+                             (self._replicas.index(rep) + rr)
+                             % max(len(self._replicas), 1)))
+
+    def _note_failure(self, rep: _Replica) -> None:
+        rep.consecutive_failures += 1
+        if rep.consecutive_failures >= self.max_consecutive_failures \
+                and not rep.breaker_open():
+            rep.breaker_open_until = (time.monotonic()
+                                      + self.breaker_cooldown_s)
+
+    def _note_success(self, rep: _Replica) -> None:
+        rep.consecutive_failures = 0
+        rep.breaker_open_until = 0.0
+
+    def submit(self, model: str, X, method: str = "predict", *,
+               priority: int = 0, deadline: Optional[float] = None,
+               request_id: Optional[str] = None) -> Future:
+        """Route one request to the least-loaded live replica; returns a
+        fleet-level Future that survives replica death (re-route +
+        replay, idempotent by ``request_id``). Validation failures, an
+        already-expired ``deadline``, and fleet-wide backpressure
+        (:class:`ServingQueueFull` from EVERY live replica — spillover
+        exhausted) raise synchronously to THIS caller. Submitting an id
+        that is already in flight returns the existing future (client
+        retry = same request)."""
+        if self._drain is not None and self._drain.requested:
+            self._closing = True
+        if self._closing or not self._started:
+            raise ServingStopped(
+                f"fleet {self.name!r} is not accepting requests")
+        rid = str(request_id) if request_id is not None else uuid.uuid4().hex
+        with self._lock:
+            existing = self._inflight.get(rid)
+            if existing is not None:
+                return existing.future
+        now = time.perf_counter()
+        if deadline is not None and float(deadline) <= 0.0:
+            self._count_shed(model)
+            raise DeadlineExceeded(
+                f"request deadline {float(deadline):.3f}s is already past "
+                "at fleet admission")
+        freq = _FleetRequest(
+            rid=rid, model=str(model), method=str(method), X=X,
+            priority=int(priority),
+            deadline_abs=None if deadline is None else now + float(deadline),
+            future=Future())
+        self._route(freq, sync=True)
+        return freq.future
+
+    def call(self, model: str, X, method: str = "predict", *,
+             priority: int = 0, deadline: Optional[float] = None,
+             timeout: Optional[float] = None) -> np.ndarray:
+        """Blocking convenience wrapped in a ``fleet.request`` span."""
+        from dask_ml_tpu.parallel import telemetry
+
+        with telemetry.span("fleet.request", model=str(model),
+                            method=str(method)):
+            return self.submit(model, X, method=method, priority=priority,
+                               deadline=deadline).result(timeout)
+
+    def _count_shed(self, model: str) -> None:
+        from dask_ml_tpu.parallel import telemetry
+
+        with self._lock:
+            self.n_shed += 1
+        if telemetry.enabled():
+            telemetry.metrics().counter("fleet.shed", model=model).inc()
+
+    def _route(self, freq: _FleetRequest, *, sync: bool,
+               exclude: Optional[set] = None) -> None:
+        """Place ``freq`` on a replica. ``sync=True`` (first admission)
+        propagates terminal errors to the caller; ``sync=False`` (replay)
+        sets them on the fleet future. Spillover: a queue-full replica is
+        excluded and the next one tried before backpressure surfaces."""
+        from dask_ml_tpu.parallel import telemetry
+
+        exclude = set() if exclude is None else set(exclude)
+        queue_full_seen = False
+        while True:
+            if self._closing:
+                self._terminal(freq, ServingStopped(
+                    f"fleet {self.name!r} is stopping"), sync)
+                return
+            rep = self._pick(exclude)
+            if rep is None:
+                if queue_full_seen:
+                    exc: ServingError = ServingQueueFull(
+                        "every live replica's queue is at capacity "
+                        f"({self.max_queue} requests each)")
+                else:
+                    exc = ServingStopped(
+                        f"fleet {self.name!r} has no live replica")
+                self._terminal(freq, exc, sync)
+                return
+            remaining = freq.remaining()
+            if remaining is not None and remaining <= 0.0:
+                self._count_shed(freq.model)
+                self._terminal(freq, DeadlineExceeded(
+                    f"request {freq.rid} deadline passed during routing"),
+                    sync)
+                return
+            try:
+                rfut = rep.loop.submit(
+                    freq.model, freq.X, method=freq.method,
+                    priority=freq.priority, deadline=remaining)
+            except ServingQueueFull:
+                queue_full_seen = True
+                exclude.add(rep.name)
+                with self._lock:
+                    self.n_spillovers += 1
+                if telemetry.enabled():
+                    telemetry.metrics().counter(
+                        "fleet.spillover", replica=rep.name).inc()
+                continue
+            except ServingClosed:
+                # draining/stopped replica: take it out of this route and
+                # let the health monitor decide its fate
+                exclude.add(rep.name)
+                continue
+            except DeadlineExceeded as e:
+                self._count_shed(freq.model)
+                self._terminal(freq, e, sync)
+                return
+            except Exception as e:  # noqa: BLE001 — validation errors etc.
+                self._terminal(freq, e, sync)
+                return
+            freq.attempts += 1
+            freq.replica = rep.name
+            with self._lock:
+                self._inflight[freq.rid] = freq
+            rfut.add_done_callback(
+                lambda f, freq=freq, rep=rep: self._on_done(freq, rep, f))
+            return
+
+    def _terminal(self, freq: _FleetRequest, exc: BaseException,
+                  sync: bool) -> None:
+        with self._lock:
+            self._inflight.pop(freq.rid, None)
+        if sync:
+            raise exc
+        _fail_future(freq.future, exc)
+
+    def _replay_budget(self) -> int:
+        return (self.max_replays if self.max_replays is not None
+                else max(len(self._replicas), 1))
+
+    def _on_done(self, freq: _FleetRequest, rep: _Replica, rfut) -> None:
+        """Replica-future completion, on the replica's dispatch thread
+        (or the failing path's). Success and model errors resolve the
+        fleet future; replica-death errors re-route + replay."""
+        from dask_ml_tpu.parallel import telemetry
+        from dask_ml_tpu.parallel.faults import SimulatedReplicaDeath
+
+        try:
+            result = rfut.result()
+        except (ServingStopped, ServingClosed, SimulatedReplicaDeath) as e:
+            # the REPLICA went away, not the request: re-route + replay
+            self._note_failure(rep)
+            if freq.attempts > self._replay_budget():
+                self._terminal(freq, e, sync=False)
+                return
+            with self._lock:
+                self.n_reroutes += 1
+            if telemetry.enabled():
+                telemetry.metrics().counter(
+                    "fleet.reroutes", replica=rep.name).inc()
+            self._route(freq, sync=False, exclude={rep.name})
+        except DeadlineExceeded as e:
+            self._count_shed(freq.model)
+            self._terminal(freq, e, sync=False)
+        except BaseException as e:  # noqa: BLE001 — the request's own error
+            self._note_failure(rep)
+            self._terminal(freq, e, sync=False)
+        else:
+            self._note_success(rep)
+            with self._lock:
+                self._inflight.pop(freq.rid, None)
+            _set_future(freq.future, result)
+
+    # -- health monitoring -------------------------------------------------
+
+    def _monitor_loop(self) -> None:
+        import contextlib
+
+        from dask_ml_tpu import config as config_lib
+
+        ctx = (config_lib.config_context(telemetry=True)
+               if getattr(self, "_telemetry_inherit", False)
+               else contextlib.nullcontext())
+        interval = self.heartbeat_interval_s
+        with ctx:
+            while not self._monitor_stop.wait(interval):
+                if self._drain is not None and self._drain.requested:
+                    with self._lock:
+                        self._closing = True
+                for rep in self._replicas:
+                    loop = rep.loop
+                    if rep.dead:
+                        # resurrection: a FALSE-positive death (slow
+                        # batch stalled the heartbeat, loop actually
+                        # fine) heals once the beat returns — the replay
+                        # already made it safe, this makes it temporary.
+                        # A crashed/stopped loop is terminal.
+                        if loop.alive() and loop.heartbeat_age() \
+                                <= self.heartbeat_timeout_s:
+                            rep.dead = False
+                            rep.consecutive_failures = 0
+                            rep.breaker_open_until = 0.0
+                            self._set_replica_up()
+                        continue
+                    if not loop.alive():
+                        # thread gone or crashed: immediate death
+                        if loop.fatal is not None or loop.stopped:
+                            self._declare_dead(rep)
+                        continue
+                    if loop.heartbeat_age() > self.heartbeat_timeout_s:
+                        self._declare_dead(rep)
+
+    def _declare_dead(self, rep: _Replica) -> None:
+        """Terminal for the replica: take it out of rotation and replay
+        its in-flight requests on survivors. Idempotent resolution makes
+        a FALSE-positive declaration (stalled heartbeat, loop actually
+        alive) safe: both completions race to the same fleet future and
+        the first one wins — duplicate compute, never a double resolve."""
+        from dask_ml_tpu.parallel import telemetry
+
+        if rep.dead:
+            return
+        rep.dead = True
+        self._set_replica_up()
+        if self._closing:
+            # fleet-wide drain/stop: replicas stopping cleanly are not
+            # deaths — no counter, no replay (stop() fails leftovers)
+            return
+        with self._lock:
+            self.n_replica_deaths += 1
+            victims = [freq for freq in self._inflight.values()
+                       if freq.replica == rep.name]
+        if telemetry.enabled():
+            telemetry.metrics().counter(
+                "fleet.replica_deaths", replica=rep.name).inc()
+        cause = ServingStopped(
+            f"replica {rep.name!r} declared dead "
+            f"(heartbeat {rep.loop.heartbeat_age():.2f}s"
+            + (f", fatal {rep.loop.fatal!r}" if rep.loop.fatal is not None
+               else "") + ")")
+        for freq in victims:
+            from dask_ml_tpu.parallel import telemetry as _t
+
+            if freq.attempts > self._replay_budget():
+                self._terminal(freq, cause, sync=False)
+                continue
+            with self._lock:
+                self.n_reroutes += 1
+            if _t.enabled():
+                _t.metrics().counter(
+                    "fleet.reroutes", replica=rep.name).inc()
+            self._route(freq, sync=False, exclude={rep.name})
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            counters = {
+                "reroutes": self.n_reroutes,
+                "spillovers": self.n_spillovers,
+                "shed": self.n_shed,
+                "swaps": self.n_swaps,
+                "replica_deaths": self.n_replica_deaths,
+                "inflight": len(self._inflight),
+            }
+        return {
+            "name": self.name,
+            "replicas_up": self.replicas_up(),
+            "replicas": {rep.name: {
+                "alive": rep.loop.alive(),
+                "dead": rep.dead,
+                "breaker_open": rep.breaker_open(),
+                "queue_depth": rep.loop.queue_depth(),
+                "latency_ewma_s": round(rep.loop.latency_s(), 6),
+                **{k: v for k, v in rep.loop.stats().items()
+                   if k in ("submitted", "completed", "errors", "batches",
+                            "rows_served", "shed")},
+            } for rep in self._replicas},
+            **counters,
+        }
+
+
+# ---------------------------------------------------------------------------
+# wire protocol: out-of-process clients over a socket
+# ---------------------------------------------------------------------------
+
+#: errors the wire protocol maps by name so a remote caller can catch the
+#: same classes a local one would
+_WIRE_ERRORS = {
+    "DeadlineExceeded": DeadlineExceeded,
+    "ServingQueueFull": ServingQueueFull,
+    "ServingStopped": ServingStopped,
+    "ServingClosed": ServingClosed,
+    "ValueError": ValueError,
+    "KeyError": KeyError,
+}
+
+
+def _encode_array(arr: np.ndarray) -> dict:
+    arr = np.ascontiguousarray(arr)
+    return {"dtype": str(arr.dtype), "shape": tuple(arr.shape),
+            "data": arr.tobytes()}
+
+
+def _decode_array(msg: dict) -> np.ndarray:
+    return np.frombuffer(
+        msg["data"], dtype=np.dtype(msg["dtype"])).reshape(msg["shape"])
+
+
+class FleetServer:
+    """Socket front-end for a :class:`ServingFleet` (or a single
+    :class:`ServingLoop`): out-of-process clients submit inference
+    requests as frames of the shared codec
+    (:data:`~dask_ml_tpu.parallel.framing.WIRE_MAGIC`).
+
+    One frame carries one pickled request dict (``op="submit"``: id,
+    model, method, priority, deadline, and the row array as raw bytes +
+    dtype/shape); responses are frames tagged with the request id and
+    return OUT OF ORDER as futures resolve, so one slow request never
+    convoys a connection. A request that fails validation (or sheds on
+    its deadline) gets an error response naming the exception class —
+    that caller only, never a shared batch
+    (validation-fails-the-caller-not-the-batch, docs/serving.md); a frame
+    that fails its checksum gets an error response and the connection is
+    closed (the stream can no longer be trusted).
+
+    Trust boundary: payloads are pickled — serve trusted networks only
+    (same posture as the checkpoint files this codec came from).
+    """
+
+    def __init__(self, fleet, host: str = "127.0.0.1", port: int = 0, *,
+                 max_payload: int = 256 * 1024 * 1024):
+        self.fleet = fleet
+        self.max_payload = int(max_payload)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, int(port)))
+        self._sock.listen(64)
+        self.address = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+        self._conns: list = []
+        self.n_requests = 0
+        self.n_frame_errors = 0
+
+    def start(self) -> "FleetServer":
+        if self._accept_thread is not None:
+            return self
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="fleet-server-accept",
+            daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def __enter__(self) -> "FleetServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for conn in list(self._conns):
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return  # listener closed
+            self._conns.append(conn)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             name="fleet-server-conn", daemon=True).start()
+
+    #: response-queue depth per connection; a client that stops READING
+    #: while responses pile up is broken — the connection is closed
+    #: rather than buffering unboundedly
+    MAX_PENDING_RESPONSES = 1024
+
+    def _send(self, conn, out_q, msg: dict) -> None:
+        """Enqueue one response for the connection's writer thread. The
+        write itself happens OFF the caller's thread: responses are
+        delivered from future callbacks that run on replica dispatch
+        threads, and a blocking ``sendall`` to a stalled client there
+        would freeze the replica's dispatch loop (and read as a death to
+        the health monitor)."""
+        import queue as queue_mod
+
+        try:
+            out_q.put_nowait(msg)
+        except queue_mod.Full:
+            try:
+                conn.close()  # reader+writer unwind on the closed socket
+            except OSError:
+                pass
+
+    def _write_loop(self, conn, out_q) -> None:
+        while True:
+            msg = out_q.get()
+            if msg is None:
+                return
+            payload = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+            try:
+                framing.write_frame(conn, payload,
+                                    magic=framing.WIRE_MAGIC)
+            except OSError:
+                return  # peer went away; nothing to deliver to
+
+    def _serve_conn(self, conn) -> None:
+        import queue as queue_mod
+
+        out_q: "queue_mod.Queue" = queue_mod.Queue(
+            maxsize=self.MAX_PENDING_RESPONSES)
+        writer = threading.Thread(target=self._write_loop,
+                                  args=(conn, out_q),
+                                  name="fleet-server-writer", daemon=True)
+        writer.start()
+        try:
+            while not self._stop.is_set():
+                try:
+                    payload = framing.read_frame(
+                        conn, magic=framing.WIRE_MAGIC,
+                        max_payload=self.max_payload)
+                except framing.FrameError as e:
+                    # a torn/corrupt frame fails ITS caller and ends the
+                    # stream: byte alignment is gone, so nothing later on
+                    # this connection can be attributed safely
+                    self.n_frame_errors += 1
+                    self._send(conn, out_q, {
+                        "id": None, "ok": False,
+                        "error": type(e).__name__, "message": str(e)})
+                    return
+                if payload is None:
+                    return  # clean EOF
+                self._handle(conn, out_q, payload)
+        finally:
+            # let queued responses flush, then stop the writer; closing
+            # the socket afterwards unblocks a writer stalled mid-send
+            try:
+                out_q.put_nowait(None)
+            except queue_mod.Full:
+                pass
+            writer.join(5.0)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            if conn in self._conns:
+                self._conns.remove(conn)
+
+    def _handle(self, conn, out_q, payload: bytes) -> None:
+        msg: dict = {}
+        rid = None
+        try:
+            msg = pickle.loads(payload)
+            op = msg.get("op")
+            rid = msg.get("id")
+            if op == "ping":
+                self._send(conn, out_q, {"id": rid, "ok": True,
+                                         "pong": True})
+                return
+            if op != "submit":
+                raise ValueError(f"unknown wire op {op!r}")
+            X = _decode_array(msg)
+            self.n_requests += 1
+            kwargs = {}
+            if rid is not None and isinstance(self.fleet, ServingFleet):
+                kwargs["request_id"] = rid  # client retry = same request
+            fut = self.fleet.submit(
+                msg["model"], X, method=msg.get("method", "predict"),
+                priority=int(msg.get("priority", 0)),
+                deadline=msg.get("deadline"), **kwargs)
+        except Exception as e:  # noqa: BLE001 — per-frame error delivery
+            self._send(conn, out_q, {
+                "id": rid, "ok": False,
+                "error": type(e).__name__, "message": str(e)})
+            return
+
+        def deliver(f, rid=rid):
+            try:
+                out = f.result()
+            except Exception as e:  # noqa: BLE001
+                self._send(conn, out_q, {
+                    "id": rid, "ok": False,
+                    "error": type(e).__name__, "message": str(e)})
+            else:
+                self._send(conn, out_q, {
+                    "id": rid, "ok": True, **_encode_array(out)})
+
+        fut.add_done_callback(deliver)
+
+
+class FleetClient:
+    """Out-of-process client of a :class:`FleetServer`: frames requests
+    over one socket, demultiplexes out-of-order responses by id on a
+    reader thread. ``submit`` returns a Future; ``call`` blocks. Error
+    responses re-raise as the same exception classes a local caller
+    would see (:data:`_WIRE_ERRORS`; anything unmapped surfaces as
+    ``RuntimeError`` naming the remote class)."""
+
+    def __init__(self, address, *, timeout: Optional[float] = None):
+        host, port = address
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
+        self._wlock = threading.Lock()
+        self._lock = threading.Lock()
+        self._pending: dict = {}  # id -> Future
+        # globally-unique id prefix: rids reach the FLEET's dedup table,
+        # where two clients colliding (id() reuse across processes or
+        # after GC) would silently hand one client the other's result
+        self._rid_prefix = uuid.uuid4().hex[:16]
+        self._seq = 0
+        self._closed = False
+        self._reader = threading.Thread(
+            target=self._read_loop, name="fleet-client-reader", daemon=True)
+        self._reader.start()
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "FleetClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _read_loop(self) -> None:
+        exc: BaseException = ServingStopped("wire connection closed")
+        try:
+            while True:
+                payload = framing.read_frame(self._sock,
+                                             magic=framing.WIRE_MAGIC)
+                if payload is None:
+                    break
+                msg = pickle.loads(payload)
+                rid = msg.get("id")
+                with self._lock:
+                    fut = self._pending.pop(rid, None)
+                if fut is None:
+                    continue  # response to a caller that went away
+                if msg.get("ok"):
+                    _set_future(fut, _decode_array(msg)
+                                if "data" in msg else msg)
+                else:
+                    cls = _WIRE_ERRORS.get(msg.get("error"), RuntimeError)
+                    _fail_future(fut, cls(
+                        f"[remote {msg.get('error')}] {msg.get('message')}"))
+        except (OSError, framing.FrameError) as e:
+            exc = e
+        finally:
+            with self._lock:
+                pending = list(self._pending.values())
+                self._pending.clear()
+            for fut in pending:
+                _fail_future(fut, ServingStopped(
+                    f"wire connection lost: {exc!r}"))
+
+    def submit(self, model: str, X, method: str = "predict", *,
+               priority: int = 0,
+               deadline: Optional[float] = None) -> Future:
+        if self._closed:
+            raise ServingStopped("client is closed")
+        with self._lock:
+            self._seq += 1
+            rid = f"{self._rid_prefix}-{self._seq}"
+            fut: Future = Future()
+            self._pending[rid] = fut
+        msg = {"op": "submit", "id": rid, "model": str(model),
+               "method": str(method), "priority": int(priority),
+               "deadline": deadline, **_encode_array(np.asarray(X))}
+        payload = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+        with self._wlock:
+            framing.write_frame(self._sock, payload,
+                                magic=framing.WIRE_MAGIC)
+        return fut
+
+    def call(self, model: str, X, method: str = "predict", *,
+             priority: int = 0, deadline: Optional[float] = None,
+             timeout: Optional[float] = None) -> np.ndarray:
+        return self.submit(model, X, method=method, priority=priority,
+                           deadline=deadline).result(timeout)
+
+    def ping(self, timeout: float = 10.0) -> bool:
+        with self._lock:
+            self._seq += 1
+            rid = f"{self._rid_prefix}-{self._seq}"
+            fut: Future = Future()
+            self._pending[rid] = fut
+        payload = pickle.dumps({"op": "ping", "id": rid},
+                               protocol=pickle.HIGHEST_PROTOCOL)
+        with self._wlock:
+            framing.write_frame(self._sock, payload,
+                                magic=framing.WIRE_MAGIC)
+        return bool(fut.result(timeout).get("pong"))

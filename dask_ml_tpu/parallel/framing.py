@@ -1,0 +1,182 @@
+"""Length-prefixed, checksummed frame codec shared by snapshots and wire.
+
+One framing discipline serves two very different transports:
+
+- **Checkpoint snapshots** (``dask_ml_tpu.checkpoint``): ``save_pytree``
+  frames its pickle payload so that atomic-rename durability becomes an
+  END-TO-END guarantee — rename protects against a kill mid-save, the
+  frame's length + sha256 protect against everything else (a torn copy, a
+  truncated transfer off shared storage, silent media corruption). Any
+  byte missing or flipped fails the digest and surfaces loudly instead of
+  unpickling noise (swept at every byte offset in
+  ``tests/test_checkpoint.py``).
+- **The serving wire protocol** (``dask_ml_tpu.parallel.fleet``):
+  out-of-process clients submit inference requests over a socket as
+  frames of exactly this layout. A frame that fails validation fails THE
+  CALLER — the connection's error response names the corrupt frame, and
+  no partial request ever reaches a batch another client shares (the
+  serving layer's validation-fails-the-caller-not-the-batch contract,
+  docs/serving.md).
+
+Frame layout (everything big-endian)::
+
+    magic (caller-chosen, includes a version byte)
+    8-byte unsigned payload length
+    32-byte sha256(payload)
+    payload
+
+The codec is transport-agnostic: :func:`encode_frame`/:func:`decode_frame`
+work on whole byte strings (the snapshot path reads the file in one go),
+:func:`read_frame`/:func:`write_frame` work on stream objects with
+``recv``-style partial reads (the socket path). Errors are typed —
+:class:`FrameTruncatedError` for missing bytes, :class:`FrameCorruptError`
+for a failed digest or foreign magic — so callers can map them onto their
+own error surface (``checkpoint.py`` wraps both in
+``CheckpointCorruptError`` with its original messages, bit-identical
+behavior to the pre-extraction code).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from typing import Optional
+
+__all__ = [
+    "FrameError",
+    "FrameTruncatedError",
+    "FrameCorruptError",
+    "encode_frame",
+    "decode_frame",
+    "read_frame",
+    "write_frame",
+    "header_length",
+    "WIRE_MAGIC",
+]
+
+#: serving wire-protocol magic (docs/serving.md, "The wire protocol");
+#: the checkpoint magic lives with its owner in ``dask_ml_tpu.checkpoint``
+WIRE_MAGIC = b"DMLTWIRE1\n"
+
+_LEN_BYTES = 8
+_DIGEST_BYTES = 32
+
+
+class FrameError(RuntimeError):
+    """Base class for framing failures."""
+
+
+class FrameTruncatedError(FrameError):
+    """The buffer/stream ended before the frame did (torn write, cut
+    connection): the header promised more bytes than arrived."""
+
+
+class FrameCorruptError(FrameError):
+    """The frame is structurally complete but wrong: foreign magic, or a
+    payload whose sha256 does not match the header's digest."""
+
+
+def header_length(magic: bytes) -> int:
+    """Total header size for ``magic``: magic + length + digest."""
+    return len(magic) + _LEN_BYTES + _DIGEST_BYTES
+
+
+def encode_frame(payload: bytes, *, magic: bytes) -> bytes:
+    """``magic + len(payload) (8B BE) + sha256(payload) + payload``."""
+    return (magic + struct.pack(">Q", len(payload))
+            + hashlib.sha256(payload).digest() + payload)
+
+
+def decode_frame(data: bytes, *, magic: bytes) -> bytes:
+    """Decode one whole-buffer frame → payload, verifying magic, length,
+    and digest. ``data`` must be exactly one frame (the snapshot file
+    case); trailing bytes are corruption, not a second frame."""
+    if data[:len(magic)] != magic:
+        raise FrameCorruptError(
+            f"bad frame magic {data[:len(magic)]!r} (expected {magic!r})")
+    rest = data[len(magic):]
+    if len(rest) < _LEN_BYTES + _DIGEST_BYTES:
+        raise FrameTruncatedError(
+            f"truncated frame header ({len(data)} bytes)")
+    (length,) = struct.unpack(">Q", rest[:_LEN_BYTES])
+    digest = rest[_LEN_BYTES:_LEN_BYTES + _DIGEST_BYTES]
+    payload = rest[_LEN_BYTES + _DIGEST_BYTES:]
+    if len(payload) < length:
+        raise FrameTruncatedError(
+            f"frame payload is {len(payload)} bytes but the header "
+            f"recorded {length}")
+    if len(payload) > length:
+        raise FrameCorruptError(
+            f"frame carries {len(payload) - length} trailing bytes past "
+            f"the recorded payload length {length}")
+    if hashlib.sha256(payload).digest() != digest:
+        raise FrameCorruptError("frame payload checksum mismatch")
+    return payload
+
+
+def _read_exact(stream, n: int) -> bytes:
+    """Read exactly ``n`` bytes from a stream exposing ``recv`` (socket)
+    or ``read`` (file object), tolerating partial reads. Returns fewer
+    bytes only at EOF."""
+    recv = getattr(stream, "recv", None) or stream.read
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = recv(n - got)
+        if not chunk:
+            break
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def read_frame(stream, *, magic: bytes,
+               max_payload: Optional[int] = None) -> Optional[bytes]:
+    """Read one frame from a stream → payload, or ``None`` on clean EOF
+    (no bytes at all — the peer closed between frames).
+
+    EOF mid-frame raises :class:`FrameTruncatedError`; wrong magic or a
+    failed digest raises :class:`FrameCorruptError`. ``max_payload``
+    bounds the allocation a hostile/corrupt length prefix could demand.
+    """
+    head = _read_exact(stream, len(magic))
+    if not head:
+        return None
+    if len(head) < len(magic) or head != magic:
+        if len(head) < len(magic):
+            raise FrameTruncatedError(
+                f"truncated frame magic ({len(head)} bytes)")
+        raise FrameCorruptError(
+            f"bad frame magic {head!r} (expected {magic!r})")
+    meta = _read_exact(stream, _LEN_BYTES + _DIGEST_BYTES)
+    if len(meta) < _LEN_BYTES + _DIGEST_BYTES:
+        raise FrameTruncatedError(
+            f"truncated frame header ({len(head) + len(meta)} bytes)")
+    (length,) = struct.unpack(">Q", meta[:_LEN_BYTES])
+    if max_payload is not None and length > max_payload:
+        raise FrameCorruptError(
+            f"frame payload length {length} exceeds the {max_payload}-byte "
+            "cap")
+    digest = meta[_LEN_BYTES:]
+    payload = _read_exact(stream, length)
+    if len(payload) < length:
+        raise FrameTruncatedError(
+            f"frame payload is {len(payload)} bytes but the header "
+            f"recorded {length}")
+    if hashlib.sha256(payload).digest() != digest:
+        raise FrameCorruptError("frame payload checksum mismatch")
+    return payload
+
+
+def write_frame(stream, payload: bytes, *, magic: bytes) -> None:
+    """Write one frame to a stream exposing ``sendall`` (socket) or
+    ``write`` (file object)."""
+    data = encode_frame(payload, magic=magic)
+    send = getattr(stream, "sendall", None)
+    if send is not None:
+        send(data)
+        return
+    stream.write(data)
+    flush = getattr(stream, "flush", None)
+    if flush is not None:
+        flush()

@@ -63,9 +63,24 @@ continuous-batching discipline of modern inference servers applied to the
   retried under a :class:`~dask_ml_tpu.parallel.faults.RetryPolicy` —
   and never wedges the queue.
 
+- **SLO-aware admission** (ISSUE 14): ``submit(priority=, deadline=)``
+  — the dispatcher coalesces EARLIEST-DEADLINE-FIRST (priority breaks
+  ties and orders the deadline-less best-effort tier), and a request
+  whose deadline passes before dispatch is SHED with
+  :class:`DeadlineExceeded` instead of queueing to death. A stop/drain
+  is race-free by construction: once one begins, ``submit`` raises
+  :class:`ServingStopped`, and the dispatch thread's exit hygiene fails
+  everything it can no longer serve — a future is NEVER left pending,
+  even if the thread dies (``fatal``).
+- **Versioning**: registry entries carry a monotonic ``version``;
+  ``publish()``/``build()+install()`` are the zero-downtime hot-swap
+  seams the fleet builds on.
+
 ``ParallelPostFit(serving=loop)`` turns the sklearn-facing wrapper into a
-thin client of this loop; see ``docs/serving.md`` for bucket tuning and
-the latency-vs-occupancy tradeoff.
+thin client of this loop (a :class:`~dask_ml_tpu.parallel.fleet.
+ServingFleet` drops in the same way); see ``docs/serving.md`` for bucket
+tuning, the latency-vs-occupancy tradeoff, and the fleet tier above this
+loop.
 """
 
 from __future__ import annotations
@@ -87,7 +102,9 @@ __all__ = [
     "ServedModel",
     "ServingError",
     "ServingClosed",
+    "ServingStopped",
     "ServingQueueFull",
+    "DeadlineExceeded",
     "DEFAULT_SERVING_POLICY",
     "serving_buckets",
 ]
@@ -101,9 +118,28 @@ class ServingClosed(ServingError):
     """The loop is draining or stopped: no new requests are accepted."""
 
 
+class ServingStopped(ServingClosed):
+    """The loop has stopped (drain finished, ``stop(drain=False)``, or the
+    dispatch thread died): a request that reached it will NEVER be served
+    here. Raised synchronously by ``submit()`` once a stop/drain has
+    begun, and set on any future the stopped loop could no longer serve —
+    a request is never left forever-pending (pinned by the barrier test in
+    ``tests/test_serving.py``). The fleet router treats this as the
+    re-route-and-replay signal (``parallel/fleet.py``)."""
+
+
 class ServingQueueFull(ServingError):
     """The bounded request queue is at capacity (backpressure): the caller
-    should retry with backoff or shed load."""
+    should retry with backoff or shed load. At fleet level the router
+    spills over to a sibling replica before surfacing this
+    (``parallel/fleet.py``)."""
+
+
+class DeadlineExceeded(ServingError):
+    """The request's SLO deadline passed before it could be dispatched:
+    it was SHED (failed fast) instead of queueing to death. Raised
+    synchronously when the deadline is already past at ``submit()``, set
+    on the future when it expires while queued."""
 
 
 #: Serving-tuned bucket policy: pure powers of two from a 32-row floor.
@@ -290,14 +326,18 @@ def _n_features_of(est) -> Optional[int]:
 
 @dataclasses.dataclass
 class ServedModel:
-    """A registered, fitted estimator with its per-method runners and the
+    """A registered, fitted estimator with its per-method runners, the
     expected request width (``n_features``; ``None`` disables the width
-    check for host-fallback models that do not declare one)."""
+    check for host-fallback models that do not declare one), and the
+    registry-assigned monotonic ``version`` (0 until installed) — the
+    hot-swap coordinate: a dispatched batch holds ITS ServedModel, so
+    publishing a new version never perturbs in-flight work."""
 
     name: str
     estimator: object
     runners: dict
     n_features: Optional[int]
+    version: int = 0
 
     @property
     def methods(self) -> tuple:
@@ -310,31 +350,70 @@ class ModelRegistry:
     ``register`` builds the family runners (staging fitted state
     device-side once); ``ensure`` is the idempotent variant keyed on
     estimator identity that :class:`~dask_ml_tpu.wrappers.ParallelPostFit`
-    uses. Registration is cheap relative to a warmup, so re-registering
-    after a refit (``invalidate`` + ``register``) is the supported way to
-    roll a model version.
+    uses. Every installed entry carries a registry-wide MONOTONIC version
+    number; :meth:`publish` is the zero-downtime hot-swap seam — it
+    atomically replaces whatever currently holds the name (bumping the
+    version), while batches already dispatched finish on the ServedModel
+    they resolved (``invalidate`` + re-``register`` remains the refit
+    path for the same estimator object, same versioning). For swap with
+    no cold-start blip, :meth:`build` + warmup + :meth:`install` splits
+    publication so the new version's programs compile BEFORE it takes
+    traffic (:meth:`ServingLoop.warmup_model`, ``ServingFleet.swap``).
     """
 
     def __init__(self):
         self._lock = threading.Lock()
         self._models: dict = {}
         self._by_id: dict = {}  # id(estimator) -> name (ensure() memo)
+        self._next_version = 0
+
+    def build(self, name: str, estimator, *, methods=None) -> ServedModel:
+        """Construct a ServedModel (family detection + runners closing
+        over device-staged state) WITHOUT installing it: version 0 until
+        :meth:`install` publishes it."""
+        return ServedModel(name=str(name), estimator=estimator,
+                           runners=_build_runners(estimator, methods),
+                           n_features=_n_features_of(estimator))
+
+    def install(self, model: ServedModel) -> ServedModel:
+        """Atomically publish ``model`` under its name, bumping the
+        monotonic version — replaces any current holder (the hot-swap
+        seam; use :meth:`register` when accidental replacement should be
+        an error)."""
+        with self._lock:
+            self._next_version += 1
+            model.version = self._next_version
+            prior = self._models.get(model.name)
+            if prior is not None and prior.estimator is not model.estimator:
+                self._by_id.pop(id(prior.estimator), None)
+            self._models[model.name] = model
+            self._by_id[id(model.estimator)] = model.name
+        return model
+
+    def publish(self, name: str, estimator, *, methods=None) -> ServedModel:
+        """Hot-swap: build + install in one call. New requests resolve the
+        new version from their dispatch on; in-flight batches finish on
+        the old one."""
+        return self.install(self.build(name, estimator, methods=methods))
 
     def register(self, name: str, estimator, *, methods=None) -> ServedModel:
-        runners = _build_runners(estimator, methods)
-        model = ServedModel(name=str(name), estimator=estimator,
-                            runners=runners,
-                            n_features=_n_features_of(estimator))
+        model = self.build(name, estimator, methods=methods)
         with self._lock:
             prior = self._models.get(model.name)
             if prior is not None and prior.estimator is not estimator:
                 raise ValueError(
                     f"model name {model.name!r} is already registered to a "
                     "different estimator; unregister it first (or pick a "
-                    "distinct name)")
+                    "distinct name, or publish() to hot-swap)")
+            self._next_version += 1
+            model.version = self._next_version
             self._models[model.name] = model
             self._by_id[id(estimator)] = model.name
         return model
+
+    def version(self, name: str) -> int:
+        """The installed version serving ``name`` (KeyError if absent)."""
+        return self.get(name).version
 
     def ensure(self, estimator, name: Optional[str] = None) -> str:
         """Idempotent registration keyed on estimator identity: returns
@@ -381,8 +460,27 @@ class ModelRegistry:
 # ---------------------------------------------------------------------------
 
 
-@dataclasses.dataclass
-class _Request:
+def _fail_future(fut: Future, exc: BaseException) -> bool:
+    """Deliver ``exc`` to ``fut`` whatever state it is in: claims an
+    unclaimed future first (a client-cancelled one is dropped), tolerates
+    one already claimed or already resolved by a racing path. Returns
+    True when this call delivered the exception."""
+    if fut.done():
+        return False  # resolved/cancelled already (benign race)
+    try:
+        if not fut.set_running_or_notify_cancel():
+            return False  # client cancelled while queued
+    except RuntimeError:
+        pass  # already claimed by the dispatch path
+    try:
+        fut.set_exception(exc)
+        return True
+    except Exception:
+        return False  # already resolved — the race went the other way
+
+
+@dataclasses.dataclass(eq=False)  # identity equality: deque.remove must
+class _Request:                   # match THIS request, not array contents
     model: str
     method: str
     X: np.ndarray
@@ -394,6 +492,19 @@ class _Request:
     #: request's rows in exactly the dtype the caller passed (numpy
     #: concatenation would silently promote a mixed-dtype batch)
     key: tuple = ()
+    #: SLO coordinates: higher ``priority`` wins among equal deadlines;
+    #: ``deadline`` is the ABSOLUTE perf_counter instant past which the
+    #: request is shed (None = best-effort, sorts after every deadline)
+    priority: int = 0
+    deadline: Optional[float] = None
+    #: admission sequence (FIFO tiebreak inside one (deadline, priority))
+    seq: int = 0
+
+    def edf_key(self) -> tuple:
+        """Earliest-deadline-first admission order: deadline, then
+        priority (higher first), then arrival."""
+        d = self.deadline if self.deadline is not None else float("inf")
+        return (d, -self.priority, self.seq)
 
 
 class ServingLoop:
@@ -462,6 +573,21 @@ class ServingLoop:
         self._sharding = None
         self._align = 1
         self._batch_seq = 0
+        self._submit_seq = 0
+        self._last_beat = time.monotonic()
+        #: the exception that killed the dispatch thread (None = clean);
+        #: submit() surfaces it so a crashed loop fails fast, and the
+        #: fleet's health monitor reads it to classify the death
+        self.fatal: Optional[BaseException] = None
+        #: EWMA of reported batch latency (seconds) — the same quantity
+        #: the serving.batch_seconds histogram observes (incl. any
+        #: injected slow-replica penalty); the fleet router balances on
+        #: this together with queue_depth()
+        self._latency_ewma = 0.0
+        #: True while the dispatch thread is inside _execute — an
+        #: in-flight batch is load the queue no longer shows, so the
+        #: fleet router counts it
+        self.busy = False
         # operational counters (drain/flush logic + stats(); the
         # OBSERVABILITY surface is the telemetry registry, not these)
         self.n_submitted = 0
@@ -469,6 +595,7 @@ class ServingLoop:
         self.n_errors = 0
         self.n_batches = 0
         self.rows_served = 0
+        self.n_shed = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -496,6 +623,8 @@ class ServingLoop:
         self._closed = False
         self._stopped = False
         self._stopped_requested = False
+        self.fatal = None
+        self._last_beat = time.monotonic()
         self._thread = threading.Thread(
             target=self._run, name=f"{self.name}-dispatch", daemon=True)
         self._thread.start()
@@ -512,16 +641,17 @@ class ServingLoop:
         requests, lets the dispatch thread flush every queued batch, and
         resolves all futures before returning; ``drain=False`` fails
         queued requests with :class:`ServingClosed` immediately."""
+        dropped: list = []
         with self._cond:
             self._closed = True
             if not drain:
-                while self._queue:
-                    r = self._queue.popleft()
-                    if r.future.set_running_or_notify_cancel():
-                        r.future.set_exception(ServingClosed(
-                            "serving loop stopped without drain"))
+                dropped = list(self._queue)
+                self._queue = deque()
             self._stopped_requested = True
             self._cond.notify_all()
+        for r in dropped:
+            _fail_future(r.future, ServingStopped(
+                "serving loop stopped without drain"))
         t = self._thread
         if t is not None and t.is_alive() \
                 and t is not threading.current_thread():
@@ -531,6 +661,41 @@ class ServingLoop:
     @property
     def closed(self) -> bool:
         return self._closed
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped
+
+    def queue_depth(self) -> int:
+        """Current queued request count — the same value the
+        ``serving.queue_depth`` gauge exports; the fleet router reads it
+        here so balancing works with telemetry off."""
+        with self._cond:
+            return len(self._queue)
+
+    def latency_s(self) -> float:
+        """EWMA of reported batch latency in seconds (the
+        ``serving.batch_seconds`` surface, including any injected
+        slow-replica penalty) — the router's second balancing signal."""
+        return self._latency_ewma
+
+    def heartbeat_age(self) -> float:
+        """Seconds since the dispatch thread last proved liveness. It
+        beats every COLLECT iteration — the beat cannot run inside a
+        runner, so a batch that executes longer than the fleet's
+        heartbeat timeout reads as a stall. That false positive is
+        designed for: the fleet replays idempotently (duplicate compute
+        only) and REVIVES a declared-dead replica whose heartbeat
+        returns (``ServingFleet._monitor_loop``); a genuinely wedged
+        batch never beats again and stays dead."""
+        return time.monotonic() - self._last_beat
+
+    def alive(self) -> bool:
+        """True while the dispatch thread is running (started, not
+        stopped, not crashed)."""
+        t = self._thread
+        return (t is not None and t.is_alive() and not self._stopped
+                and self.fatal is None)
 
     def warmup(self, buckets=None, models=None) -> dict:
         """Pre-compile every (model, method, bucket) program by pushing a
@@ -549,24 +714,40 @@ class ServingLoop:
         n_programs = 0
         with track_compiles() as t:
             for name in names:
-                model = self.registry.get(name)
-                d = model.n_features
-                if d is None:
-                    continue
-                for method, runner in model.runners.items():
-                    if runner.kind != "device":
-                        continue
-                    for b in sizes:
-                        buf = np.zeros((int(b), d), self._batch_dtype())
-                        runner.run(self._stage(buf))
-                        n_programs += 1
+                n_programs += self.warmup_model(self.registry.get(name),
+                                                buckets=sizes)
         return {"n_programs": n_programs,
                 "n_compiles": t["n_compiles"],
                 "compile_seconds": round(t["compile_seconds"], 3)}
 
+    def warmup_model(self, model: ServedModel, buckets=None) -> int:
+        """Pre-compile one ServedModel's device programs through the
+        exact serving staging path — works on a NOT-yet-installed model
+        (:meth:`ModelRegistry.build`), which is how a zero-downtime
+        hot-swap compiles the incoming version before it takes traffic
+        (``ServingFleet.swap``). Returns the program count."""
+        if self._sharding is None:
+            raise ServingError("start() the loop before warmup")
+        sizes = list(buckets) if buckets is not None else serving_buckets(
+            self.policy, self.max_batch_rows, align=self._align)
+        d = model.n_features
+        if d is None:
+            return 0
+        n_programs = 0
+        for runner in model.runners.values():
+            if runner.kind != "device":
+                continue
+            for b in sizes:
+                buf = np.zeros((int(b), d), self._batch_dtype())
+                runner.run(self._stage(buf))
+                n_programs += 1
+        return n_programs
+
     # -- client side -------------------------------------------------------
 
-    def submit(self, model: str, X, method: str = "predict") -> Future:
+    def submit(self, model: str, X, method: str = "predict", *,
+               priority: int = 0,
+               deadline: Optional[float] = None) -> Future:
         """Enqueue one inference request; returns a Future resolving to
         the method's host-numpy result for exactly these rows.
 
@@ -578,7 +759,15 @@ class ServingLoop:
         given (dtype preserved, NaN passed through) so a foreign
         estimator behaves identically to calling it directly — NaN-native
         models keep working, and its own validation errors stay its
-        own."""
+        own.
+
+        SLO admission: ``deadline`` is this request's latency budget in
+        SECONDS from now; the dispatcher admits earliest-deadline-first
+        (``priority`` breaks ties, and orders the deadline-less
+        best-effort tier), and a request whose deadline passes before it
+        can dispatch is SHED with :class:`DeadlineExceeded` — immediately
+        when the budget is already non-positive here — instead of
+        queueing to death."""
         from dask_ml_tpu.parallel import telemetry
         from dask_ml_tpu.utils.validation import staging_dtype
 
@@ -619,22 +808,38 @@ class ServingLoop:
         else:
             key = (model, str(method), str(arr.dtype))
 
+        now = time.perf_counter()
+        if deadline is not None and float(deadline) <= 0.0:
+            self._count_shed(model)
+            raise DeadlineExceeded(
+                f"request deadline {float(deadline):.3f}s is already past "
+                "at admission")
         fut: Future = Future()
         req = _Request(model=model, method=str(method), X=arr,
                        n=int(arr.shape[0]), future=fut,
-                       t_enqueue=time.perf_counter(), key=key)
+                       t_enqueue=now, key=key, priority=int(priority),
+                       deadline=(None if deadline is None
+                                 else now + float(deadline)))
         with self._cond:
             if self._drain is not None and self._drain.requested:
                 # SIGTERM landed: stop accepting IMMEDIATELY (the dispatch
                 # thread flushes what is already queued)
                 self._closed = True
                 self._cond.notify_all()
-            if self._closed or self._stopped:
-                raise ServingClosed(
-                    f"serving loop {self.name!r} is not accepting requests")
+            if self._stopped or self.fatal is not None:
+                raise ServingStopped(
+                    f"serving loop {self.name!r} has stopped"
+                    + (f" ({self.fatal!r})" if self.fatal is not None
+                       else ""))
+            if self._closed:
+                raise ServingStopped(
+                    f"serving loop {self.name!r} is draining and not "
+                    "accepting requests")
             if len(self._queue) >= self.max_queue:
                 raise ServingQueueFull(
                     f"serving queue at capacity ({self.max_queue})")
+            req.seq = self._submit_seq
+            self._submit_seq += 1
             self._queue.append(req)
             depth = len(self._queue)
             self.n_submitted += 1
@@ -642,6 +847,13 @@ class ServingLoop:
         if telemetry.enabled():
             telemetry.metrics().gauge("serving.queue_depth").set(depth)
         return fut
+
+    def _count_shed(self, model: str, n: int = 1) -> None:
+        from dask_ml_tpu.parallel import telemetry
+
+        self.n_shed += n
+        if telemetry.enabled():
+            telemetry.metrics().counter("serving.shed", model=model).inc(n)
 
     def call(self, model: str, X, method: str = "predict",
              timeout: Optional[float] = None) -> np.ndarray:
@@ -668,6 +880,8 @@ class ServingLoop:
             "errors": self.n_errors,
             "batches": self.n_batches,
             "rows_served": self.rows_served,
+            "shed": self.n_shed,
+            "latency_ewma_s": round(self._latency_ewma, 6),
             "closed": self._closed,
         }
 
@@ -696,34 +910,80 @@ class ServingLoop:
                 put, kind="serving-transfer", detail=f"batch {seq}")
         return put()
 
+    def _shed_expired_locked(self) -> list:
+        """Under the lock: pull every queued request whose deadline has
+        passed. The caller resolves them OUTSIDE the lock (future
+        callbacks — e.g. the fleet router's — must never run under it)."""
+        now = time.perf_counter()
+        if not any(r.deadline is not None and r.deadline < now
+                   for r in self._queue):
+            return []
+        live: deque = deque()
+        shed = []
+        for r in self._queue:
+            if r.deadline is not None and r.deadline < now:
+                shed.append(r)
+            else:
+                live.append(r)
+        self._queue = live
+        return shed
+
+    def _resolve_shed(self, shed: list) -> None:
+        for r in shed:
+            late = time.perf_counter() - r.deadline
+            if _fail_future(r.future, DeadlineExceeded(
+                    f"request for {r.model!r}.{r.method} shed: deadline "
+                    f"passed {late * 1e3:.1f} ms before dispatch")):
+                self._count_shed(r.model)
+
+    def _pull_mates_locked(self, key, batch, rows) -> int:
+        """Under the lock: move every queued request sharing ``key`` into
+        ``batch`` (earliest-deadline-first) while the row budget holds.
+        One sort + one queue rebuild — O(n log n); per-mate
+        ``deque.remove`` would be O(n²) exactly when the queue is
+        deepest, with every submit blocked on this lock."""
+        mates = [r for r in self._queue if r.key == key]
+        if not mates:
+            return rows
+        mates.sort(key=_Request.edf_key)
+        taken = set()
+        for r in mates:
+            if rows + r.n <= self.max_batch_rows:
+                taken.add(id(r))
+                batch.append(r)
+                rows += r.n
+        if taken:
+            self._queue = deque(r for r in self._queue
+                                if id(r) not in taken)
+        return rows
+
     def _collect(self) -> list:
-        """Under the condition lock: wait for work, then pull the oldest
-        request plus every queued request sharing its (model, method), up
-        to the batch row budget. Returns [] when told to exit."""
-        with self._cond:
-            while True:
-                if self._queue:
-                    break
-                if self._closed or self._stopped \
-                        or getattr(self, "_stopped_requested", False):
-                    return []
-                if self._drain is not None and self._drain.requested:
-                    self._closed = True
-                    return []
-                self._cond.wait(timeout=0.05)
-            first = self._queue.popleft()
-            key = first.key
-            batch = [first]
-            rows = first.n
-            keep: deque = deque()
-            while self._queue:
-                r = self._queue.popleft()
-                if r.key == key and rows + r.n <= self.max_batch_rows:
-                    batch.append(r)
-                    rows += r.n
-                else:
-                    keep.append(r)
-            self._queue.extendleft(reversed(keep))
+        """Under the condition lock: wait for work, shed past-deadline
+        requests, then pull the earliest-deadline (then highest-priority,
+        then oldest) request plus every queued request sharing its
+        (model, method) coalesce key, up to the batch row budget.
+        Returns [] when told to exit."""
+        shed: list = []
+        try:
+            with self._cond:
+                while True:
+                    self._last_beat = time.monotonic()
+                    shed.extend(self._shed_expired_locked())
+                    if self._queue:
+                        break
+                    if self._closed or self._stopped \
+                            or self._stopped_requested:
+                        return []
+                    if self._drain is not None and self._drain.requested:
+                        self._closed = True
+                        return []
+                    self._cond.wait(timeout=0.05)
+                first = min(self._queue, key=_Request.edf_key)
+                self._queue.remove(first)
+                batch = [first]
+                rows = self._pull_mates_locked(first.key, batch, first.n)
+        finally:
+            self._resolve_shed(shed)
         if self.coalesce_window_s > 0:
             deadline = first.t_enqueue + self.coalesce_window_s
             while time.perf_counter() < deadline \
@@ -733,18 +993,9 @@ class ServingLoop:
                         remaining = deadline - time.perf_counter()
                         if remaining > 0:
                             self._cond.wait(timeout=remaining)
-                    pulled = False
-                    keep = deque()
-                    while self._queue:
-                        r = self._queue.popleft()
-                        if r.key == key \
-                                and rows + r.n <= self.max_batch_rows:
-                            batch.append(r)
-                            rows += r.n
-                            pulled = True
-                        else:
-                            keep.append(r)
-                    self._queue.extendleft(reversed(keep))
+                    before = len(batch)
+                    rows = self._pull_mates_locked(first.key, batch, rows)
+                    pulled = len(batch) > before
                     if self._closed or self._stopped:
                         break
                 if not pulled and time.perf_counter() >= deadline:
@@ -798,6 +1049,13 @@ class ServingLoop:
                     "serving.errors", model=model_name).inc(len(batch))
             return
         dt = time.perf_counter() - t0
+        # synthetic straggler penalty (FaultInjector.slow_replica): added
+        # to every latency this replica REPORTS — the EWMA/histograms its
+        # router balances on — without sleeping, so failover drills are
+        # deterministic and wall-clock-free
+        penalty = (self._fault_injector.dispatch_penalty(self.name)
+                   if self._fault_injector is not None else 0.0)
+        dt += penalty
         now = time.perf_counter()
         off = 0
         for r in batch:
@@ -806,6 +1064,8 @@ class ServingLoop:
         self.n_completed += len(batch)
         self.n_batches += 1
         self.rows_served += rows
+        self._latency_ewma = (dt if self._latency_ewma == 0.0
+                              else 0.7 * self._latency_ewma + 0.3 * dt)
         if tel:
             reg = telemetry.metrics()
             reg.counter("serving.batches", model=model_name).inc()
@@ -816,13 +1076,14 @@ class ServingLoop:
             reg.histogram("serving.batch_seconds").observe(dt)
             lat = reg.histogram("serving.request_seconds", model=model_name)
             for r in batch:
-                lat.observe(now - r.t_enqueue)
+                lat.observe(now - r.t_enqueue + penalty)
 
     def _run(self) -> None:
         import contextlib
 
         from dask_ml_tpu import config as config_lib
         from dask_ml_tpu.parallel import telemetry
+        from dask_ml_tpu.parallel.faults import SimulatedReplicaDeath
 
         # the dispatch thread inherits an ENABLED telemetry scope from the
         # thread that called start() (thread-local scopes don't cross
@@ -833,25 +1094,70 @@ class ServingLoop:
         # mid-flight.
         ctx = (config_lib.config_context(telemetry=True)
                if self._telemetry_inherit else contextlib.nullcontext())
-        with ctx:
-            while True:
-                batch = self._collect()
-                if not batch:
-                    with self._cond:
-                        drain_hit = (self._drain is not None
-                                     and self._drain.requested)
-                        if drain_hit:
-                            self._closed = True
-                        if (self._closed
-                                or getattr(self, "_stopped_requested", False)
-                                ) and not self._queue:
-                            self._stopped = True
-                            self._cond.notify_all()
-                            return
-                    continue
-                if telemetry.enabled():
-                    with self._cond:
-                        depth = len(self._queue)
-                    telemetry.metrics().gauge(
-                        "serving.queue_depth").set(depth)
-                self._execute(batch)
+        pending: list = []
+        try:
+            with ctx:
+                while True:
+                    batch = self._collect()
+                    if not batch:
+                        with self._cond:
+                            drain_hit = (self._drain is not None
+                                         and self._drain.requested)
+                            if drain_hit:
+                                self._closed = True
+                            if (self._closed or self._stopped_requested) \
+                                    and not self._queue:
+                                self._stopped = True
+                                self._cond.notify_all()
+                                return
+                        continue
+                    pending = batch
+                    fi = self._fault_injector
+                    if fi is not None:
+                        if fi.should_kill_replica(self.name,
+                                                  self.n_batches):
+                            raise SimulatedReplicaDeath(
+                                f"replica {self.name!r} killed by fault "
+                                f"plan after {self.n_batches} batches")
+                        fi.on_dispatch(self._batch_seq)
+                    if telemetry.enabled():
+                        with self._cond:
+                            depth = len(self._queue)
+                        telemetry.metrics().gauge(
+                            "serving.queue_depth").set(depth)
+                    self.busy = True
+                    try:
+                        self._execute(batch)
+                    finally:
+                        self.busy = False
+                    pending = []
+        except BaseException as e:  # noqa: BLE001 — record, then fail fast
+            self.fatal = e
+        finally:
+            self._finalize(pending)
+
+    def _finalize(self, pending: list) -> None:
+        """Dispatch-thread exit hygiene, clean or not: close the loop and
+        fail EVERY request the thread can no longer serve — the collected
+        batch it never executed plus the whole queue — with the fatal
+        error (crash) or :class:`ServingStopped`. A request is never left
+        forever-pending, whatever killed the thread."""
+        with self._cond:
+            self._closed = True
+            self._stopped = True
+            leftovers = list(pending) + list(self._queue)
+            self._queue = deque()
+            self._cond.notify_all()
+        if not leftovers and self.fatal is None:
+            return
+        exc = self.fatal if self.fatal is not None else ServingStopped(
+            f"serving loop {self.name!r} stopped before this request "
+            "could dispatch")
+        for r in leftovers:
+            _fail_future(r.future, exc)
+        if self.fatal is not None:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "serving loop %r dispatch thread died: %r (%d request(s) "
+                "failed over)", self.name, self.fatal, len(leftovers))

@@ -94,6 +94,22 @@ SECTIONS = [
      "pre-warmed shape buckets, with results bit-identical to direct "
      "predict calls — see docs/serving.md for bucket tuning, lifecycle, "
      "and the telemetry taxonomy."),
+    ("dask_ml_tpu.parallel.fleet", "Serving fleet",
+     "The fault-tolerant tier above the serving loop: ServingFleet runs "
+     "N replicas over disjoint device subsets behind a health-checked "
+     "router (heartbeats + consecutive-failure circuit breaker) that "
+     "balances on queue depth and latency, re-routes + replays in-flight "
+     "requests on replica death (idempotent by request id), spills "
+     "ServingQueueFull over to siblings, sheds past-deadline requests, "
+     "and hot-swaps model versions with pre-warmed programs and zero "
+     "downtime; FleetServer/FleetClient speak the framed wire protocol "
+     "for out-of-process clients — see docs/serving.md, \"The serving "
+     "fleet\", and the committed FLEET_r01.json kill drill."),
+    ("dask_ml_tpu.parallel.framing", "Frame codec",
+     "The shared length-prefixed magic+length+sha256 frame codec behind "
+     "both checkpoint snapshots and the serving wire protocol: "
+     "whole-buffer encode/decode plus stream read/write with typed "
+     "truncation/corruption errors."),
     ("dask_ml_tpu.parallel.hierarchy", "Two-level mesh scale-out",
      "The (pod, chip) hierarchical mesh and its communication-avoiding "
      "collective family: hpsum/hpmean/hpsum_scatter lower every hot "

@@ -828,3 +828,75 @@ def test_search_timed_out_cells_are_not_journaled(tmp_path):
     assert gs2.n_resumed_cells_ == 2  # only candidate p=1's cells restored
     np.testing.assert_array_equal(gs2.cv_results_["mean_test_score"],
                                   [1.0, 3.0])
+
+
+# ---------------------------------------------------------------------------
+# serving-fleet fault plans: stragglers + replica death (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+
+class TestServingFaultPlans:
+    def test_slow_replica_penalty_is_deterministic_and_sleep_free(self):
+        import time as _time
+
+        fi = FaultInjector().slow_replica("r0", 2.5, batches=2)
+        t0 = _time.perf_counter()
+        assert fi.dispatch_penalty("r0") == 2.5
+        assert fi.dispatch_penalty("r1") == 0.0  # only the named replica
+        assert fi.dispatch_penalty("r0") == 2.5
+        assert fi.dispatch_penalty("r0") == 0.0  # budget of 2 exhausted
+        assert _time.perf_counter() - t0 < 0.5   # no wall-clock sleeps
+        assert fi.injected["slow_replica"] == 2
+
+    def test_slow_replica_unbounded_until_cleared(self):
+        fi = FaultInjector().slow_replica("r0", 1.0)
+        for _ in range(5):
+            assert fi.dispatch_penalty("r0") == 1.0
+        assert fi.injected["slow_replica"] == 5
+
+    def test_delay_dispatch_sleeps_for_planned_batch_only(self):
+        import time as _time
+
+        fi = FaultInjector().delay_dispatch(2, 0.2, times=1)
+        t0 = _time.perf_counter()
+        fi.on_dispatch(0)
+        fi.on_dispatch(1)
+        assert _time.perf_counter() - t0 < 0.1
+        fi.on_dispatch(2)
+        assert _time.perf_counter() - t0 >= 0.2
+        fi.on_dispatch(2)  # budget exhausted: no second sleep
+        assert _time.perf_counter() - t0 < 0.45
+        assert fi.injected["dispatch_delay"] == 1
+
+    def test_kill_replica_one_shot_after_batches(self):
+        fi = FaultInjector().kill_replica("r1", after_batches=2)
+        assert not fi.should_kill_replica("r1", 0)
+        assert not fi.should_kill_replica("r1", 1)
+        assert not fi.should_kill_replica("r0", 5)  # wrong replica
+        assert fi.should_kill_replica("r1", 2)
+        assert not fi.should_kill_replica("r1", 3)  # one-shot
+        assert fi.injected["replica_kill"] == 1
+
+    def test_simulated_replica_death_not_transient(self):
+        """A dead replica must never be retried away by its own policy —
+        the fleet handles it by re-routing."""
+        from dask_ml_tpu.parallel.faults import SimulatedReplicaDeath
+
+        policy = RetryPolicy(max_retries=3)
+        assert not policy.is_transient(SimulatedReplicaDeath("x"))
+
+    def test_injected_counters_mirror_to_telemetry(self):
+        from dask_ml_tpu import config
+        from dask_ml_tpu.parallel import telemetry
+
+        telemetry.reset_telemetry()
+        fi = (FaultInjector().slow_replica("r0", 1.0, batches=1)
+              .delay_dispatch(0, 0.01).kill_replica("r0"))
+        with config.config_context(telemetry=True):
+            fi.dispatch_penalty("r0")
+            fi.on_dispatch(0)
+            fi.should_kill_replica("r0", 0)
+        counters = telemetry.telemetry_report()["metrics"]["counters"]
+        assert counters["faults.injected{kind=slow_replica}"] == 1
+        assert counters["faults.injected{kind=dispatch_delay}"] == 1
+        assert counters["faults.injected{kind=replica_kill}"] == 1

@@ -1,0 +1,729 @@
+"""The fault-tolerant serving fleet (docs/serving.md, "The serving
+fleet"): replica sharding over disjoint device subsets, health-checked
+routing with re-route + replay, SLO spillover/shedding, zero-downtime
+hot-swap, GracefulDrain composition across N loops, and the framed wire
+protocol for out-of-process clients.
+
+The load-bearing pins: a replica death never drops or double-resolves a
+request (idempotent by request id — a false-positive death costs
+duplicate compute only), served results stay bit-identical to the direct
+predict paths whichever replica answered, and a swap loses nothing.
+"""
+
+import pickle
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu import config
+from dask_ml_tpu.parallel import framing, telemetry
+from dask_ml_tpu.parallel.faults import FaultInjector, GracefulDrain
+from dask_ml_tpu.parallel.fleet import (
+    FleetClient,
+    FleetServer,
+    ServingFleet,
+)
+from dask_ml_tpu.parallel.serving import (
+    DeadlineExceeded,
+    ModelRegistry,
+    ServingQueueFull,
+    ServingStopped,
+)
+from dask_ml_tpu.parallel.shapes import track_compiles
+
+RAGGED_SIZES = (1, 3, 31, 32, 33, 64, 100, 128)
+
+
+def _data(n=512, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randn(n, d).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    from dask_ml_tpu.cluster import KMeans
+    from dask_ml_tpu.decomposition import PCA
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    X = _data(512, 8)
+    rng = np.random.RandomState(1)
+    y = (rng.rand(512) > 0.5).astype(np.int32)
+    return {
+        "X": X,
+        "kmeans": KMeans(n_clusters=4, random_state=0, max_iter=5).fit(X),
+        "logistic": LogisticRegression(max_iter=20).fit(X, y),
+        "logistic_v2": LogisticRegression(max_iter=60, C=0.3).fit(X, y),
+        "pca": PCA(n_components=3, random_state=0).fit(X),
+    }
+
+
+def _make_fleet(fitted, n_replicas=3, **kw):
+    fleet = ServingFleet(n_replicas=n_replicas, max_batch_rows=256, **kw)
+    fleet.start()
+    fleet.register("kmeans", fitted["kmeans"])
+    fleet.register("logistic", fitted["logistic"])
+    fleet.register("pca", fitted["pca"])
+    return fleet
+
+
+class _GateModel:
+    """Host-fallback model blocking until released; records batch row
+    counts (= dispatch order for distinct-size requests) and total
+    calls."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.calls = []
+        self._lock = threading.Lock()
+
+    def predict(self, X):
+        self.release.wait(60)
+        with self._lock:
+            self.calls.append(int(len(X)))
+        return np.zeros(len(X), np.float32)
+
+
+# ---------------------------------------------------------------------------
+# replica sharding + bit-identity
+# ---------------------------------------------------------------------------
+
+
+def test_replicas_get_disjoint_device_subsets(fitted):
+    fleet = _make_fleet(fitted, n_replicas=3)
+    try:
+        seen = set()
+        for rep in fleet._replicas:
+            devs = {d.id for d in rep.mesh.devices.flat}
+            assert not (devs & seen), "replica meshes overlap"
+            seen |= devs
+        assert fleet.replicas_up() == 3
+    finally:
+        fleet.stop()
+
+
+@pytest.mark.parametrize("name,method", [
+    ("kmeans", "predict"),
+    ("logistic", "predict"),
+    ("logistic", "predict_proba"),
+    ("pca", "transform"),
+])
+def test_bit_identity_every_replica(fitted, name, method):
+    """Every replica serves results bit-identical to the direct path —
+    pinned by submitting enough ragged requests that all three replicas
+    take traffic, then checking each against the direct call."""
+    fleet = _make_fleet(fitted, n_replicas=3)
+    try:
+        est = fitted[name]
+        X = fitted["X"]
+        direct = getattr(est, method)
+        futs = [(n, fleet.submit(name, X[:n], method=method))
+                for n in RAGGED_SIZES * 3]
+        for n, fut in futs:
+            assert np.array_equal(fut.result(60), direct(X[:n])), n
+        served = [r["batches"] for r in fleet.stats()["replicas"].values()]
+        assert sum(1 for b in served if b > 0) >= 2, served
+    finally:
+        fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# router: spillover, straggler avoidance, breaker
+# ---------------------------------------------------------------------------
+
+
+def test_queue_full_spills_over_before_surfacing(fitted):
+    """One replica at capacity triggers router spillover to a sibling;
+    ServingQueueFull reaches the caller only when EVERY live replica is
+    full."""
+    gate = _GateModel()
+    fleet = ServingFleet(n_replicas=2, max_batch_rows=8, max_queue=2,
+                         heartbeat_timeout_s=60.0)
+    fleet.start()
+    fleet.registry.register("gate", gate)
+    try:
+        futs = []
+        # 2 dispatching + 2x2 queued = saturation; submits past that must
+        # first spill across replicas, then raise
+        with pytest.raises(ServingQueueFull):
+            for _ in range(16):
+                futs.append(fleet.submit("gate", np.zeros((5, 3),
+                                                          np.float32)))
+        assert fleet.n_spillovers >= 1
+        gate.release.set()
+        for f in futs:
+            f.result(60)
+    finally:
+        gate.release.set()
+        fleet.stop()
+
+
+def test_router_avoids_injected_straggler(fitted):
+    """slow_replica marks one replica a synthetic straggler (no sleeps
+    anywhere); once its reported latency exceeds the routing quantum the
+    router sends traffic to the fast sibling."""
+    fi = FaultInjector().slow_replica("fl-r0", 5.0)
+    fleet = ServingFleet(n_replicas=2, max_batch_rows=256,
+                         fault_injector=fi, name="fl")
+    fleet.start()
+    fleet.register("kmeans", fitted["kmeans"])
+    try:
+        X = fitted["X"]
+        t0 = time.perf_counter()
+        for i in range(20):
+            fleet.call("kmeans", X[i:i + 4], timeout=60)
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 4.0, "synthetic penalty must not sleep"
+        assert fi.injected["slow_replica"] >= 1
+        r0, r1 = fleet._replicas
+        assert r0.loop.latency_s() > 1.0 > r1.loop.latency_s()
+        # after the first penalized batch, traffic goes to the sibling
+        assert fleet.stats()["replicas"]["fl-r1"]["batches"] >= 15
+    finally:
+        fleet.stop()
+
+
+def test_circuit_breaker_takes_failing_replica_out(fitted):
+    fleet = _make_fleet(fitted, n_replicas=2,
+                        max_consecutive_failures=3, breaker_cooldown_s=0.2)
+    try:
+        r0, r1 = fleet._replicas
+        for _ in range(3):
+            fleet._note_failure(r0)
+        assert r0.breaker_open()
+        for _ in range(10):
+            assert fleet._pick(set()) is r1
+        # cooldown expires -> half-open probe can pick r0 again
+        time.sleep(0.25)
+        picked = {fleet._pick(set()).name for _ in range(10)}
+        assert r0.name in picked
+        fleet._note_success(r0)
+        assert not r0.breaker_open()
+    finally:
+        fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# replica death: re-route + replay, idempotent by request id
+# ---------------------------------------------------------------------------
+
+
+def test_replica_kill_reroutes_and_replays(fitted):
+    """FaultInjector.kill_replica mid-traffic: the in-flight request
+    fails over to a survivor, nothing is dropped, every result stays
+    bit-identical, and the monitor takes the dead replica out."""
+    fi = FaultInjector().kill_replica("fk-r0", after_batches=1)
+    fleet = ServingFleet(n_replicas=3, max_batch_rows=256,
+                         fault_injector=fi, heartbeat_interval_s=0.02,
+                         name="fk")
+    fleet.start()
+    fleet.register("kmeans", fitted["kmeans"])
+    try:
+        X = fitted["X"]
+        km = fitted["kmeans"]
+        for i in range(40):
+            out = fleet.call("kmeans", X[i:i + 8], timeout=60)
+            assert np.array_equal(out, km.predict(X[i:i + 8]))
+        deadline = time.monotonic() + 5.0
+        while fleet.replicas_up() > 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        s = fleet.stats()
+        assert fi.injected["replica_kill"] == 1
+        assert s["replicas_up"] == 2
+        assert s["replica_deaths"] == 1
+        assert s["reroutes"] >= 1
+        assert s["inflight"] == 0
+    finally:
+        fleet.stop()
+
+
+def test_false_positive_death_duplicates_compute_not_resolution(fitted):
+    """Declaring a LIVE replica dead replays its in-flight request on a
+    survivor; when the 'dead' replica answers anyway, both completions
+    race to one fleet future and the first wins — duplicate compute,
+    never a dropped or double-resolved future."""
+    gate = _GateModel()
+    fleet = ServingFleet(n_replicas=2, max_batch_rows=8,
+                         heartbeat_timeout_s=60.0, name="fp")
+    fleet.start()
+    fleet.registry.register("gate", gate)
+    try:
+        fut = fleet.submit("gate", np.zeros((4, 3), np.float32))
+        deadline = time.monotonic() + 5.0
+        while not fleet._inflight and time.monotonic() < deadline:
+            time.sleep(0.01)
+        (freq,) = fleet._inflight.values()
+        victim = next(r for r in fleet._replicas
+                      if r.name == freq.replica)
+        fleet._declare_dead(victim)  # false positive: loop still alive
+        gate.release.set()
+        out = fut.result(60)
+        assert np.array_equal(out, np.zeros(4, np.float32))
+        deadline = time.monotonic() + 5.0
+        while len(gate.calls) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(gate.calls) == 2  # both replicas computed it
+        assert fleet.stats()["inflight"] == 0
+    finally:
+        gate.release.set()
+        fleet.stop()
+
+
+def test_heartbeat_stall_declares_dead_and_replays(fitted):
+    """A stalled heartbeat (thread alive but frozen past the timeout)
+    triggers the monitor's death path: in-flight work replays on a
+    survivor and the request still resolves."""
+    gate = _GateModel()
+    fleet = ServingFleet(n_replicas=2, max_batch_rows=8,
+                         heartbeat_interval_s=0.02,
+                         heartbeat_timeout_s=1.0, name="hb")
+    fleet.start()
+    fleet.registry.register("gate", gate)
+    fleet.register("kmeans", fitted["kmeans"])
+    try:
+        # the gate blocks one replica's dispatch thread mid-execute: its
+        # heartbeat stalls past the timeout while the OS thread stays
+        # alive — exactly a wedged replica
+        fut = fleet.submit("gate", np.zeros((4, 3), np.float32))
+        deadline = time.monotonic() + 15.0
+        while fleet.replicas_up() > 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert fleet.replicas_up() == 1
+        assert fleet.stats()["replica_deaths"] == 1
+        # release promptly: the REPLAYED gate batch now wedges the
+        # survivor the same way, and must finish before ITS timeout
+        gate.release.set()
+        assert np.array_equal(fut.result(60), np.zeros(4, np.float32))
+        # the survivor keeps serving device traffic
+        out = fleet.call("kmeans", fitted["X"][:8], timeout=60)
+        assert np.array_equal(out,
+                              fitted["kmeans"].predict(fitted["X"][:8]))
+    finally:
+        gate.release.set()
+        fleet.stop()
+
+
+def test_request_id_idempotent(fitted):
+    fleet = _make_fleet(fitted, n_replicas=2)
+    gate = _GateModel()
+    fleet.registry.register("gate", gate)
+    try:
+        f1 = fleet.submit("gate", np.zeros((3, 3), np.float32),
+                          request_id="rid-1")
+        f2 = fleet.submit("gate", np.zeros((3, 3), np.float32),
+                          request_id="rid-1")
+        assert f1 is f2  # client retry = the same request
+        gate.release.set()
+        f1.result(60)
+    finally:
+        gate.release.set()
+        fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# SLO admission at fleet level
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_shed_and_telemetry_mirrors(fitted):
+    telemetry.reset_telemetry()
+    with config.config_context(telemetry=True):
+        fleet = _make_fleet(fitted, n_replicas=2)
+        try:
+            with pytest.raises(DeadlineExceeded):
+                fleet.submit("kmeans", fitted["X"][:4], deadline=-1.0)
+            fleet.call("kmeans", fitted["X"][:4], timeout=60)
+            assert fleet.n_shed == 1
+        finally:
+            fleet.stop()
+        rep = telemetry.telemetry_report()
+    counters = rep["metrics"]["counters"]
+    assert counters["fleet.shed{model=kmeans}"] == 1
+    gauges = rep["metrics"]["gauges"]
+    assert gauges["fleet.replica_up"]["last"] == 2
+    names = [s["name"] for s in telemetry.spans()]
+    assert "fleet.request" in names
+
+
+def test_mixed_priority_traffic_all_resolve(fitted):
+    """Mixed priorities/deadlines through the fleet: everything either
+    resolves bit-identically or sheds with DeadlineExceeded — no third
+    outcome, nothing pending."""
+    fleet = _make_fleet(fitted, n_replicas=3)
+    try:
+        X = fitted["X"]
+        km = fitted["kmeans"]
+        futs = []
+        for i in range(60):
+            kw = {}
+            if i % 3 == 0:
+                kw = {"priority": 5, "deadline": 30.0}
+            elif i % 3 == 1:
+                kw = {"deadline": 30.0}
+            futs.append((i, fleet.submit("kmeans", X[i:i + 8], **kw)))
+        shed = 0
+        for i, f in futs:
+            try:
+                assert np.array_equal(f.result(60), km.predict(X[i:i + 8]))
+            except DeadlineExceeded:
+                shed += 1
+        assert shed == 0  # 30s budgets never lapse on this traffic
+    finally:
+        fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# zero-downtime hot-swap
+# ---------------------------------------------------------------------------
+
+
+def test_swap_under_traffic_loses_nothing(fitted):
+    """Hammer the fleet while swapping the model: every request resolves,
+    every result is bit-identical to the OLD or the NEW direct path, the
+    version bumps, and post-swap steady traffic compiles nothing (the
+    swap pre-warmed the incoming programs)."""
+    fleet = _make_fleet(fitted, n_replicas=3)
+    try:
+        X = fitted["X"]
+        old, new = fitted["logistic"], fitted["logistic_v2"]
+        v0 = fleet.registry.version("logistic")
+        old_out = {n: old.predict_proba(X[:n]) for n in (8, 16, 24)}
+        new_out = {n: new.predict_proba(X[:n]) for n in (8, 16, 24)}
+        results = []
+        errors = []
+        stop_evt = threading.Event()
+
+        def hammer():
+            i = 0
+            while not stop_evt.is_set():
+                n = (8, 16, 24)[i % 3]
+                i += 1
+                try:
+                    results.append(
+                        (n, fleet.call("logistic", X[:n],
+                                       method="predict_proba", timeout=60)))
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        v1 = fleet.swap("logistic", new)
+        with track_compiles() as steady:
+            time.sleep(0.3)
+        stop_evt.set()
+        for t in threads:
+            t.join(30)
+        assert not errors, errors
+        assert v1 > v0
+        assert fleet.registry.version("logistic") == v1
+        n_old = n_new = 0
+        for n, out in results:
+            if np.array_equal(out, old_out[n]):
+                n_old += 1
+            elif np.array_equal(out, new_out[n]):
+                n_new += 1
+            else:
+                raise AssertionError(
+                    "served result matches neither model version")
+        assert n_old > 0 and n_new > 0, (n_old, n_new)
+        assert steady["n_compiles"] == 0
+        # direct confirmation the new version serves going forward
+        assert np.array_equal(
+            fleet.call("logistic", X[:16], method="predict_proba",
+                       timeout=60),
+            new_out[16])
+    finally:
+        fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# GracefulDrain composition across N loops
+# ---------------------------------------------------------------------------
+
+
+def test_shared_drain_drains_all_replicas(fitted):
+    """One GracefulDrain shared by every replica + the fleet: request()
+    (the deterministic SIGTERM stand-in) makes every loop stop accepting,
+    flush its queue, and resolve every future; fleet submits afterwards
+    raise ServingStopped."""
+    drain = GracefulDrain()
+    fleet = ServingFleet(n_replicas=3, max_batch_rows=256, drain=drain,
+                         name="dr")
+    fleet.start()
+    fleet.register("kmeans", fitted["kmeans"])
+    try:
+        X = fitted["X"]
+        km = fitted["kmeans"]
+        futs = [fleet.submit("kmeans", X[:8]) for _ in range(20)]
+        drain.request()
+        expected = km.predict(X[:8])
+        for f in futs:
+            assert np.array_equal(f.result(60), expected)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            try:
+                fleet.submit("kmeans", X[:8])
+            except ServingStopped:
+                break
+            time.sleep(0.01)
+        else:
+            raise AssertionError("post-drain submit was not rejected")
+        for rep in fleet._replicas:
+            deadline = time.monotonic() + 10.0
+            while not rep.loop.stopped and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert rep.loop.stopped
+            assert rep.loop.queue_depth() == 0
+    finally:
+        fleet.stop()
+
+
+def test_drain_reentrancy_with_fleet(fitted):
+    """PR-8 re-entrancy rules hold when N loops share one drain: nested
+    scopes on the SAME drain install handlers once and restore at the
+    outermost exit, with the fleet's loops reading the shared flag."""
+    import signal
+
+    drain = GracefulDrain()
+    before = signal.getsignal(signal.SIGTERM)
+    with drain:
+        installed = signal.getsignal(signal.SIGTERM)
+        with drain:  # re-entry: no re-install
+            assert signal.getsignal(signal.SIGTERM) is installed
+            fleet = ServingFleet(n_replicas=2, drain=drain, name="rz")
+            fleet.start()
+            fleet.register("kmeans", fitted["kmeans"])
+            out = fleet.call("kmeans", fitted["X"][:8], timeout=60)
+            assert np.array_equal(
+                out, fitted["kmeans"].predict(fitted["X"][:8]))
+            fleet.stop()
+        assert signal.getsignal(signal.SIGTERM) is installed
+    assert signal.getsignal(signal.SIGTERM) == before
+
+
+def test_fleet_stop_leaves_nothing_pending(fitted):
+    """Barrier composition: submitter threads race fleet.stop(drain=True);
+    every obtained future resolves or raises ServingStopped."""
+    X = fitted["X"]
+    km = fitted["kmeans"]
+    fleet = _make_fleet(fitted, n_replicas=2)
+    barrier = threading.Barrier(4)
+    futures: list = []
+    flock = threading.Lock()
+
+    def worker():
+        barrier.wait()
+        for _ in range(40):
+            try:
+                f = fleet.submit("kmeans", X[:3])
+            except ServingStopped:
+                return
+            with flock:
+                futures.append(f)
+
+    threads = [threading.Thread(target=worker) for _ in range(3)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    time.sleep(0.01)
+    fleet.stop(drain=True)
+    for t in threads:
+        t.join(30)
+        assert not t.is_alive()
+    expected = km.predict(X[:3])
+    for f in futures:
+        try:
+            assert np.array_equal(f.result(10), expected)
+        except ServingStopped:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# the wire protocol
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def wired(fitted):
+    fleet = _make_fleet(fitted, n_replicas=2)
+    server = FleetServer(fleet).start()
+    yield fleet, server
+    server.stop()
+    fleet.stop()
+
+
+def test_wire_round_trip_bit_identical(wired, fitted):
+    fleet, server = wired
+    with FleetClient(server.address) as cli:
+        assert cli.ping()
+        for n in (1, 17, 64):
+            out = cli.call("kmeans", fitted["X"][:n], timeout=60)
+            assert np.array_equal(
+                out, fitted["kmeans"].predict(fitted["X"][:n]))
+            proba = cli.call("logistic", fitted["X"][:n],
+                             method="predict_proba", timeout=60)
+            assert np.array_equal(
+                proba, fitted["logistic"].predict_proba(fitted["X"][:n]))
+
+
+def test_wire_validation_fails_caller_not_connection(wired, fitted):
+    """A malformed request errors ITS frame only: the same connection
+    keeps serving afterwards (validation-fails-the-caller contract over
+    the wire)."""
+    fleet, server = wired
+    with FleetClient(server.address) as cli:
+        with pytest.raises(ValueError):
+            cli.call("kmeans", fitted["X"][:4, :5], timeout=60)  # bad width
+        with pytest.raises(KeyError):
+            cli.call("nosuch", fitted["X"][:4], timeout=60)
+        with pytest.raises(DeadlineExceeded):
+            cli.call("kmeans", fitted["X"][:4], deadline=-1.0, timeout=60)
+        out = cli.call("kmeans", fitted["X"][:8], timeout=60)
+        assert np.array_equal(
+            out, fitted["kmeans"].predict(fitted["X"][:8]))
+
+
+def test_wire_corrupt_frame_fails_caller_and_closes(wired):
+    """A frame that fails its checksum gets an error response and the
+    connection closes — the stream's byte alignment can no longer be
+    trusted."""
+    fleet, server = wired
+    sock = socket.create_connection(server.address, timeout=10)
+    try:
+        good = framing.encode_frame(
+            pickle.dumps({"op": "ping", "id": "x"}),
+            magic=framing.WIRE_MAGIC)
+        bad = bytearray(good)
+        bad[-1] ^= 0xFF  # flip a payload byte: checksum fails
+        sock.sendall(bytes(bad))
+        msg = pickle.loads(framing.read_frame(sock,
+                                              magic=framing.WIRE_MAGIC))
+        assert msg["ok"] is False
+        assert "Corrupt" in msg["error"]
+        assert framing.read_frame(sock, magic=framing.WIRE_MAGIC) is None
+    finally:
+        sock.close()
+    assert server.n_frame_errors == 1
+
+
+def test_wire_out_of_order_responses(wired, fitted):
+    """Responses return as futures resolve, tagged by id — one slow
+    request never convoys the connection."""
+    fleet, server = wired
+    gate = _GateModel()
+    fleet.registry.register("gate", gate)
+    try:
+        with FleetClient(server.address) as cli:
+            slow = cli.submit("gate", np.zeros((4, 3), np.float32))
+            deadline = time.monotonic() + 10.0
+            while not any(r.loop.busy for r in fleet._replicas) \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)  # gate batch is now mid-execute
+            fast = cli.submit("kmeans", fitted["X"][:8])
+            out = fast.result(60)  # resolves while the gate still blocks
+            assert not slow.done()
+            assert np.array_equal(
+                out, fitted["kmeans"].predict(fitted["X"][:8]))
+            gate.release.set()
+            assert np.array_equal(slow.result(60),
+                                  np.zeros(4, np.float32))
+    finally:
+        gate.release.set()
+
+
+def test_wire_server_fronts_single_loop(fitted):
+    """FleetServer also fronts a bare ServingLoop — the wire protocol is
+    the transport, not the fleet."""
+    from dask_ml_tpu.parallel.serving import ServingLoop
+
+    reg = ModelRegistry()
+    reg.register("kmeans", fitted["kmeans"])
+    with ServingLoop(reg, max_batch_rows=256) as lp:
+        server = FleetServer(lp).start()
+        try:
+            with FleetClient(server.address) as cli:
+                out = cli.call("kmeans", fitted["X"][:10], timeout=60)
+                assert np.array_equal(
+                    out, fitted["kmeans"].predict(fitted["X"][:10]))
+        finally:
+            server.stop()
+
+
+def test_parallel_post_fit_serves_through_fleet(fitted):
+    """ParallelPostFit(serving=fleet): the sklearn-facing wrapper is a
+    thin client of the whole fleet — chunking above the row cap, results
+    bit-identical to the direct path."""
+    from dask_ml_tpu.wrappers import ParallelPostFit
+
+    fleet = _make_fleet(fitted, n_replicas=2)
+    try:
+        clf = ParallelPostFit(estimator=fitted["kmeans"], serving=fleet,
+                              serving_model="ppf-kmeans")
+        X = fitted["X"]
+        out = clf.predict(X[:300])
+        assert np.array_equal(out, fitted["kmeans"].predict(X[:300]))
+        # above the cap: chunked across the fleet, order preserved
+        fleet2 = fleet  # same fleet; force chunking via block_size
+        clf_small = ParallelPostFit(estimator=fitted["pca"],
+                                    serving=fleet2, block_size=64)
+        got = clf_small.transform(X[:200])
+        assert np.array_equal(got, fitted["pca"].transform(X[:200]))
+    finally:
+        fleet.stop()
+
+
+def test_false_positive_death_heals_when_heartbeat_returns(fitted):
+    """Review pin: a replica declared dead on a stalled heartbeat (slow
+    batch, loop actually fine) is REVIVED once its beat returns — a
+    false positive is temporary, not a permanent capacity loss."""
+    gate = _GateModel()
+    fleet = ServingFleet(n_replicas=2, max_batch_rows=8,
+                         heartbeat_interval_s=0.02,
+                         heartbeat_timeout_s=0.3, name="rv")
+    fleet.start()
+    fleet.registry.register("gate", gate)
+    try:
+        fut = fleet.submit("gate", np.zeros((4, 3), np.float32))
+        deadline = time.monotonic() + 15.0
+        while fleet.replicas_up() > 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert fleet.replicas_up() == 1  # slow batch read as a death
+        gate.release.set()               # the batch completes, beat returns
+        fut.result(60)
+        deadline = time.monotonic() + 15.0
+        while fleet.replicas_up() < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert fleet.replicas_up() == 2  # resurrected
+        assert all(not r.dead for r in fleet._replicas)
+    finally:
+        gate.release.set()
+        fleet.stop()
+
+
+def test_clean_drain_records_no_replica_deaths(fitted):
+    """Review pin: replicas stopping under a fleet-wide GracefulDrain are
+    not 'deaths' — the counter and telemetry mirror stay at zero."""
+    telemetry.reset_telemetry()
+    drain = GracefulDrain()
+    with config.config_context(telemetry=True):
+        fleet = ServingFleet(n_replicas=2, drain=drain,
+                             heartbeat_interval_s=0.02, name="cd")
+        fleet.start()
+        fleet.register("kmeans", fitted["kmeans"])
+        fleet.call("kmeans", fitted["X"][:8], timeout=60)
+        drain.request()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not all(
+                r.loop.stopped for r in fleet._replicas):
+            time.sleep(0.01)
+        time.sleep(0.2)  # give the monitor ticks every chance to miscount
+        assert fleet.n_replica_deaths == 0
+        fleet.stop()
+    counters = telemetry.telemetry_report()["metrics"]["counters"]
+    assert not any(k.startswith("fleet.replica_deaths")
+                   for k in counters), counters

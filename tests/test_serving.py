@@ -763,3 +763,205 @@ def test_mid_fit_reregistration_dropped(fitted):
         clf.fit(X, y2)
         hook["fn"] = None
         assert np.array_equal(clf.predict(X[:50]), est.predict(X[:50]))
+
+
+# ---------------------------------------------------------------------------
+# SLO-aware admission: earliest-deadline-first, priorities, shedding
+# ---------------------------------------------------------------------------
+
+
+class _GateModel:
+    """Host-fallback model whose first dispatch blocks until released —
+    deterministic control over when the dispatcher makes its NEXT
+    admission decision — recording each batch's row count (requests carry
+    distinct row counts, so the call log IS the dispatch order)."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.calls = []
+
+    def predict(self, X):
+        self.release.wait(30)
+        self.calls.append(int(len(X)))
+        return np.zeros(len(X), np.float32)
+
+
+def _gate_loop(max_batch_rows=8):
+    reg = ModelRegistry()
+    gate = _GateModel()
+    reg.register("gate", gate)
+    lp = ServingLoop(reg, max_batch_rows=max_batch_rows)
+    lp.start()
+    return lp, gate
+
+
+def test_edf_admission_order():
+    """With the dispatcher blocked, queued requests dispatch earliest-
+    deadline-first; the deadline-less tier orders by priority (higher
+    first), then arrival. Row counts are sized so no two coalesce
+    (max_batch_rows=8), making the order observable per-batch."""
+    lp, gate = _gate_loop(max_batch_rows=8)
+    try:
+        X = np.zeros((8, 3), np.float32)
+        futs = [lp.submit("gate", X[:4])]          # blocker: dispatches 1st
+        time.sleep(0.15)                            # let it occupy the gate
+        futs.append(lp.submit("gate", X[:7], deadline=20.0))
+        futs.append(lp.submit("gate", X[:6]))                 # best-effort
+        futs.append(lp.submit("gate", X[:5], deadline=5.0))   # soonest
+        futs.append(lp.submit("gate", X[:8], priority=5))     # prio tier
+        gate.release.set()
+        for f in futs:
+            f.result(30)
+        assert gate.calls == [4, 5, 7, 8, 6]
+    finally:
+        lp.stop()
+
+
+def test_deadline_shed_at_admission():
+    lp, gate = _gate_loop()
+    gate.release.set()
+    try:
+        from dask_ml_tpu.parallel.serving import DeadlineExceeded
+
+        with pytest.raises(DeadlineExceeded):
+            lp.submit("gate", np.zeros((2, 3), np.float32), deadline=-0.5)
+        assert lp.n_shed == 1
+    finally:
+        lp.stop()
+
+
+def test_deadline_shed_while_queued():
+    """A queued request whose deadline passes before dispatch is shed
+    with DeadlineExceeded — it never queues to death — and the shed
+    counter mirrors to telemetry at the increment site."""
+    from dask_ml_tpu.parallel.serving import DeadlineExceeded
+
+    telemetry.reset_telemetry()
+    with config.config_context(telemetry=True):
+        lp, gate = _gate_loop()
+        try:
+            X = np.zeros((4, 3), np.float32)
+            blocker = lp.submit("gate", X[:4])        # occupies the gate
+            time.sleep(0.15)
+            doomed = lp.submit("gate", X[:3], deadline=0.05)
+            survivor = lp.submit("gate", X[:2], deadline=30.0)
+            time.sleep(0.3)                           # let the budget lapse
+            gate.release.set()
+            with pytest.raises(DeadlineExceeded):
+                doomed.result(30)
+            survivor.result(30)
+            blocker.result(30)
+            assert lp.n_shed == 1
+        finally:
+            lp.stop()
+        counters = telemetry.telemetry_report()["metrics"]["counters"]
+    assert counters["serving.shed{model=gate}"] == 1
+
+
+def test_registry_publish_versions():
+    """Monotonic versions + publish() as the hot-swap seam: register
+    assigns a version, publish replaces a DIFFERENT estimator under the
+    same name (register refuses that), and version() reports the
+    installed one."""
+    from dask_ml_tpu.linear_model import LinearRegression
+
+    rng = np.random.RandomState(3)
+    X = rng.randn(64, 4).astype(np.float32)
+    y = X @ rng.randn(4).astype(np.float32)
+    a = LinearRegression(max_iter=5).fit(X, y)
+    b = LinearRegression(max_iter=10).fit(X, y)
+    reg = ModelRegistry()
+    v1 = reg.register("m", a).version
+    assert reg.version("m") == v1 >= 1
+    with pytest.raises(ValueError):
+        reg.register("m", b)  # accidental replacement stays an error
+    v2 = reg.publish("m", b).version
+    assert v2 > v1 and reg.get("m").estimator is b
+    # in-flight semantics: a batch holding the OLD ServedModel still runs
+    old = reg.build("m", a)
+    assert old.version == 0  # not installed
+    reg.install(old)
+    assert reg.version("m") > v2 and reg.get("m").estimator is a
+
+
+# ---------------------------------------------------------------------------
+# the stop(drain=True) vs submit() race: never a forever-pending future
+# ---------------------------------------------------------------------------
+
+
+def test_stop_submit_race_barrier(fitted):
+    """Satellite pin: submitter threads race stop(drain=True) across a
+    start barrier; EVERY future they obtained must resolve — with a
+    result (admitted before the drain) or ServingStopped — and no submit
+    may hang. Repeated to widen the race window."""
+    from dask_ml_tpu.parallel.serving import ServingStopped
+
+    X = fitted["X"]
+    km = fitted["kmeans"]
+    for _trial in range(4):
+        reg = ModelRegistry()
+        reg.register("kmeans", km)
+        lp = ServingLoop(reg, max_batch_rows=64).start()
+        barrier = threading.Barrier(5)
+        futures: list = []
+        flock = threading.Lock()
+
+        def worker():
+            barrier.wait()
+            for _ in range(40):
+                try:
+                    f = lp.submit("kmeans", X[:3])
+                except ServingClosed:  # includes ServingStopped
+                    return
+                with flock:
+                    futures.append(f)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        lp.stop(drain=True)
+        for t in threads:
+            t.join(30)
+            assert not t.is_alive()
+        expected = km.predict(X[:3])
+        for f in futures:
+            assert f.done() or f.exception(timeout=10) is not None \
+                or f.result(0) is not None  # resolved one way or the other
+            try:
+                assert np.array_equal(f.result(0), expected)
+            except ServingStopped:
+                pass  # rejected by the drain — allowed; pending is not
+
+
+def test_dispatch_thread_death_fails_everything(fitted):
+    """Crash hygiene: if the dispatch thread dies (BaseException out of a
+    runner), queued futures fail with the fatal error, nothing is left
+    pending, and later submits raise ServingStopped naming it."""
+    from dask_ml_tpu.parallel.serving import ServingStopped
+
+    class _Bomb:
+        def __init__(self):
+            self.armed = threading.Event()
+
+        def predict(self, X):
+            self.armed.wait(30)
+            raise KeyboardInterrupt("simulated thread death")
+
+    bomb = _Bomb()
+    reg = ModelRegistry()
+    reg.register("bomb", bomb)
+    lp = ServingLoop(reg, max_batch_rows=4).start()
+    X = np.zeros((3, 2), np.float32)
+    first = lp.submit("bomb", X)
+    time.sleep(0.1)
+    queued = lp.submit("bomb", X)  # second batch, still queued
+    bomb.armed.set()
+    with pytest.raises(BaseException):
+        first.result(30)
+    with pytest.raises((KeyboardInterrupt, ServingStopped)):
+        queued.result(30)
+    assert isinstance(lp.fatal, KeyboardInterrupt)
+    with pytest.raises(ServingStopped):
+        lp.submit("bomb", X)
+    lp.stop()
