@@ -3067,6 +3067,324 @@ def bench_fleet(_rtt):
             + ", ".join(g for g, v in gates.items() if not v))
 
 
+def bench_fleet_proc(_rtt):
+    """Process-isolation kill drill (ISSUE 15; docs/serving.md, "The
+    process-isolated fleet"): the kill drill graduates from simulated
+    thread death to ``kill -9`` of a live replica OS PROCESS under
+    traffic, plus a hedging A/B under a real injected straggler.
+
+    Phases:
+    1. fit three families once; they ship to every replica process via
+       the registry snapshot;
+    2. hedging A/B: two fleets of ``FLEETPROC_REPLICAS`` replica
+       processes, replica slot 0 carrying a REAL wall-clock straggle
+       plan (``FaultInjector.straggle_replica``: sleep
+       ``FLEETPROC_STRAGGLE_S`` every ``FLEETPROC_STRAGGLE_EVERY``-th
+       batch). Identical seeded closed-loop traffic with hedging OFF
+       then ON — the measured p99 must improve;
+    3. kill -9: a fresh fleet (telemetry on), closed-loop traffic;
+       at ~1/3 of traffic the coordinator sends REAL ``SIGKILL`` to a
+       replica process. The router must replay its in-flight requests on
+       survivors (idempotent by request id), respawn the slot — snapshot
+       load + warmup through the exact serving staging path BEFORE
+       rejoining rotation — and finish the run;
+    4. drain: SIGTERM to every replica; graceful exit 0 everywhere
+       (except the SIGKILLed incarnation, whose -9 is itself a gate).
+
+    Gates (nonzero exit on failure): the kill was a real SIGKILL of a
+    real OS process; ZERO dropped requests and ZERO double-resolutions
+    (every future resolved exactly once — ``n_results`` equals resolved
+    count); every result — including replayed and hedged ones —
+    bit-identical to the direct path; the respawned replica rejoins with
+    zero steady-state compiles; hedged p99 < unhedged p99 under the
+    straggler (which must be visible unhedged); hedge/respawn/death
+    telemetry mirrors exact; and the fleet module is pickle-free
+    (``grep -r pickle dask_ml_tpu/parallel/fleet.py`` comes back
+    empty). Committed as FLEET_r02.json; the CI ``chaos`` job runs this
+    scaled to 2 replica processes.
+    """
+    import signal as signal_mod
+    import threading
+
+    import jax
+
+    from dask_ml_tpu import config as config_lib
+    from dask_ml_tpu.cluster import KMeans
+    from dask_ml_tpu.decomposition import PCA
+    from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.parallel import telemetry
+    from dask_ml_tpu.parallel.procfleet import ProcessFleet
+
+    n_fit, d = 4096, 32
+    replicas = int(os.environ.get("FLEETPROC_REPLICAS", "3"))
+    clients = int(os.environ.get("FLEETPROC_CLIENTS", "8"))
+    reqs_per_client = int(os.environ.get("FLEETPROC_REQS", "24"))
+    straggle_s = float(os.environ.get("FLEETPROC_STRAGGLE_S", "0.25"))
+    straggle_every = int(os.environ.get("FLEETPROC_STRAGGLE_EVERY", "3"))
+    max_batch_rows = 1024
+
+    rng = np.random.RandomState(0)
+    X = rng.standard_normal((n_fit, d)).astype(np.float32)
+    y = (X @ rng.standard_normal(d).astype(np.float32) > 0).astype(np.int32)
+    km = KMeans(n_clusters=16, random_state=0, max_iter=10).fit(X)
+    lr = LogisticRegression(max_iter=30).fit(X, y)
+    pca = PCA(n_components=8, random_state=0).fit(X)
+    direct = {
+        ("kmeans", "predict"): km.predict,
+        ("logistic", "predict_proba"): lr.predict_proba,
+        ("pca", "transform"): pca.transform,
+    }
+    keys = sorted(direct)
+    size_choices = [1, 2, 4, 8, 16, 24, 32, 48, 64, 96, 128]
+    trng = np.random.RandomState(42)
+    trace = []
+    for c in range(clients):
+        rows = []
+        for r in range(reqs_per_client):
+            key = keys[trng.randint(len(keys))]
+            size = int(size_choices[trng.randint(len(size_choices))])
+            rows.append((key, int(trng.randint(0, n_fit - size)), size))
+        trace.append(rows)
+    total_requests = clients * reqs_per_client
+
+    def build(name, *, hedge, straggle=None):
+        fleet = ProcessFleet(
+            n_replicas=replicas, max_batch_rows=max_batch_rows,
+            hedge=hedge, hedge_min_s=0.02, request_timeout_s=300.0,
+            straggle=straggle, name=name)
+        fleet.register("kmeans", km)
+        fleet.register("logistic", lr)
+        fleet.register("pca", pca)
+        return fleet.start()
+
+    def closed_loop(fleet, on_complete=None):
+        """Run the seeded trace; returns (latencies, outcomes, errors,
+        wall)."""
+        lat: list = []
+        outcomes: list = []
+        errors: list = []
+        lock = threading.Lock()
+        done = [0]
+        start_evt = threading.Event()
+
+        def client(rows):
+            mine_lat, mine_out = [], []
+            start_evt.wait()
+            for key, off, size in rows:
+                name, method = key
+                t0 = time.perf_counter()
+                try:
+                    out = fleet.submit(
+                        name, X[off:off + size], method=method).result(300)
+                except Exception as e:  # noqa: BLE001 — gate on these
+                    errors.append((key, off, size, repr(e)))
+                    continue
+                mine_lat.append(time.perf_counter() - t0)
+                mine_out.append((key, off, size, out))
+                with lock:
+                    done[0] += 1
+                if on_complete is not None:
+                    on_complete(done[0])
+            with lock:
+                lat.extend(mine_lat)
+                outcomes.extend(mine_out)
+
+        threads = [threading.Thread(target=client, args=(rows,))
+                   for rows in trace]
+        for t in threads:
+            t.start()
+        t0 = time.perf_counter()
+        start_evt.set()
+        for t in threads:
+            t.join()
+        return lat, outcomes, errors, time.perf_counter() - t0
+
+    def verify(outcomes):
+        bad = 0
+        cache: dict = {}
+        for key, off, size, out in outcomes:
+            ck = (key, off, size)
+            if ck not in cache:
+                cache[ck] = direct[key](X[off:off + size])
+            if not np.array_equal(out, cache[ck]):
+                bad += 1
+        return bad
+
+    # -- phase 2: hedging A/B under a real straggler ----------------------
+    hedge_ab = {}
+    for hedge in (False, True):
+        fleet = build(f"pf-h{int(hedge)}", hedge=hedge,
+                      straggle={0: (straggle_s, straggle_every)})
+        try:
+            lat, outcomes, errors, wall = closed_loop(fleet)
+            stats = fleet.stats()
+        finally:
+            fleet.stop()
+        p50, p99 = (float(v) * 1e3 for v in np.percentile(lat, [50, 99]))
+        hedge_ab["hedged" if hedge else "unhedged"] = {
+            "p50_ms": round(p50, 3), "p99_ms": round(p99, 3),
+            "qps": round(len(lat) / wall, 1),
+            "resolved": len(lat), "errors": errors[:5],
+            "mismatches": verify(outcomes),
+            "hedged": stats["hedged"], "hedge_wins": stats["hedge_wins"],
+            "reroutes": stats["reroutes"],
+        }
+    p99_unhedged = hedge_ab["unhedged"]["p99_ms"]
+    p99_hedged = hedge_ab["hedged"]["p99_ms"]
+
+    # -- phase 3: kill -9 of a live replica process under traffic ---------
+    kill_info: dict = {}
+    with config_lib.config_context(telemetry=True):
+        telemetry.reset_telemetry(ring_capacity=65_536)
+        fleet = build("pf-kill", hedge=True)
+        try:
+            pids_before = {rep.name: rep.pid for rep in fleet._procs}
+            victim = fleet._procs[0]
+            old_pid, old_proc = victim.pid, victim.proc
+            killed = threading.Event()
+            kill_lock = threading.Lock()
+
+            def maybe_kill(done_count):
+                # atomic test-and-set: exactly ONE client thread delivers
+                # the kill, and a pid already reaped by the respawner
+                # must not blow up that client's trace
+                if done_count < total_requests // 3:
+                    return
+                with kill_lock:
+                    if killed.is_set():
+                        return
+                    killed.set()
+                try:
+                    os.kill(old_pid, signal_mod.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                kill_info["at_completed"] = done_count
+
+            results_before = fleet.n_results
+            lat, outcomes, errors, wall = closed_loop(
+                fleet, on_complete=maybe_kill)
+            resolved = len(outcomes)
+            first_resolutions = fleet.n_results - results_before
+            old_proc.wait(60)
+            # wait out the respawn, then prove steady-state is compile-free
+            deadline_t = time.monotonic() + 300.0
+            while (fleet.replicas_up() < replicas
+                   or fleet.n_respawns < 1) \
+                    and time.monotonic() < deadline_t:
+                time.sleep(0.05)
+            post_outcomes = []
+            for i in range(10 * replicas):
+                out = fleet.call("kmeans", X[i:i + 16], timeout=300)
+                post_outcomes.append((("kmeans", "predict"), i, 16, out))
+            remote = fleet.remote_stats()
+            stats = fleet.stats()
+            kill_info.update(
+                victim=victim.name, old_pid=old_pid,
+                old_exit=old_proc.returncode, new_pid=victim.pid,
+                deaths=stats["replica_deaths"],
+                respawns=stats["respawns"],
+                reroutes=stats["reroutes"],
+                replicas_up_after=fleet.replicas_up())
+        finally:
+            fleet.stop()
+        exit_codes = {rep.name: rep.proc.returncode
+                      for rep in fleet._procs}
+        report = telemetry.telemetry_report()
+
+    counters = report["metrics"]["counters"]
+
+    def mirror(prefix):
+        return sum(v for k, v in counters.items()
+                   if k == prefix or k.startswith(prefix + "{"))
+
+    steady_compiles = {name: st.get("steady_compiles")
+                       for name, st in remote.items()}
+    fleet_src = open(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "dask_ml_tpu", "parallel", "fleet.py")).read()
+    dropped = total_requests - resolved - len(errors)
+    p50, p99 = (float(v) * 1e3 for v in np.percentile(lat, [50, 99]))
+    gates = {
+        "replicas_are_processes":
+            len(set(pids_before.values())) == replicas
+            and os.getpid() not in pids_before.values(),
+        "kill_was_real_sigkill":
+            kill_info.get("old_exit") == -signal_mod.SIGKILL,
+        "zero_dropped_requests":
+            dropped == 0 and not errors,
+        "zero_double_resolutions":
+            first_resolutions == resolved,
+        "replayed_results_bit_identical":
+            verify(outcomes) == 0 and verify(post_outcomes) == 0
+            and hedge_ab["unhedged"]["mismatches"] == 0
+            and hedge_ab["hedged"]["mismatches"] == 0,
+        "respawn_rejoined_rotation":
+            kill_info.get("respawns") == 1
+            and kill_info.get("replicas_up_after") == replicas
+            and kill_info.get("new_pid") != kill_info.get("old_pid"),
+        "respawn_zero_steady_compiles":
+            len(steady_compiles) == replicas
+            and all(v == 0 for v in steady_compiles.values()),
+        "hedging_improves_p99":
+            hedge_ab["hedged"]["hedged"] >= 1
+            and p99_hedged < p99_unhedged,
+        "straggler_visible_unhedged":
+            p99_unhedged >= straggle_s * 1e3 * 0.8,
+        "telemetry_mirrors_exact":
+            mirror("fleet.respawns") == kill_info.get("respawns")
+            and mirror("fleet.replica_deaths") == kill_info.get("deaths")
+            and mirror("fleet.reroutes") == kill_info.get("reroutes"),
+        "graceful_drain_exit_codes":
+            all(rc == 0 for rc in exit_codes.values()),
+        "fleet_module_pickle_free": "pickle" not in fleet_src,
+    }
+    rec = {
+        "metric": "fleet_proc_drill",
+        "value": round(resolved / wall, 1),
+        "unit": "sustained QPS across replica PROCESSES (with mid-run "
+                "kill -9 + respawn)",
+        "vs_baseline": None,  # robustness drill: the gates ARE the result
+        "backend": jax.default_backend(),
+        "all_gates_pass": all(gates.values()),
+        "gates": gates,
+        "replicas": replicas,
+        "clients": clients, "reqs_per_client": reqs_per_client,
+        "total_requests": total_requests,
+        "resolved": resolved, "dropped": dropped,
+        "first_resolutions": first_resolutions,
+        "errors": errors[:10],
+        "p50_ms": round(p50, 3), "p99_ms": round(p99, 3),
+        "hedging_ab": hedge_ab,
+        "straggle": {"seconds": straggle_s, "every": straggle_every,
+                     "replica_slot": 0},
+        "kill": kill_info,
+        "steady_compiles_after_respawn": steady_compiles,
+        "exit_codes_after_drain": exit_codes,
+        "telemetry_mirrors": {
+            "fleet.respawns": mirror("fleet.respawns"),
+            "fleet.replica_deaths": mirror("fleet.replica_deaths"),
+            "fleet.reroutes": mirror("fleet.reroutes"),
+            "serving.hedged": mirror("serving.hedged"),
+            "serving.hedge_wins": mirror("serving.hedge_wins"),
+        },
+        "note": "replica processes spawned via the ReplicaHost "
+                "entrypoint (registry snapshot + warmup before "
+                "rotation); slot-0 straggle is a REAL wall-clock sleep "
+                "every Nth batch; the kill is os.kill(SIGKILL) of a "
+                "live replica pid mid-traffic. Scaled down in CI via "
+                "FLEETPROC_REPLICAS/FLEETPROC_CLIENTS/FLEETPROC_REQS.",
+    }
+    emit(rec)
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "FLEET_r02.json")
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=1)
+    if not all(gates.values()):
+        raise SystemExit(
+            "fleet-proc drill: failed gates: "
+            + ", ".join(g for g, v in gates.items() if not v))
+
+
 # ---------------------------------------------------------------------------
 # KDD-Cup'99 harness (the reference's flagship real-data benchmark,
 # benchmarks/k_means_kdd.py:95-125: KMeans(n_clusters=8,
@@ -3680,6 +3998,15 @@ if __name__ == "__main__":
         # gate failure (committed as PRECISION_r01.json)
         _enable_compilation_cache()
         bench_precision(measure_rtt())
+        emit_summary()
+    elif "--fleet-proc" in sys.argv:
+        # process-isolation kill drill (ISSUE 15); CI's chaos job runs
+        # this scaled to 2 replica processes: kill -9 of a live replica
+        # OS process under traffic, replay/respawn/zero-drop gates, the
+        # hedging A/B under a real straggler, and the pickle-free wire
+        # pin — nonzero exit on any gate (committed as FLEET_r02.json)
+        _enable_compilation_cache()
+        bench_fleet_proc(measure_rtt())
         emit_summary()
     elif "--serving" in sys.argv:
         # online-serving drill (ISSUE 9); CI's serving job runs this

@@ -90,11 +90,20 @@ from dask_ml_tpu.parallel.serving import (  # noqa: F401
 from dask_ml_tpu.parallel.fleet import (  # noqa: F401
     FleetClient,
     FleetServer,
+    FleetTimeoutError,
     ServingFleet,
 )
 from dask_ml_tpu.parallel.elastic import (  # noqa: F401
     BlockPlan,
     ElasticRun,
+    FileHeartbeat,
+)
+
+# the process-isolated fleet tier (out-of-process replicas): imported
+# lazily by name to keep `import dask_ml_tpu.parallel` light — but the
+# router class is small and pure-host, so re-exporting it here is cheap
+from dask_ml_tpu.parallel.procfleet import (  # noqa: F401
+    ProcessFleet,
 )
 
 # runtime (multi-host bootstrap) is imported lazily by users that need it:

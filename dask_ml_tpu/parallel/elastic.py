@@ -62,7 +62,74 @@ from dask_ml_tpu.parallel.faults import Preempted
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["BlockPlan", "ElasticRun", "SimulatedHostDeath"]
+__all__ = ["BlockPlan", "ElasticRun", "FileHeartbeat",
+           "SimulatedHostDeath"]
+
+
+class FileHeartbeat:
+    """mtime-heartbeat + tombstone liveness over a shared directory —
+    the PR-8 coordination primitive factored out of :class:`ElasticRun`
+    so every fleet of PROCESSES shares one liveness layer (the elastic
+    data plane's hosts here; the process-isolated serving replicas in
+    ``parallel/procfleet.py``).
+
+    ``workdir/hb/<member>`` holds heartbeat files whose MTIME is the
+    signal (writes are atomic temp+rename, so readers never see a torn
+    file); ``workdir/dead/<member>`` holds tombstones left by graceful
+    leavers — a member that died for real (SIGKILL, machine loss) leaves
+    nothing: its beats simply stop, and observers detect the silence by
+    age. That asymmetry is the whole protocol: clean exits are observed
+    immediately, dirty ones within the observer's timeout.
+    """
+
+    def __init__(self, workdir: str):
+        self.workdir = str(workdir)
+        self._hb = os.path.join(self.workdir, "hb")
+        self._dead = os.path.join(self.workdir, "dead")
+        os.makedirs(self._hb, exist_ok=True)
+        os.makedirs(self._dead, exist_ok=True)
+
+    def hb_path(self, member: str) -> str:
+        return os.path.join(self._hb, str(member))
+
+    def tomb_path(self, member: str) -> str:
+        return os.path.join(self._dead, str(member))
+
+    def beat(self, member: str) -> None:
+        """Refresh ``member``'s heartbeat (atomic, mtime-signaled)."""
+        path = self.hb_path(member)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            f.write(f"{time.time():.6f}\n")
+        os.replace(tmp, path)
+
+    def tombstone(self, member: str) -> None:
+        """Leave ``member``'s tombstone (the graceful-leaver courtesy:
+        observers skip the timeout)."""
+        with open(self.tomb_path(member), "w") as f:
+            f.write(f"{time.time():.6f}\n")
+
+    def has_tombstone(self, member: str) -> bool:
+        return os.path.exists(self.tomb_path(member))
+
+    def age(self, member: str) -> Optional[float]:
+        """Seconds since ``member``'s last beat, or ``None`` when no
+        heartbeat was ever observed (the caller decides how a
+        never-launched member ages)."""
+        try:
+            return time.time() - os.path.getmtime(self.hb_path(member))
+        except OSError:
+            return None
+
+    def clear(self, member: str) -> None:
+        """Forget ``member``'s heartbeat AND tombstone — the respawn
+        hygiene: a fresh incarnation must not inherit its predecessor's
+        death record or stale beat."""
+        for path in (self.hb_path(member), self.tomb_path(member)):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
 
 
 class SimulatedHostDeath(RuntimeError):
@@ -223,8 +290,10 @@ class ElasticRun:
         return os.path.join(self.workdir, self._ns, sub)
 
     def _ensure_dirs(self) -> None:
-        for sub in ("hb", "dead", "blocks"):
-            os.makedirs(self._dir(sub), exist_ok=True)
+        # liveness (hb/ + dead/) goes through the shared FileHeartbeat
+        # primitive; blocks/ is this class's own publication directory
+        self._live = FileHeartbeat(os.path.join(self.workdir, self._ns))
+        os.makedirs(self._dir("blocks"), exist_ok=True)
 
     def bind_problem(self, kind: str, **bind) -> str:
         """Scope this run to a problem fingerprint: the coordination tree
@@ -266,27 +335,22 @@ class ElasticRun:
     # -- liveness ----------------------------------------------------------
 
     def _hb_path(self, rank: int) -> str:
-        return os.path.join(self._dir("hb"), f"host{int(rank)}")
+        return self._live.hb_path(f"host{int(rank)}")
 
     def _tomb_path(self, rank: int) -> str:
-        return os.path.join(self._dir("dead"), f"host{int(rank)}")
+        return self._live.tomb_path(f"host{int(rank)}")
 
     def beat(self) -> None:
         """Refresh this process's heartbeat (mtime is the signal; the
         write is atomic so readers never see a torn file)."""
-        path = self._hb_path(self.rank)
-        tmp = f"{path}.{os.getpid()}.tmp"
-        with open(tmp, "w") as f:
-            f.write(f"{time.time():.6f}\n")
-        os.replace(tmp, path)
+        self._live.beat(f"host{self.rank}")
 
     def mark_dead(self, rank: int) -> None:
         """Leave a tombstone for ``rank`` — the deterministic test hook
         (and the graceful leaver's own exit courtesy): survivors observe
         the death immediately instead of waiting out the heartbeat
         timeout."""
-        with open(self._tomb_path(rank), "w") as f:
-            f.write(f"{time.time():.6f}\n")
+        self._live.tombstone(f"host{int(rank)}")
 
     def lost_hosts(self) -> set:
         """Ranks currently considered lost: tombstoned, or heartbeat
@@ -301,16 +365,13 @@ class ElasticRun:
         for r in range(self.world):
             if r == self.rank or r in lost:
                 continue
-            if os.path.exists(self._tomb_path(r)):
+            if self._live.has_tombstone(f"host{r}"):
                 lost.add(r)
                 continue
-            try:
-                age = now - os.path.getmtime(self._hb_path(r))
-            except OSError:
+            age = self._live.age(f"host{r}")
+            fresh_hb = age is not None
+            if age is None:
                 age = now - self._t0
-                fresh_hb = False
-            else:
-                fresh_hb = True
             if age > self.heartbeat_timeout:
                 lost.add(r)
             elif fresh_hb and r in self._ever_lost:

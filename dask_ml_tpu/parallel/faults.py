@@ -495,11 +495,14 @@ class FaultInjector:
         self._dispatch_delay: dict = {}  # batch -> [times_left, seconds]
         self._slow_replica: dict = {}    # replica -> [batches_left, seconds]
         self._kill_replica: dict = {}    # replica -> after_batches
+        self._kill_process: dict = {}    # name -> after_requests
+        self._straggle: dict = {}        # replica -> [count, every, s, left]
         self._p_load = 0.0
         self._p_exc = InjectedLoaderError
         self.injected = {"load": 0, "transfer": 0, "delay": 0, "preempt": 0,
                          "die": 0, "dispatch_delay": 0, "slow_replica": 0,
-                         "replica_kill": 0}
+                         "replica_kill": 0, "process_kill": 0,
+                         "straggle": 0}
 
     # -- planning ----------------------------------------------------------
 
@@ -575,6 +578,56 @@ class FaultInjector:
         the fleet's router re-routes + replays them
         (``parallel/fleet.py``). One-shot per replica."""
         self._kill_replica[str(replica)] = int(after_batches)
+        return self
+
+    def kill_process(self, name: str, *,
+                     after_requests: int = 0) -> "FaultInjector":
+        """Kill the OS process hosting ``name`` once it has served
+        ``after_requests`` wire requests — REAL ``SIGKILL`` semantics,
+        delivered by :meth:`maybe_kill_process` in the victim process
+        itself: no drain, no atexit, no flush; heartbeats simply stop
+        and the socket goes dark mid-stream. This is the process-fleet
+        analogue of :meth:`kill_replica` (which kills a dispatch THREAD
+        and therefore still unwinds Python): the ``ReplicaHost`` worker
+        polls the plan so chaos drills can place the kill deterministically
+        at a request count instead of a wall-clock race. One-shot per
+        name."""
+        self._kill_process[str(name)] = int(after_requests)
+        return self
+
+    def should_kill_process(self, name: str, n_requests: int) -> bool:
+        """True exactly once, when ``name`` has served
+        ``after_requests`` requests (see :meth:`kill_process`)."""
+        with self._lock:
+            after = self._kill_process.get(str(name))
+            if after is None or int(n_requests) < after:
+                return False
+            del self._kill_process[str(name)]
+            self.injected["process_kill"] += 1
+        self._mirror("process_kill")
+        return True
+
+    def maybe_kill_process(self, name: str, n_requests: int) -> None:
+        """Deliver the :meth:`kill_process` plan: ``SIGKILL`` to OUR OWN
+        pid when the plan fires. Nothing after this line runs — which is
+        the point."""
+        if self.should_kill_process(name, n_requests):
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def straggle_replica(self, replica: str, seconds: float, *,
+                         every: int = 1,
+                         batches: Optional[int] = None) -> "FaultInjector":
+        """Make replica ``replica`` a REAL straggler: every ``every``-th
+        dispatched batch sleeps ``seconds`` of wall clock before
+        executing (``batches`` bounds the total penalized dispatches;
+        default unbounded). Unlike :meth:`slow_replica` — whose penalty
+        is synthetic, only REPORTED latency — this one actually stalls
+        the dispatch thread, which is what a hedging drill needs: the
+        router must rescue the request's tail latency, not just route
+        around a number."""
+        self._straggle[str(replica)] = [
+            0, max(int(every), 1), float(seconds),
+            -1 if batches is None else int(batches)]
         return self
 
     def random_load_failures(self, p: float,
@@ -662,6 +715,25 @@ class FaultInjector:
         if delay:
             self._mirror("dispatch_delay")
             time.sleep(delay)
+
+    def dispatch_sleep(self, replica: str) -> float:
+        """Real straggler hook: sleep per the :meth:`straggle_replica`
+        plan before replica ``replica`` dispatches a batch; returns the
+        seconds slept (0.0 when the plan did not fire)."""
+        with self._lock:
+            plan = self._straggle.get(str(replica))
+            if not plan or plan[3] == 0:
+                return 0.0
+            plan[0] += 1
+            if plan[0] % plan[1] != 0:
+                return 0.0
+            if plan[3] > 0:
+                plan[3] -= 1
+            self.injected["straggle"] += 1
+            seconds = plan[2]
+        self._mirror("straggle")
+        time.sleep(seconds)
+        return seconds
 
     def dispatch_penalty(self, replica: str) -> float:
         """Synthetic straggler: extra seconds replica ``replica`` must

@@ -43,9 +43,19 @@ exceeds capacity):
 - **Wire protocol**: :class:`FleetServer` accepts out-of-process clients
   over a socket speaking the shared length-prefixed magic+length+sha256
   frame codec (:mod:`dask_ml_tpu.parallel.framing` — the same frame
-  layout PR 8's checkpoints use). One frame = one request; responses
-  return out of order tagged by id, and a request that fails validation
-  fails ITS caller's frame only — never a batch another client shares.
+  layout PR 8's checkpoints use). Frame payloads are the TYPED codec
+  (:func:`~dask_ml_tpu.parallel.framing.encode_payload`: a JSON control
+  envelope + dtype/shape-tagged numpy buffers, strict decode caps, no
+  object deserialization anywhere), so the socket surface is safe for
+  untrusted clients. One frame = one request; responses return out of
+  order tagged by id, and a request that fails validation fails ITS
+  caller's frame only — never a batch another client shares.
+  :class:`FleetClient` adds per-request deadlines (a wedged server
+  surfaces as a typed :class:`FleetTimeoutError`, never an eternal
+  block) and reconnects ONCE when the server closed the previous
+  connection cleanly between frames. The process-isolated tier above
+  this (``parallel/procfleet.py``) runs each replica as its own OS
+  process behind exactly this wire.
 
 Telemetry (all at their increment sites, mirror discipline of
 docs/observability.md): ``fleet.reroutes``, ``fleet.spillover``,
@@ -59,12 +69,13 @@ gates as FLEET_r01.json (docs/serving.md, "The serving fleet").
 from __future__ import annotations
 
 import dataclasses
-import pickle
+import os
 import socket
 import threading
 import time
 import uuid
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as _FutureTimeout
 from typing import Optional
 
 import numpy as np
@@ -86,7 +97,17 @@ __all__ = [
     "ServingFleet",
     "FleetServer",
     "FleetClient",
+    "FleetTimeoutError",
 ]
+
+
+class FleetTimeoutError(ServingError):
+    """A wire request (or ping) exceeded its deadline: the server socket
+    is wedged, the replica process is gone without closing the
+    connection, or the request simply outlived its budget. Typed so
+    callers (and the process-fleet router, which treats it as a re-route
+    signal) can distinguish "no answer in time" from a served error —
+    and so nothing ever blocks forever on a dead peer."""
 
 
 def _set_future(fut: Future, result) -> bool:
@@ -709,20 +730,11 @@ _WIRE_ERRORS = {
     "ServingQueueFull": ServingQueueFull,
     "ServingStopped": ServingStopped,
     "ServingClosed": ServingClosed,
+    "FleetTimeoutError": FleetTimeoutError,
+    "PayloadError": framing.PayloadError,
     "ValueError": ValueError,
     "KeyError": KeyError,
 }
-
-
-def _encode_array(arr: np.ndarray) -> dict:
-    arr = np.ascontiguousarray(arr)
-    return {"dtype": str(arr.dtype), "shape": tuple(arr.shape),
-            "data": arr.tobytes()}
-
-
-def _decode_array(msg: dict) -> np.ndarray:
-    return np.frombuffer(
-        msg["data"], dtype=np.dtype(msg["dtype"])).reshape(msg["shape"])
 
 
 class FleetServer:
@@ -731,25 +743,38 @@ class FleetServer:
     requests as frames of the shared codec
     (:data:`~dask_ml_tpu.parallel.framing.WIRE_MAGIC`).
 
-    One frame carries one pickled request dict (``op="submit"``: id,
-    model, method, priority, deadline, and the row array as raw bytes +
-    dtype/shape); responses are frames tagged with the request id and
-    return OUT OF ORDER as futures resolve, so one slow request never
-    convoys a connection. A request that fails validation (or sheds on
-    its deadline) gets an error response naming the exception class —
-    that caller only, never a shared batch
-    (validation-fails-the-caller-not-the-batch, docs/serving.md); a frame
-    that fails its checksum gets an error response and the connection is
-    closed (the stream can no longer be trusted).
+    One frame carries one TYPED request payload
+    (:func:`~dask_ml_tpu.parallel.framing.encode_payload`: a JSON
+    control envelope — ``op="submit"``, id, model, method, priority,
+    deadline — plus the row array as one dtype/shape-tagged buffer);
+    responses are frames tagged with the request id and return OUT OF
+    ORDER as futures resolve, so one slow request never convoys a
+    connection. ``op="ping"`` answers with the serving pid;
+    ``op="stats"`` returns the routing-signal snapshot (queue depth,
+    latency EWMA, batch count — plus whatever ``extra_stats`` adds; the
+    process-fleet replicas report their steady-state compile count
+    through it). A request that fails validation (or sheds on its
+    deadline) gets an error response naming the exception class — that
+    caller only, never a shared batch
+    (validation-fails-the-caller-not-the-batch, docs/serving.md). A
+    payload that fails its typed decode fails ITS frame only (the frame
+    boundary was intact); a frame that fails its checksum gets an error
+    response and the connection is closed (the stream's byte alignment
+    can no longer be trusted).
 
-    Trust boundary: payloads are pickled — serve trusted networks only
-    (same posture as the checkpoint files this codec came from).
+    Nothing received on this socket is ever deserialized as an object —
+    control is JSON under a size cap, buffers are (dtype, shape, bytes)
+    against an allowlist — so the surface is safe for untrusted clients
+    (the remaining exposure is load, which ``max_payload`` and the
+    serving layer's admission control bound).
     """
 
     def __init__(self, fleet, host: str = "127.0.0.1", port: int = 0, *,
-                 max_payload: int = 256 * 1024 * 1024):
+                 max_payload: int = 256 * 1024 * 1024,
+                 extra_stats=None):
         self.fleet = fleet
         self.max_payload = int(max_payload)
+        self._extra_stats = extra_stats
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, int(port)))
@@ -803,7 +828,7 @@ class FleetServer:
     #: rather than buffering unboundedly
     MAX_PENDING_RESPONSES = 1024
 
-    def _send(self, conn, out_q, msg: dict) -> None:
+    def _send(self, conn, out_q, control: dict, arrays=()) -> None:
         """Enqueue one response for the connection's writer thread. The
         write itself happens OFF the caller's thread: responses are
         delivered from future callbacks that run on replica dispatch
@@ -813,7 +838,7 @@ class FleetServer:
         import queue as queue_mod
 
         try:
-            out_q.put_nowait(msg)
+            out_q.put_nowait((control, tuple(arrays)))
         except queue_mod.Full:
             try:
                 conn.close()  # reader+writer unwind on the closed socket
@@ -825,7 +850,20 @@ class FleetServer:
             msg = out_q.get()
             if msg is None:
                 return
-            payload = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+            control, arrays = msg
+            try:
+                payload = framing.encode_payload(control, arrays)
+            except framing.PayloadError as e:
+                # an un-encodable RESPONSE (e.g. a host-fallback model
+                # returning string labels — a dtype the typed wire
+                # refuses) fails ITS caller with an error frame; the
+                # writer must survive, or every later response on this
+                # connection silently wedges
+                payload = framing.encode_payload({
+                    "id": control.get("id"), "ok": False,
+                    "error": "PayloadError",
+                    "message": f"response not wire-encodable: "
+                               f"{str(e)[:512]}"})
             try:
                 framing.write_frame(conn, payload,
                                     magic=framing.WIRE_MAGIC)
@@ -874,28 +912,65 @@ class FleetServer:
             if conn in self._conns:
                 self._conns.remove(conn)
 
+    def _stats_snapshot(self) -> dict:
+        """The routing-signal summary ``op="stats"`` answers with —
+        loop-side queue depth + latency EWMA (the same surfaces the
+        in-process router balances on) plus the serving pid, so a
+        process-fleet router can label its telemetry per replica
+        process."""
+        target = self.fleet
+        out = {"pid": os.getpid(), "n_requests": self.n_requests}
+        qd = getattr(target, "queue_depth", None)
+        if callable(qd):
+            out["queue_depth"] = int(qd())
+        lat = getattr(target, "latency_s", None)
+        if callable(lat):
+            out["latency_ewma_s"] = float(lat())
+        out["batches"] = int(getattr(target, "n_batches", 0))
+        if self._extra_stats is not None:
+            out.update(self._extra_stats())
+        return out
+
     def _handle(self, conn, out_q, payload: bytes) -> None:
-        msg: dict = {}
         rid = None
         try:
-            msg = pickle.loads(payload)
+            msg, arrays = framing.decode_payload(payload)
             op = msg.get("op")
             rid = msg.get("id")
+            if rid is not None and not isinstance(rid, str):
+                raise framing.PayloadError(
+                    f"request id must be a string, got "
+                    f"{type(rid).__name__}")
             if op == "ping":
                 self._send(conn, out_q, {"id": rid, "ok": True,
-                                         "pong": True})
+                                         "pong": True,
+                                         "pid": os.getpid()})
+                return
+            if op == "stats":
+                self._send(conn, out_q, {"id": rid, "ok": True,
+                                         "stats": self._stats_snapshot()})
                 return
             if op != "submit":
                 raise ValueError(f"unknown wire op {op!r}")
-            X = _decode_array(msg)
+            if len(arrays) != 1:
+                raise framing.PayloadError(
+                    f"submit expects exactly one array buffer, got "
+                    f"{len(arrays)}")
+            X = arrays[0]
+            deadline = msg.get("deadline")
+            if deadline is not None and not isinstance(
+                    deadline, (int, float)):
+                raise framing.PayloadError(
+                    "deadline must be a number or null")
             self.n_requests += 1
             kwargs = {}
             if rid is not None and isinstance(self.fleet, ServingFleet):
                 kwargs["request_id"] = rid  # client retry = same request
             fut = self.fleet.submit(
-                msg["model"], X, method=msg.get("method", "predict"),
+                str(msg.get("model")), X,
+                method=str(msg.get("method", "predict")),
                 priority=int(msg.get("priority", 0)),
-                deadline=msg.get("deadline"), **kwargs)
+                deadline=deadline, **kwargs)
         except Exception as e:  # noqa: BLE001 — per-frame error delivery
             self._send(conn, out_q, {
                 "id": rid, "ok": False,
@@ -910,36 +985,87 @@ class FleetServer:
                     "id": rid, "ok": False,
                     "error": type(e).__name__, "message": str(e)})
             else:
-                self._send(conn, out_q, {
-                    "id": rid, "ok": True, **_encode_array(out)})
+                self._send(conn, out_q, {"id": rid, "ok": True},
+                           arrays=(np.asarray(out),))
 
         fut.add_done_callback(deliver)
 
 
 class FleetClient:
-    """Out-of-process client of a :class:`FleetServer`: frames requests
-    over one socket, demultiplexes out-of-order responses by id on a
-    reader thread. ``submit`` returns a Future; ``call`` blocks. Error
-    responses re-raise as the same exception classes a local caller
-    would see (:data:`_WIRE_ERRORS`; anything unmapped surfaces as
-    ``RuntimeError`` naming the remote class)."""
+    """Out-of-process client of a :class:`FleetServer`: frames typed
+    requests over one socket, demultiplexes out-of-order responses by id
+    on a reader thread. ``submit`` returns a Future; ``call`` blocks.
+    Error responses re-raise as the same exception classes a local
+    caller would see (:data:`_WIRE_ERRORS`; anything unmapped surfaces
+    as ``RuntimeError`` naming the remote class).
 
-    def __init__(self, address, *, timeout: Optional[float] = None):
-        host, port = address
-        self._sock = socket.create_connection((host, int(port)),
-                                              timeout=timeout)
+    Deadlines: ``request_timeout`` (and the per-call ``timeout=`` on
+    ``submit``) arms a reaper that fails the future with the typed
+    :class:`FleetTimeoutError` when no response arrived in time — a
+    wedged or silently-dead server can never block a caller forever
+    (``ping`` has the same contract). Timeouts mirror to the
+    ``fleet.timeouts`` counter at the increment site.
+
+    Reconnect: when the server closed the previous connection CLEANLY
+    between frames (EOF, not an error), the next ``submit``/``ping``
+    transparently reconnects once. In-flight requests of the closed
+    connection were already failed with ``ServingStopped`` — reconnect
+    never resurrects them; a torn connection (reset, frame corruption)
+    stays down so the failure is visible.
+    """
+
+    def __init__(self, address, *, timeout: Optional[float] = None,
+                 request_timeout: Optional[float] = None,
+                 send_timeout: Optional[float] = 30.0):
+        self.address = (address[0], int(address[1]))
+        self._connect_timeout = timeout
+        self.request_timeout = request_timeout
+        self.send_timeout = send_timeout
         self._wlock = threading.Lock()
         self._lock = threading.Lock()
         self._pending: dict = {}  # id -> Future
+        self._deadlines: dict = {}  # id -> absolute monotonic instant
         # globally-unique id prefix: rids reach the FLEET's dedup table,
         # where two clients colliding (id() reuse across processes or
         # after GC) would silently hand one client the other's result
         self._rid_prefix = uuid.uuid4().hex[:16]
         self._seq = 0
         self._closed = False
-        self._reader = threading.Thread(
-            target=self._read_loop, name="fleet-client-reader", daemon=True)
-        self._reader.start()
+        self._clean_eof = False
+        self._reconnected = False
+        self._reaper: Optional[threading.Thread] = None
+        self.n_timeouts = 0
+        self.n_reconnects = 0
+        from dask_ml_tpu.parallel import telemetry
+
+        self._telemetry_inherit = telemetry.enabled()
+        self._sock = self._connect()
+
+    def _connect(self):
+        import struct as struct_mod
+
+        sock = socket.create_connection(self.address,
+                                        timeout=self._connect_timeout)
+        # the connect timeout must not leak into the reader's blocking
+        # recv (an idle connection would look like a dead one)
+        sock.settimeout(None)
+        if self.send_timeout is not None:
+            # kernel-level SEND timeout only (SO_SNDTIMEO): a wedged
+            # server whose recv buffer filled must fail the sender's
+            # sendall instead of blocking it forever under the write
+            # lock — socket.settimeout would also arm recv and kill the
+            # reader on every idle connection
+            try:
+                t = float(self.send_timeout)
+                sock.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                    struct_mod.pack("ll", int(t),
+                                    int((t - int(t)) * 1e6)))
+            except (OSError, AttributeError):
+                pass  # platform without SO_SNDTIMEO: keep blocking sends
+        threading.Thread(target=self._read_loop, args=(sock,),
+                         name="fleet-client-reader", daemon=True).start()
+        return sock
 
     def close(self) -> None:
         self._closed = True
@@ -954,23 +1080,25 @@ class FleetClient:
     def __exit__(self, *exc) -> None:
         self.close()
 
-    def _read_loop(self) -> None:
+    def _read_loop(self, sock) -> None:
         exc: BaseException = ServingStopped("wire connection closed")
+        clean = False
         try:
             while True:
-                payload = framing.read_frame(self._sock,
+                payload = framing.read_frame(sock,
                                              magic=framing.WIRE_MAGIC)
                 if payload is None:
+                    clean = True
                     break
-                msg = pickle.loads(payload)
+                msg, arrays = framing.decode_payload(payload)
                 rid = msg.get("id")
                 with self._lock:
                     fut = self._pending.pop(rid, None)
+                    self._deadlines.pop(rid, None)
                 if fut is None:
                     continue  # response to a caller that went away
                 if msg.get("ok"):
-                    _set_future(fut, _decode_array(msg)
-                                if "data" in msg else msg)
+                    _set_future(fut, arrays[0] if arrays else msg)
                 else:
                     cls = _WIRE_ERRORS.get(msg.get("error"), RuntimeError)
                     _fail_future(fut, cls(
@@ -979,46 +1107,186 @@ class FleetClient:
             exc = e
         finally:
             with self._lock:
+                if sock is self._sock:
+                    # a cleanly-closed connection arms the one-shot
+                    # reconnect; a torn one stays down
+                    self._clean_eof = clean and not self._closed
                 pending = list(self._pending.values())
                 self._pending.clear()
+                self._deadlines.clear()
+            cause = (ServingStopped("wire connection closed by server")
+                     if clean else ServingStopped(
+                         f"wire connection lost: {exc!r}"))
             for fut in pending:
-                _fail_future(fut, ServingStopped(
-                    f"wire connection lost: {exc!r}"))
+                _fail_future(fut, cause)
 
-    def submit(self, model: str, X, method: str = "predict", *,
-               priority: int = 0,
-               deadline: Optional[float] = None) -> Future:
-        if self._closed:
-            raise ServingStopped("client is closed")
+    def _ensure_connected(self) -> None:
+        """Reconnect ONCE after a clean server-side close (under the
+        write lock's caller)."""
         with self._lock:
+            if not self._clean_eof or self._closed:
+                return
+            if self._reconnected:
+                raise ServingStopped(
+                    "wire connection closed by server (already "
+                    "reconnected once)")
+            self._clean_eof = False
+            self._reconnected = True
+            self.n_reconnects += 1
+        try:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = self._connect()
+        except OSError as e:
+            raise ServingStopped(f"wire reconnect failed: {e!r}")
+
+    def _count_timeout(self) -> None:
+        from dask_ml_tpu.parallel import telemetry
+
+        with self._lock:
+            self.n_timeouts += 1
+        if telemetry.enabled():
+            telemetry.metrics().counter("fleet.timeouts").inc()
+
+    def _reap_loop(self) -> None:
+        import contextlib
+
+        from dask_ml_tpu import config as config_lib
+
+        ctx = (config_lib.config_context(telemetry=True)
+               if self._telemetry_inherit else contextlib.nullcontext())
+        with ctx:
+            while not self._closed:
+                now = time.monotonic()
+                expired = []
+                with self._lock:
+                    for rid, t in list(self._deadlines.items()):
+                        if t <= now:
+                            self._deadlines.pop(rid, None)
+                            fut = self._pending.pop(rid, None)
+                            if fut is not None:
+                                expired.append((rid, fut))
+                    if not self._deadlines and not expired:
+                        # nothing armed: exit instead of idle-polling;
+                        # _arm_deadline restarts the thread (same lock)
+                        self._reaper = None
+                        return
+                for rid, fut in expired:
+                    if _fail_future(fut, FleetTimeoutError(
+                            f"request {rid} got no wire response within "
+                            "its deadline")):
+                        self._count_timeout()
+                time.sleep(0.02)
+
+    def _arm_deadline(self, rid: str, timeout: Optional[float]) -> None:
+        if timeout is None:
+            return
+        with self._lock:
+            self._deadlines[rid] = time.monotonic() + float(timeout)
+            if self._reaper is None or not self._reaper.is_alive():
+                self._reaper = threading.Thread(
+                    target=self._reap_loop, name="fleet-client-reaper",
+                    daemon=True)
+                self._reaper.start()
+
+    def _send_msg(self, control: dict, arrays=()) -> None:
+        payload = framing.encode_payload(control, arrays)
+        with self._wlock:
+            self._ensure_connected()
+            try:
+                framing.write_frame(self._sock, payload,
+                                    magic=framing.WIRE_MAGIC)
+            except OSError:
+                # the close may have raced the write; one clean-EOF
+                # reconnect attempt, then give up loudly
+                self._ensure_connected()
+                framing.write_frame(self._sock, payload,
+                                    magic=framing.WIRE_MAGIC)
+
+    def _new_request(self) -> tuple:
+        with self._lock:
+            if self._closed:
+                raise ServingStopped("client is closed")
             self._seq += 1
             rid = f"{self._rid_prefix}-{self._seq}"
             fut: Future = Future()
             self._pending[rid] = fut
-        msg = {"op": "submit", "id": rid, "model": str(model),
-               "method": str(method), "priority": int(priority),
-               "deadline": deadline, **_encode_array(np.asarray(X))}
-        payload = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
-        with self._wlock:
-            framing.write_frame(self._sock, payload,
-                                magic=framing.WIRE_MAGIC)
+        return rid, fut
+
+    def _send_or_unregister(self, rid: str, fut: Future,
+                            control: dict, arrays=()) -> None:
+        """``_send_msg`` that never leaks: a failed send pops the
+        pending entry and fails the future before re-raising (a polling
+        caller — ping() on a downed server — must not grow
+        ``_pending`` by one dead future per attempt)."""
+        try:
+            self._send_msg(control, arrays)
+        except BaseException as e:
+            with self._lock:
+                self._pending.pop(rid, None)
+                self._deadlines.pop(rid, None)
+            _fail_future(fut, e if isinstance(e, ServingError)
+                         else ServingStopped(f"wire send failed: {e!r}"))
+            raise
+
+    def submit(self, model: str, X, method: str = "predict", *,
+               priority: int = 0,
+               deadline: Optional[float] = None,
+               timeout: Optional[float] = None) -> Future:
+        """Send one request; the Future resolves to the result array,
+        a remote error, or — when ``timeout`` (default: the client's
+        ``request_timeout``) passes with no response —
+        :class:`FleetTimeoutError`."""
+        rid, fut = self._new_request()
+        self._send_or_unregister(
+            rid, fut,
+            {"op": "submit", "id": rid, "model": str(model),
+             "method": str(method), "priority": int(priority),
+             "deadline": deadline}, arrays=(np.asarray(X),))
+        self._arm_deadline(
+            rid, timeout if timeout is not None else self.request_timeout)
         return fut
 
     def call(self, model: str, X, method: str = "predict", *,
              priority: int = 0, deadline: Optional[float] = None,
              timeout: Optional[float] = None) -> np.ndarray:
-        return self.submit(model, X, method=method, priority=priority,
-                           deadline=deadline).result(timeout)
+        fut = self.submit(model, X, method=method, priority=priority,
+                          deadline=deadline, timeout=timeout)
+        try:
+            return fut.result(timeout if timeout is not None
+                              else self.request_timeout)
+        except _FutureTimeout:
+            # the reaper holds the same deadline and is the ONE counting
+            # site (it fails the still-pending future moments after this
+            # raise) — counting here too would double fleet.timeouts
+            raise FleetTimeoutError(
+                f"no wire response for {model!r}.{method} within "
+                f"{timeout if timeout is not None else self.request_timeout}"
+                "s")
+
+    def stats(self, timeout: float = 10.0) -> dict:
+        """The server's ``op="stats"`` snapshot (queue depth, latency
+        EWMA, pid, …) — :class:`FleetTimeoutError` past ``timeout``."""
+        rid, fut = self._new_request()
+        self._send_or_unregister(rid, fut, {"op": "stats", "id": rid})
+        self._arm_deadline(rid, timeout)
+        try:
+            return dict(fut.result(timeout).get("stats") or {})
+        except _FutureTimeout:
+            raise FleetTimeoutError(  # the reaper counts (see call())
+                f"no stats response within {timeout}s")
 
     def ping(self, timeout: float = 10.0) -> bool:
-        with self._lock:
-            self._seq += 1
-            rid = f"{self._rid_prefix}-{self._seq}"
-            fut: Future = Future()
-            self._pending[rid] = fut
-        payload = pickle.dumps({"op": "ping", "id": rid},
-                               protocol=pickle.HIGHEST_PROTOCOL)
-        with self._wlock:
-            framing.write_frame(self._sock, payload,
-                                magic=framing.WIRE_MAGIC)
-        return bool(fut.result(timeout).get("pong"))
+        """Round-trip liveness probe with a hard deadline: True on pong,
+        :class:`FleetTimeoutError` when the server never answers —
+        never an eternal block on a wedged socket."""
+        rid, fut = self._new_request()
+        self._send_or_unregister(rid, fut, {"op": "ping", "id": rid})
+        self._arm_deadline(rid, timeout)
+        try:
+            return bool(fut.result(timeout).get("pong"))
+        except _FutureTimeout:
+            raise FleetTimeoutError(  # the reaper counts (see call())
+                f"no pong within {timeout}s")

@@ -1,4 +1,5 @@
-"""Length-prefixed, checksummed frame codec shared by snapshots and wire.
+"""Length-prefixed, checksummed frame codec shared by snapshots and wire,
+plus the typed (pickle-free) wire payload the serving fleet speaks.
 
 One framing discipline serves two very different transports:
 
@@ -34,29 +35,48 @@ for a failed digest or foreign magic — so callers can map them onto their
 own error surface (``checkpoint.py`` wraps both in
 ``CheckpointCorruptError`` with its original messages, bit-identical
 behavior to the pre-extraction code).
+
+**The typed wire payload.** The serving wire's frame payloads are NOT
+pickle: they are a self-describing, capped layout that deserializes no
+objects anywhere, so ``FleetServer`` can face untrusted clients —
+:func:`encode_payload`/:func:`decode_payload` (layout documented there).
+A payload that fails its caps or structure raises the typed
+:class:`PayloadError`, which the serving layer maps to a per-frame error
+response (the frame boundary is intact, so the connection survives — only
+a torn FRAME ends a stream).
 """
 
 from __future__ import annotations
 
-import hashlib
+import json
 import struct
+import hashlib
 from typing import Optional
+
+import numpy as np
 
 __all__ = [
     "FrameError",
     "FrameTruncatedError",
     "FrameCorruptError",
+    "PayloadError",
     "encode_frame",
     "decode_frame",
     "read_frame",
     "write_frame",
+    "encode_payload",
+    "decode_payload",
     "header_length",
     "WIRE_MAGIC",
+    "PAYLOAD_DTYPES",
 ]
 
 #: serving wire-protocol magic (docs/serving.md, "The wire protocol");
-#: the checkpoint magic lives with its owner in ``dask_ml_tpu.checkpoint``
-WIRE_MAGIC = b"DMLTWIRE1\n"
+#: the checkpoint magic lives with its owner in ``dask_ml_tpu.checkpoint``.
+#: The version byte is 2: version 1 framed pickle payloads, version 2
+#: frames the typed payload below — a v1 peer fails the magic check loudly
+#: instead of misparsing bytes.
+WIRE_MAGIC = b"DMLTWIRE2\n"
 
 _LEN_BYTES = 8
 _DIGEST_BYTES = 32
@@ -74,6 +94,14 @@ class FrameTruncatedError(FrameError):
 class FrameCorruptError(FrameError):
     """The frame is structurally complete but wrong: foreign magic, or a
     payload whose sha256 does not match the header's digest."""
+
+
+class PayloadError(FrameError):
+    """A typed wire payload failed decoding: malformed control envelope,
+    a dtype outside the allowlist, a shape that disagrees with the buffer
+    bytes, or a cap violation. The FRAME was intact (length + digest
+    passed), so the error is attributable to one request and the
+    connection keeps serving."""
 
 
 def header_length(magic: bytes) -> int:
@@ -180,3 +208,147 @@ def write_frame(stream, payload: bytes, *, magic: bytes) -> None:
     flush = getattr(stream, "flush", None)
     if flush is not None:
         flush()
+
+
+# ---------------------------------------------------------------------------
+# the typed wire payload: JSON control envelope + dtype/shape-tagged buffers
+# ---------------------------------------------------------------------------
+
+#: numpy dtypes allowed on the wire — fixed-width numerics only. No
+#: object/void/str dtypes: nothing on this list can smuggle code or force
+#: deserialization, which is the whole point of the typed payload.
+PAYLOAD_DTYPES = frozenset({
+    "bool",
+    "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64",
+    "float16", "float32", "float64",
+})
+
+#: decode caps (hostile-input bounds; the frame-level ``max_payload`` cap
+#: already bounds the total allocation — these bound the SHAPE of it)
+MAX_CONTROL_BYTES = 1 << 20   # control envelope: 1 MiB of JSON, far above
+#                               any real request header
+MAX_ARRAYS = 64               # buffers per payload
+MAX_NDIM = 8                  # dims per buffer
+
+_CTRL_LEN_BYTES = 4
+
+
+def encode_payload(control: dict, arrays=()) -> bytes:
+    """Encode one wire message: a JSON control envelope plus zero or more
+    numpy buffers, self-describing and pickle-free.
+
+    Layout (inside one :data:`WIRE_MAGIC` frame)::
+
+        4-byte unsigned BE control length
+        control JSON (utf-8) — ``control`` plus an ``"arrays"`` list of
+            ``{"dtype", "shape"}`` descriptors, one per buffer
+        the raw array buffers, C-contiguous, concatenated in order
+
+    ``control`` must be JSON-serializable (strings/numbers/bools/lists/
+    dicts — enforced by ``json.dumps``); arrays must have an allowlisted
+    dtype (:data:`PAYLOAD_DTYPES`). Everything a peer decodes is
+    reconstructed from (dtype, shape, bytes) — no object deserialization
+    exists on this path.
+    """
+    metas = []
+    bufs = []
+    for a in arrays:
+        a = np.asarray(a)
+        shape = list(a.shape)  # before ascontiguousarray 0-d→1-d quirk
+        a = np.ascontiguousarray(a)
+        name = a.dtype.name
+        if name not in PAYLOAD_DTYPES:
+            raise PayloadError(
+                f"dtype {name!r} is not wire-encodable "
+                f"(allowed: {sorted(PAYLOAD_DTYPES)})")
+        metas.append({"dtype": name, "shape": shape})
+        bufs.append(a.tobytes())
+    ctrl = dict(control)
+    if "arrays" in ctrl:
+        raise PayloadError(
+            "'arrays' is the codec's buffer-descriptor key — a control "
+            "envelope cannot carry its own (it would be silently "
+            "replaced on encode and stripped on decode)")
+    ctrl["arrays"] = metas
+    head = json.dumps(ctrl, separators=(",", ":")).encode("utf-8")
+    if len(head) > MAX_CONTROL_BYTES:
+        raise PayloadError(
+            f"control envelope is {len(head)} bytes "
+            f"(cap {MAX_CONTROL_BYTES})")
+    return (struct.pack(">I", len(head)) + head + b"".join(bufs))
+
+
+def decode_payload(payload: bytes, *,
+                   max_control_bytes: int = MAX_CONTROL_BYTES):
+    """Decode one typed wire message → ``(control, arrays)``.
+
+    Strict by construction: the control length is capped, the envelope
+    must be a JSON object, every buffer descriptor must carry an
+    allowlisted dtype and a sane shape (``<= MAX_NDIM`` non-negative
+    dims), the described bytes must tile the remaining payload EXACTLY
+    (no trailing garbage, no short buffers), and at most
+    :data:`MAX_ARRAYS` buffers are accepted. Any violation raises
+    :class:`PayloadError`; nothing here ever deserializes an object.
+    """
+    if len(payload) < _CTRL_LEN_BYTES:
+        raise PayloadError(
+            f"payload is {len(payload)} bytes — too short for the "
+            "control-length prefix")
+    (hlen,) = struct.unpack(">I", payload[:_CTRL_LEN_BYTES])
+    if hlen > max_control_bytes:
+        raise PayloadError(
+            f"control envelope length {hlen} exceeds the "
+            f"{max_control_bytes}-byte cap")
+    if _CTRL_LEN_BYTES + hlen > len(payload):
+        raise PayloadError(
+            f"control envelope length {hlen} overruns the "
+            f"{len(payload)}-byte payload")
+    try:
+        control = json.loads(
+            payload[_CTRL_LEN_BYTES:_CTRL_LEN_BYTES + hlen].decode("utf-8"))
+    except (UnicodeDecodeError, ValueError) as e:
+        raise PayloadError(f"control envelope is not valid JSON: {e}")
+    if not isinstance(control, dict):
+        raise PayloadError(
+            f"control envelope must be a JSON object, got "
+            f"{type(control).__name__}")
+    metas = control.pop("arrays", [])
+    if not isinstance(metas, list) or len(metas) > MAX_ARRAYS:
+        raise PayloadError(
+            "control 'arrays' must be a list of at most "
+            f"{MAX_ARRAYS} descriptors")
+    arrays = []
+    off = _CTRL_LEN_BYTES + hlen
+    for i, m in enumerate(metas):
+        if not isinstance(m, dict):
+            raise PayloadError(f"array descriptor {i} is not an object")
+        name = m.get("dtype")
+        if name not in PAYLOAD_DTYPES:
+            raise PayloadError(
+                f"array {i} dtype {name!r} is not wire-decodable "
+                f"(allowed: {sorted(PAYLOAD_DTYPES)})")
+        shape = m.get("shape")
+        if (not isinstance(shape, list) or len(shape) > MAX_NDIM
+                or not all(isinstance(s, int) and not isinstance(s, bool)
+                           and 0 <= s for s in shape)):
+            raise PayloadError(
+                f"array {i} shape {shape!r} is not a list of <= "
+                f"{MAX_NDIM} non-negative integers")
+        dt = np.dtype(name)
+        n = 1
+        for s in shape:
+            n *= s
+        nbytes = n * dt.itemsize
+        if off + nbytes > len(payload):
+            raise PayloadError(
+                f"array {i} ({name}, shape {tuple(shape)}) needs "
+                f"{nbytes} bytes but only {len(payload) - off} remain")
+        arrays.append(np.frombuffer(
+            payload, dtype=dt, count=n, offset=off).reshape(shape))
+        off += nbytes
+    if off != len(payload):
+        raise PayloadError(
+            f"payload carries {len(payload) - off} trailing bytes past "
+            "the described buffers")
+    return control, arrays

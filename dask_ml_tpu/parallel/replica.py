@@ -1,0 +1,302 @@
+"""ReplicaHost: one out-of-process serving replica — the worker half of
+the process-isolated fleet.
+
+PR 12's :class:`~dask_ml_tpu.parallel.fleet.ServingFleet` replicated the
+reference's fault-tolerance POLICY (heartbeats, breaker, replay) without
+its fault DOMAIN: every replica was a thread in one interpreter sharing
+one XLA runtime, so a segfault, an OOM, or a wedged runtime took the
+whole tier down at once. dask-ml never had that problem — its workers
+are ``dask.distributed`` OS processes (PAPER.md, delegated
+distribution). This module is that missing half: a worker ENTRYPOINT the
+router (``parallel/procfleet.py``) spawns as its own OS process, so a
+replica's crash is contained by the kernel, not by Python's unwinding.
+
+One ``ReplicaHost`` process:
+
+- owns its device subset — the parent pins ``JAX_PLATFORMS`` /
+  ``XLA_FLAGS`` (CPU: ``--xla_force_host_platform_device_count``) /
+  visible-devices env BEFORE spawn, so the child's jax runtime never
+  even sees a sibling's chips;
+- loads its models from a REGISTRY SNAPSHOT the router wrote
+  (:func:`save_registry_snapshot` — the shared frame codec under its own
+  magic, atomic rename + sha256, same durability discipline as
+  checkpoints; trusted local disk, never the socket);
+- warms every (model, method, bucket) program through the EXACT serving
+  staging path before announcing itself, so a respawned replica rejoins
+  rotation with ZERO steady-state compiles (the count is reported live
+  via the wire ``stats`` op);
+- serves a :class:`~dask_ml_tpu.parallel.serving.ServingLoop` behind a
+  :class:`~dask_ml_tpu.parallel.fleet.FleetServer` speaking the typed
+  pickle-free wire, announcing its address atomically in
+  ``workdir/<name>.addr.json``;
+- heartbeats through the PR-8
+  :class:`~dask_ml_tpu.parallel.elastic.FileHeartbeat` mtime/tombstone
+  liveness layer: SIGTERM drains gracefully and leaves a tombstone;
+  SIGKILL leaves NOTHING — the beats just stop, which is exactly the
+  signal the router's monitor fuses with the socket going dark;
+- optionally carries deterministic chaos plans
+  (:meth:`~dask_ml_tpu.parallel.faults.FaultInjector.kill_process` —
+  real ``SIGKILL`` to itself after N served requests — and
+  :meth:`~dask_ml_tpu.parallel.faults.FaultInjector.straggle_replica` —
+  a real wall-clock straggler for the hedging drill).
+
+Run as ``python -m dask_ml_tpu.parallel.replica --name r0 --snapshot
+/path/snap.reg --workdir /path/fleet`` (the router does this; see
+``bench.py --fleet-proc`` and docs/serving.md, "The process-isolated
+fleet").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pickle
+import signal
+import tempfile
+import threading
+from typing import Optional
+
+__all__ = [
+    "ReplicaHost",
+    "save_registry_snapshot",
+    "load_registry_snapshot",
+    "main",
+]
+
+#: registry-snapshot magic (the shared frame codec of
+#: ``parallel/framing.py`` under its own version byte). Snapshots are a
+#: TRUSTED-DISK artifact written by the router and read by its own child
+#: processes — they carry pickled fitted estimators, like checkpoints,
+#: and never travel the socket (the wire is the typed codec).
+REGISTRY_MAGIC = b"DMLTFREG1\n"
+
+
+def save_registry_snapshot(path: str, models) -> None:
+    """Atomically write the fleet's model registry snapshot: ``models``
+    is a list of ``(name, fitted_estimator, methods_or_None)``. Framed
+    (length + sha256) and renamed into place, so a child can never load
+    a torn snapshot — it either sees the previous complete one or this
+    one."""
+    from dask_ml_tpu.parallel import framing
+
+    body = pickle.dumps({"models": list(models)},
+                        protocol=pickle.HIGHEST_PROTOCOL)
+    frame = framing.encode_frame(body, magic=REGISTRY_MAGIC)
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".reg.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(frame)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def load_registry_snapshot(path: str):
+    """→ the ``(name, estimator, methods)`` list of
+    :func:`save_registry_snapshot` (frame-verified first: corruption
+    raises a FrameError, never unpickles noise)."""
+    from dask_ml_tpu.parallel import framing
+
+    with open(path, "rb") as f:
+        data = f.read()
+    body = framing.decode_frame(data, magic=REGISTRY_MAGIC)
+    return pickle.loads(body)["models"]
+
+
+class ReplicaHost:
+    """One serving-replica process (module docstring has the role).
+
+    Parameters
+    ----------
+    name : str
+        This replica's fleet-wide name — the heartbeat member name, the
+        address-file stem, and the loop/telemetry label.
+    snapshot_path : str
+        The registry snapshot to serve (:func:`save_registry_snapshot`).
+    workdir : str
+        Shared coordination directory (heartbeats, tombstones, address
+        files) — the router passes the same path to every replica.
+    max_batch_rows, max_queue, policy
+        Forwarded to the :class:`~dask_ml_tpu.parallel.serving.
+        ServingLoop`.
+    heartbeat_interval_s : float
+        Beat cadence (the router declares death past ITS timeout).
+    kill_after_requests : int, optional
+        Deterministic chaos: arm a
+        :meth:`~dask_ml_tpu.parallel.faults.FaultInjector.kill_process`
+        plan — real ``SIGKILL`` to this process once that many wire
+        requests were served.
+    straggle_s, straggle_every : float, int
+        Deterministic chaos: every ``straggle_every``-th batch sleeps
+        ``straggle_s`` wall-clock seconds
+        (:meth:`~dask_ml_tpu.parallel.faults.FaultInjector.
+        straggle_replica`) — the hedging drill's tail-latency source.
+    """
+
+    def __init__(self, name: str, snapshot_path: str, workdir: str, *,
+                 max_batch_rows: int = 1024,
+                 max_queue: int = 4096,
+                 heartbeat_interval_s: float = 0.05,
+                 wedge_timeout_s: float = 10.0,
+                 kill_after_requests: Optional[int] = None,
+                 straggle_s: float = 0.0,
+                 straggle_every: int = 1):
+        self.name = str(name)
+        self.snapshot_path = str(snapshot_path)
+        self.workdir = str(workdir)
+        self.max_batch_rows = int(max_batch_rows)
+        self.max_queue = int(max_queue)
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        self.wedge_timeout_s = float(wedge_timeout_s)
+        self.kill_after_requests = kill_after_requests
+        self.straggle_s = float(straggle_s)
+        self.straggle_every = int(straggle_every)
+        self._warm_compiles = 0
+        self._loop = None
+        self._server = None
+        self._stop = threading.Event()
+
+    # -- the wire `stats` op payload --------------------------------------
+
+    def _extra_stats(self) -> dict:
+        from dask_ml_tpu.parallel.shapes import compile_stats
+
+        return {
+            "replica": self.name,
+            # the respawn gate: compiles since warmup finished must stay
+            # 0 under steady-state traffic (docs/serving.md)
+            "steady_compiles": int(
+                compile_stats()["n_compiles"] - self._warm_compiles),
+            "warm_compiles": int(self._warm_compiles),
+        }
+
+    def _addr_path(self) -> str:
+        return os.path.join(self.workdir, f"{self.name}.addr.json")
+
+    def _announce(self, warm: dict) -> None:
+        """Atomically publish this replica's address + pid + warmup cost
+        — the router's readiness signal (written only AFTER warmup, so a
+        replica in rotation never compiles on the request path)."""
+        info = {"name": self.name, "host": self._server.address[0],
+                "port": int(self._server.address[1]),
+                "pid": os.getpid(), "warmup": warm}
+        path = self._addr_path()
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(info, f)
+        os.replace(tmp, path)
+
+    def run(self) -> int:
+        """Serve until SIGTERM (graceful drain: flush, tombstone, exit 0)
+        or SIGKILL (nothing at all — the liveness layer's silence IS the
+        signal). Returns the exit code."""
+        from dask_ml_tpu.parallel.elastic import FileHeartbeat
+        from dask_ml_tpu.parallel.faults import FaultInjector, GracefulDrain
+        from dask_ml_tpu.parallel.fleet import FleetServer
+        from dask_ml_tpu.parallel.serving import ModelRegistry, ServingLoop
+        from dask_ml_tpu.parallel.shapes import (
+            reset_compile_stats,
+            track_compiles,
+        )
+
+        os.makedirs(self.workdir, exist_ok=True)
+        live = FileHeartbeat(self.workdir)
+        live.beat(self.name)
+
+        injector = FaultInjector()
+        if self.straggle_s > 0.0:
+            injector.straggle_replica(self.name, self.straggle_s,
+                                      every=self.straggle_every)
+        if self.kill_after_requests is not None:
+            injector.kill_process(self.name,
+                                  after_requests=int(
+                                      self.kill_after_requests))
+
+        registry = ModelRegistry()
+        for mname, est, methods in load_registry_snapshot(
+                self.snapshot_path):
+            registry.register(mname, est, methods=methods)
+
+        drain = GracefulDrain(signals=(signal.SIGTERM,))
+        self._loop = ServingLoop(
+            registry, max_batch_rows=self.max_batch_rows,
+            max_queue=self.max_queue, drain=drain,
+            fault_injector=injector, name=self.name)
+        reset_compile_stats()
+        with drain:
+            self._loop.start()
+            with track_compiles() as warm_t:
+                warm = self._loop.warmup()
+            self._warm_compiles = warm_t["n_compiles"]
+            self._server = FleetServer(
+                self._loop, extra_stats=self._extra_stats).start()
+            self._announce(warm)
+            live.beat(self.name)
+            # the beat loop IS the main thread's job: liveness + chaos
+            # polling until the drain (SIGTERM) or a stop lands
+            while not self._stop.is_set() and not drain.requested:
+                # gate the FILE beat on the dispatch thread's own beat:
+                # a wedged (not crashed) batch stalls the loop heartbeat,
+                # and past wedge_timeout_s this process goes silent too —
+                # the process-level analogue of the in-process fleet's
+                # heartbeat_age() death signal, so the router respawns a
+                # wedged replica instead of routing to it forever. The
+                # generous default (10 s) keeps a merely-slow batch from
+                # reading as a wedge.
+                if self._loop.heartbeat_age() <= self.wedge_timeout_s:
+                    live.beat(self.name)
+                injector.maybe_kill_process(self.name,
+                                            self._server.n_requests)
+                if not self._loop.alive() and self._loop.fatal is not None:
+                    break  # dispatch crashed: die visibly, not silently
+                self._stop.wait(self.heartbeat_interval_s)
+            # graceful exit: flush the queue, resolve every future, leave
+            # the tombstone so the router skips its timeout
+            self._loop.stop(drain=True)
+            self._server.stop()
+            live.tombstone(self.name)
+        return 0 if self._loop.fatal is None else 1
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m dask_ml_tpu.parallel.replica",
+        description="one out-of-process serving replica (spawned by the "
+                    "process fleet router)")
+    parser.add_argument("--name", required=True)
+    parser.add_argument("--snapshot", required=True)
+    parser.add_argument("--workdir", required=True)
+    parser.add_argument("--max-batch-rows", type=int, default=1024)
+    parser.add_argument("--max-queue", type=int, default=4096)
+    parser.add_argument("--heartbeat-interval-s", type=float, default=0.05)
+    parser.add_argument("--wedge-timeout-s", type=float, default=10.0)
+    parser.add_argument("--kill-after-requests", type=int, default=None)
+    parser.add_argument("--straggle-s", type=float, default=0.0)
+    parser.add_argument("--straggle-every", type=int, default=1)
+    args = parser.parse_args(argv)
+    host = ReplicaHost(
+        args.name, args.snapshot, args.workdir,
+        max_batch_rows=args.max_batch_rows,
+        max_queue=args.max_queue,
+        heartbeat_interval_s=args.heartbeat_interval_s,
+        wedge_timeout_s=args.wedge_timeout_s,
+        kill_after_requests=args.kill_after_requests,
+        straggle_s=args.straggle_s,
+        straggle_every=args.straggle_every)
+    return host.run()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1120,6 +1120,13 @@ class ServingLoop:
                                 f"replica {self.name!r} killed by fault "
                                 f"plan after {self.n_batches} batches")
                         fi.on_dispatch(self._batch_seq)
+                        # real-straggler plan (straggle_replica): stalls
+                        # THIS dispatch's wall clock — the hedging drill's
+                        # tail-latency source (getattr: foreign injectors
+                        # predate the hook)
+                        straggle = getattr(fi, "dispatch_sleep", None)
+                        if straggle is not None:
+                            straggle(self.name)
                     if telemetry.enabled():
                         with self._cond:
                             depth = len(self._queue)

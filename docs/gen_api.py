@@ -102,14 +102,36 @@ SECTIONS = [
      "requests on replica death (idempotent by request id), spills "
      "ServingQueueFull over to siblings, sheds past-deadline requests, "
      "and hot-swaps model versions with pre-warmed programs and zero "
-     "downtime; FleetServer/FleetClient speak the framed wire protocol "
-     "for out-of-process clients — see docs/serving.md, \"The serving "
+     "downtime; FleetServer/FleetClient speak the typed, pickle-free "
+     "wire protocol for out-of-process (and untrusted) clients, with "
+     "per-request FleetTimeoutError deadlines and one-shot reconnect "
+     "after a clean server close — see docs/serving.md, \"The serving "
      "fleet\", and the committed FLEET_r01.json kill drill."),
+    ("dask_ml_tpu.parallel.procfleet", "Process-isolated fleet",
+     "The process-isolation tier: ProcessFleet spawns each replica as "
+     "its own OS process (ReplicaHost) with a pinned device subset, "
+     "fuses FileHeartbeat mtime/tombstone liveness with socket-level "
+     "signals, replays in-flight requests on survivors and respawns "
+     "dead slots (warm through the exact serving staging path before "
+     "rotation re-entry), and hedges tail-latency requests onto the "
+     "next-best replica past an adaptive quantile threshold — see "
+     "docs/serving.md, \"The process-isolated fleet\", and the "
+     "committed FLEET_r02.json kill -9 drill."),
+    ("dask_ml_tpu.parallel.replica", "Replica worker process",
+     "The worker half of the process-isolated fleet: the ReplicaHost "
+     "entrypoint (python -m dask_ml_tpu.parallel.replica) loads a "
+     "frame-verified registry snapshot, warms every program, serves a "
+     "ServingLoop behind FleetServer on the typed wire, heartbeats "
+     "through FileHeartbeat, and carries deterministic chaos plans "
+     "(kill_process SIGKILL, straggle_replica)."),
     ("dask_ml_tpu.parallel.framing", "Frame codec",
      "The shared length-prefixed magic+length+sha256 frame codec behind "
      "both checkpoint snapshots and the serving wire protocol: "
      "whole-buffer encode/decode plus stream read/write with typed "
-     "truncation/corruption errors."),
+     "truncation/corruption errors — plus the typed wire payload "
+     "(encode_payload/decode_payload): a capped JSON control envelope "
+     "with dtype/shape-tagged numpy buffers, no object deserialization "
+     "anywhere."),
     ("dask_ml_tpu.parallel.hierarchy", "Two-level mesh scale-out",
      "The (pod, chip) hierarchical mesh and its communication-avoiding "
      "collective family: hpsum/hpmean/hpsum_scatter lower every hot "

@@ -900,3 +900,59 @@ class TestServingFaultPlans:
         assert counters["faults.injected{kind=slow_replica}"] == 1
         assert counters["faults.injected{kind=dispatch_delay}"] == 1
         assert counters["faults.injected{kind=replica_kill}"] == 1
+
+    def test_kill_process_one_shot_after_requests(self):
+        """The SIGKILL-semantics plan (ISSUE 15): fires exactly once at
+        the request threshold, for the named process only. The actual
+        os.kill lives in maybe_kill_process — unit-testable only via the
+        predicate, drilled for real by the process-fleet suite."""
+        fi = FaultInjector().kill_process("p0", after_requests=3)
+        assert not fi.should_kill_process("p0", 0)
+        assert not fi.should_kill_process("p0", 2)
+        assert not fi.should_kill_process("p1", 10)  # wrong process
+        assert fi.should_kill_process("p0", 3)
+        assert not fi.should_kill_process("p0", 4)  # one-shot
+        assert fi.injected["process_kill"] == 1
+
+    def test_straggle_replica_real_sleep_every_nth(self):
+        """Unlike slow_replica's synthetic penalty, straggle_replica
+        actually stalls the dispatch wall clock — every Nth batch, for
+        the named replica only, within the batch budget."""
+        import time as _time
+
+        fi = FaultInjector().straggle_replica("r0", 0.1, every=2,
+                                              batches=2)
+        t0 = _time.perf_counter()
+        assert fi.dispatch_sleep("r0") == 0.0   # batch 1 of every=2
+        assert fi.dispatch_sleep("r1") == 0.0   # wrong replica
+        assert fi.dispatch_sleep("r0") == 0.1   # batch 2: sleeps
+        assert _time.perf_counter() - t0 >= 0.1
+        assert fi.dispatch_sleep("r0") == 0.0
+        assert fi.dispatch_sleep("r0") == 0.1   # second budgeted sleep
+        assert fi.dispatch_sleep("r0") == 0.0
+        assert fi.dispatch_sleep("r0") == 0.0   # budget of 2 exhausted
+        assert fi.injected["straggle"] == 2
+
+    def test_straggle_plan_reaches_the_serving_loop(self):
+        """The serving loop calls the dispatch_sleep hook: a straggle
+        plan raises the replica's OBSERVED batch latency (wall clock),
+        which is what the hedging drill keys on."""
+        import time as _time
+
+        import numpy as np
+
+        from dask_ml_tpu.parallel.serving import ModelRegistry, ServingLoop
+
+        class _Echo:
+            def predict(self, X):
+                return np.zeros(len(X), np.float32)
+
+        fi = FaultInjector().straggle_replica("st", 0.15, batches=1)
+        reg = ModelRegistry()
+        reg.register("echo", _Echo())
+        with ServingLoop(reg, max_batch_rows=64, fault_injector=fi,
+                         name="st") as lp:
+            t0 = _time.perf_counter()
+            lp.submit("echo", np.zeros((2, 3), np.float32)).result(30)
+            assert _time.perf_counter() - t0 >= 0.15
+            assert fi.injected["straggle"] == 1

@@ -10,7 +10,6 @@ duplicate compute only), served results stay bit-identical to the direct
 predict paths whichever replica answered, and a swap loses nothing.
 """
 
-import pickle
 import socket
 import threading
 import time
@@ -24,6 +23,7 @@ from dask_ml_tpu.parallel.faults import FaultInjector, GracefulDrain
 from dask_ml_tpu.parallel.fleet import (
     FleetClient,
     FleetServer,
+    FleetTimeoutError,
     ServingFleet,
 )
 from dask_ml_tpu.parallel.serving import (
@@ -588,6 +588,13 @@ def test_wire_validation_fails_caller_not_connection(wired, fitted):
             out, fitted["kmeans"].predict(fitted["X"][:8]))
 
 
+def _wire_response(sock):
+    """Read one typed response frame → (control, arrays)."""
+    payload = framing.read_frame(sock, magic=framing.WIRE_MAGIC)
+    assert payload is not None
+    return framing.decode_payload(payload)
+
+
 def test_wire_corrupt_frame_fails_caller_and_closes(wired):
     """A frame that fails its checksum gets an error response and the
     connection closes — the stream's byte alignment can no longer be
@@ -596,13 +603,12 @@ def test_wire_corrupt_frame_fails_caller_and_closes(wired):
     sock = socket.create_connection(server.address, timeout=10)
     try:
         good = framing.encode_frame(
-            pickle.dumps({"op": "ping", "id": "x"}),
+            framing.encode_payload({"op": "ping", "id": "x"}),
             magic=framing.WIRE_MAGIC)
         bad = bytearray(good)
         bad[-1] ^= 0xFF  # flip a payload byte: checksum fails
         sock.sendall(bytes(bad))
-        msg = pickle.loads(framing.read_frame(sock,
-                                              magic=framing.WIRE_MAGIC))
+        msg, _ = _wire_response(sock)
         assert msg["ok"] is False
         assert "Corrupt" in msg["error"]
         assert framing.read_frame(sock, magic=framing.WIRE_MAGIC) is None
@@ -702,6 +708,259 @@ def test_false_positive_death_heals_when_heartbeat_returns(fitted):
         assert all(not r.dead for r in fleet._replicas)
     finally:
         gate.release.set()
+        fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# wire fuzz: hostile bytes against a live server (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+def _server_still_serves(server, fitted):
+    """The load-bearing fuzz invariant: whatever one connection was fed,
+    a FRESH client still gets bit-identical service."""
+    with FleetClient(server.address) as cli:
+        out = cli.call("kmeans", fitted["X"][:8], timeout=60)
+    assert np.array_equal(out, fitted["kmeans"].predict(fitted["X"][:8]))
+
+
+def test_wire_fuzz_garbage_bytes(wired, fitted):
+    """Raw garbage (wrong magic) kills that connection only — the error
+    response is best-effort (unread garbage makes the close an RST), the
+    invariant is that the accept loop never stops serving."""
+    fleet, server = wired
+    for blob in (b"\x00" * 64, b"GET / HTTP/1.1\r\n\r\n",
+                 b"DMLTWIRE1\n" + b"\x00" * 48):  # the OLD pickle magic
+        sock = socket.create_connection(server.address, timeout=10)
+        try:
+            sock.sendall(blob)
+            sock.settimeout(10)
+            try:
+                msg, _ = _wire_response(sock)
+                assert msg["ok"] is False
+                assert framing.read_frame(
+                    sock, magic=framing.WIRE_MAGIC) is None  # closed
+            except (ConnectionError, framing.FrameError):
+                pass  # reset mid-response: the connection died, as planned
+        finally:
+            sock.close()
+    _server_still_serves(server, fitted)
+
+
+def test_wire_fuzz_truncated_frames_every_header_offset(wired, fitted):
+    """A frame cut at EVERY header offset (and a few payload offsets)
+    tears that connection only — the accept loop keeps serving."""
+    fleet, server = wired
+    frame = framing.encode_frame(
+        framing.encode_payload({"op": "ping", "id": "t"}),
+        magic=framing.WIRE_MAGIC)
+    head = framing.header_length(framing.WIRE_MAGIC)
+    cuts = list(range(1, head + 1)) + [head + 3, len(frame) - 1]
+    for cut in cuts:
+        sock = socket.create_connection(server.address, timeout=10)
+        try:
+            sock.sendall(frame[:cut])
+            sock.shutdown(socket.SHUT_WR)  # EOF mid-frame
+            # truncation surfaces as an error response (when enough
+            # arrived to attribute) and/or a close — never a hang
+            sock.settimeout(10)
+            try:
+                framing.read_frame(sock, magic=framing.WIRE_MAGIC)
+            except framing.FrameError:
+                pass
+        finally:
+            sock.close()
+    _server_still_serves(server, fitted)
+
+
+def test_wire_fuzz_oversized_payload_rejected(fitted):
+    """A length prefix past max_payload is refused before any allocation
+    — the connection errors, the server survives."""
+    fleet = _make_fleet(fitted, n_replicas=2)
+    server = FleetServer(fleet, max_payload=1024).start()
+    try:
+        sock = socket.create_connection(server.address, timeout=10)
+        try:
+            big = framing.encode_frame(b"x" * 4096,
+                                       magic=framing.WIRE_MAGIC)
+            sock.sendall(big)
+            msg, _ = _wire_response(sock)
+            assert msg["ok"] is False
+            assert "Corrupt" in msg["error"]
+        finally:
+            sock.close()
+        _server_still_serves(server, fitted)
+    finally:
+        server.stop()
+        fleet.stop()
+
+
+def test_wire_fuzz_malformed_control_envelopes(wired, fitted):
+    """Structurally-valid frames with hostile payloads: each fails ITS
+    frame only — the same connection keeps serving afterwards."""
+    fleet, server = wired
+    hostile = [
+        b"",                                    # no control-length prefix
+        b"\x00\x00\x00\x05" + b"{}",            # control overruns payload
+        framing.encode_payload({"op": "submit", "id": "a"}),  # no array
+        b"\x00\x00\x00\x02" + b"[]",            # JSON but not an object
+        b"\x00\x00\x00\x04" + b"nope",          # not JSON at all
+    ]
+    # dtype outside the allowlist, hand-built (encode_payload refuses)
+    import json as json_lib
+
+    ctrl = json_lib.dumps({"op": "submit", "id": "z", "model": "kmeans",
+                           "arrays": [{"dtype": "object", "shape": [1]}]},
+                          separators=(",", ":")).encode()
+    hostile.append(len(ctrl).to_bytes(4, "big") + ctrl + b"\x00" * 8)
+    # shape that disagrees with the buffer bytes
+    ctrl = json_lib.dumps({"op": "submit", "id": "y", "model": "kmeans",
+                           "arrays": [{"dtype": "float32",
+                                       "shape": [1024, 1024]}]},
+                          separators=(",", ":")).encode()
+    hostile.append(len(ctrl).to_bytes(4, "big") + ctrl + b"\x00" * 16)
+    sock = socket.create_connection(server.address, timeout=10)
+    try:
+        for payload in hostile:
+            framing.write_frame(sock, payload, magic=framing.WIRE_MAGIC)
+            msg, _ = _wire_response(sock)
+            assert msg["ok"] is False, payload[:40]
+        # the SAME connection still serves a well-formed request
+        framing.write_frame(
+            sock,
+            framing.encode_payload(
+                {"op": "submit", "id": "ok", "model": "kmeans",
+                 "method": "predict"}, arrays=(fitted["X"][:4],)),
+            magic=framing.WIRE_MAGIC)
+        msg, arrays = _wire_response(sock)
+        assert msg["ok"] is True and msg["id"] == "ok"
+        assert np.array_equal(arrays[0],
+                              fitted["kmeans"].predict(fitted["X"][:4]))
+    finally:
+        sock.close()
+    _server_still_serves(server, fitted)
+
+
+class _StringModel:
+    """Host-fallback model whose predictions are string labels — a
+    dtype the typed wire refuses to encode."""
+
+    def predict(self, X):
+        return np.array(["yes"] * len(X))
+
+
+def test_wire_unencodable_response_fails_caller_not_writer(wired, fitted):
+    """A response the typed codec cannot encode (string labels) errors
+    ITS caller as a remote PayloadError — the writer thread survives and
+    the same connection keeps serving numeric models."""
+    fleet, server = wired
+    fleet.registry.register("strings", _StringModel())
+    with FleetClient(server.address) as cli:
+        with pytest.raises(framing.PayloadError):
+            cli.call("strings", fitted["X"][:4], timeout=60)
+        out = cli.call("kmeans", fitted["X"][:8], timeout=60)
+        assert np.array_equal(
+            out, fitted["kmeans"].predict(fitted["X"][:8]))
+
+
+def test_fleet_wire_is_pickle_free():
+    """The acceptance grep, as a pin: no pickle anywhere in the fleet
+    module — the wire is the typed codec."""
+    import dask_ml_tpu.parallel.fleet as fleet_mod
+
+    src = open(fleet_mod.__file__).read()
+    assert "pickle" not in src
+
+
+# ---------------------------------------------------------------------------
+# client deadlines + reconnect (ISSUE 15 satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_client_ping_and_call_timeout_typed(fitted):
+    """A wedged server (its one gate batch never finishes) surfaces as
+    FleetTimeoutError on ping-with-deadline and call-with-deadline —
+    never an eternal block."""
+    from dask_ml_tpu.parallel.serving import ServingLoop
+
+    gate = _GateModel()
+    reg = ModelRegistry()
+    reg.register("gate", gate)
+    reg.register("kmeans", fitted["kmeans"])
+    with ServingLoop(reg, max_batch_rows=64) as lp:
+        server = FleetServer(lp).start()
+        try:
+            with FleetClient(server.address) as cli:
+                assert cli.ping(timeout=10.0)  # healthy first
+                # wedge the loop's single dispatch thread
+                slow = cli.submit("gate", np.zeros((2, 3), np.float32))
+                deadline = time.monotonic() + 10.0
+                while not lp.busy and time.monotonic() < deadline:
+                    time.sleep(0.01)
+                with pytest.raises(FleetTimeoutError):
+                    cli.call("kmeans", fitted["X"][:4], timeout=0.3)
+                # the reaper is the single counting site (no double
+                # count with call's own raise); give its tick a moment
+                deadline = time.monotonic() + 5.0
+                while cli.n_timeouts < 1 \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.01)
+                assert cli.n_timeouts == 1
+                gate.release.set()
+                slow.result(60)
+        finally:
+            gate.release.set()
+            server.stop()
+
+
+def test_client_request_future_deadline(fitted):
+    """submit(timeout=) arms a reaper that fails the FUTURE with the
+    typed error — the caller never needs its own watchdog."""
+    from dask_ml_tpu.parallel.serving import ServingLoop
+
+    gate = _GateModel()
+    reg = ModelRegistry()
+    reg.register("gate", gate)
+    with ServingLoop(reg, max_batch_rows=64) as lp:
+        server = FleetServer(lp).start()
+        try:
+            with FleetClient(server.address) as cli:
+                fut = cli.submit("gate", np.zeros((2, 3), np.float32),
+                                 timeout=0.3)
+                with pytest.raises(FleetTimeoutError):
+                    fut.result(30)
+        finally:
+            gate.release.set()
+            server.stop()
+
+
+def test_client_reconnects_once_after_clean_close(fitted):
+    """A server that closed the connection cleanly between frames is
+    transparently reconnected to on the next request — once."""
+    fleet = _make_fleet(fitted, n_replicas=2)
+    server = FleetServer(fleet).start()
+    try:
+        cli = FleetClient(server.address)
+        try:
+            assert cli.ping()
+            # close every server-side conn cleanly (no request in flight)
+            for conn in list(server._conns):
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                conn.close()
+            deadline = time.monotonic() + 10.0
+            while not cli._clean_eof and time.monotonic() < deadline:
+                time.sleep(0.01)
+            out = cli.call("kmeans", fitted["X"][:8], timeout=60)
+            assert np.array_equal(
+                out, fitted["kmeans"].predict(fitted["X"][:8]))
+            assert cli.n_reconnects == 1
+        finally:
+            cli.close()
+    finally:
+        server.stop()
         fleet.stop()
 
 

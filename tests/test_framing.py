@@ -115,6 +115,106 @@ def test_socket_transport_partial_reads():
         b.close()
 
 
+# ---------------------------------------------------------------------------
+# the typed wire payload (pickle-free; ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+def test_payload_round_trip_arrays_and_control():
+    import numpy as np
+
+    control = {"op": "submit", "id": "r1", "model": "m",
+               "priority": 3, "deadline": 0.5, "nested": {"k": [1, 2]}}
+    arrays = [np.arange(12, dtype=np.float32).reshape(3, 4),
+              np.array([], dtype=np.int64),
+              np.array(7, dtype=np.uint8)]
+    payload = framing.encode_payload(control, arrays)
+    ctrl, out = framing.decode_payload(payload)
+    assert ctrl == control  # the arrays descriptor list is stripped
+    assert len(out) == len(arrays)
+    for a, b in zip(arrays, out):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        assert np.array_equal(a, b)
+
+
+def test_payload_no_object_dtype_either_direction():
+    import numpy as np
+
+    with pytest.raises(framing.PayloadError):
+        framing.encode_payload({}, [np.array([object()], dtype=object)])
+    # hand-built hostile descriptor: decode refuses by allowlist
+    import json
+
+    for dtype in ("object", "str_", "void", "complex128", "S16", "<U4"):
+        ctrl = json.dumps({"arrays": [{"dtype": dtype, "shape": [1]}]},
+                          separators=(",", ":")).encode()
+        blob = len(ctrl).to_bytes(4, "big") + ctrl + b"\x00" * 16
+        with pytest.raises(framing.PayloadError):
+            framing.decode_payload(blob)
+
+
+def test_payload_truncation_sweep():
+    """Every proper prefix of a typed payload fails loudly — the frame
+    codec guards the stream, this guards the STRUCTURE."""
+    import numpy as np
+
+    payload = framing.encode_payload(
+        {"op": "submit", "id": "x"}, [np.ones((2, 3), np.float32)])
+    for cut in range(len(payload)):
+        with pytest.raises(framing.PayloadError):
+            framing.decode_payload(payload[:cut])
+
+
+def test_payload_trailing_bytes_are_an_error():
+    payload = framing.encode_payload({"op": "ping"})
+    with pytest.raises(framing.PayloadError):
+        framing.decode_payload(payload + b"x")
+
+
+def test_payload_hostile_shapes_and_caps():
+    import json
+
+    import numpy as np
+
+    def blob(meta, extra=b""):
+        ctrl = json.dumps({"arrays": meta},
+                          separators=(",", ":")).encode()
+        return len(ctrl).to_bytes(4, "big") + ctrl + extra
+
+    hostile = [
+        blob([{"dtype": "float32", "shape": [-1]}]),
+        blob([{"dtype": "float32", "shape": [True]}]),
+        blob([{"dtype": "float32", "shape": "4"}]),
+        blob([{"dtype": "float32", "shape": [1] * 9}]),     # > MAX_NDIM
+        blob([{"dtype": "float32", "shape": [2 ** 60]}]),   # huge alloc ask
+        blob([{"dtype": "float32"}]),                        # no shape
+        blob(["not-a-descriptor"]),
+        blob([{"dtype": "float32", "shape": [2]}], b"\x00" * 4),  # short buf
+        blob([{"dtype": "float32", "shape": []}] * 65),      # > MAX_ARRAYS
+    ]
+    for b in hostile:
+        with pytest.raises(framing.PayloadError):
+            framing.decode_payload(b)
+    # control-length prefix overrunning the payload / over the cap
+    with pytest.raises(framing.PayloadError):
+        framing.decode_payload(b"\xff\xff\xff\xff" + b"{}")
+    with pytest.raises(framing.PayloadError):
+        framing.decode_payload(
+            (framing.MAX_CONTROL_BYTES + 1).to_bytes(4, "big"))
+    # a zero-dim descriptor consuming 0 bytes is legal
+    ctrl, arrays = framing.decode_payload(
+        blob([{"dtype": "float32", "shape": [0, 4]}]))
+    assert arrays[0].shape == (0, 4)
+    assert isinstance(np.asarray(arrays[0]), np.ndarray)
+
+
+def test_payload_control_must_be_json_object():
+    for head in (b"[]", b"42", b'"s"', b"nope"):
+        blob = len(head).to_bytes(4, "big") + head
+        with pytest.raises(framing.PayloadError):
+            framing.decode_payload(blob)
+
+
 def test_checkpoint_uses_shared_codec(tmp_path):
     """The snapshot format IS this codec under the checkpoint magic —
     re-pointing checkpoints at framing.py changed no bytes on disk."""

@@ -1,0 +1,314 @@
+"""The process-isolated serving fleet (docs/serving.md, "The
+process-isolated fleet"): out-of-process replicas on the elastic
+liveness layer, request hedging, and SIGKILL respawn.
+
+The load-bearing pins: a replica is a real OS process with its own
+device subset (the fault domain, not just the policy); ``kill -9`` of a
+live replica under traffic drops nothing and double-resolves nothing
+(replay idempotent by request id); a respawned replica re-warms through
+the exact serving staging path BEFORE rejoining rotation and then serves
+with zero steady-state compiles and bit-identical results; hedging
+rescues the latency tail a real straggler creates; every hedge/respawn/
+death counter mirrors exactly into the telemetry registry at its
+increment site, labeled with the replica's pid where one exists.
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu import config
+from dask_ml_tpu.parallel import telemetry
+from dask_ml_tpu.parallel.elastic import FileHeartbeat
+from dask_ml_tpu.parallel.procfleet import ProcessFleet
+
+RAGGED_SIZES = (1, 3, 31, 33, 100, 128)
+
+
+def _data(n=512, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randn(n, d).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    from dask_ml_tpu.cluster import KMeans
+    from dask_ml_tpu.decomposition import PCA
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    X = _data(512, 8)
+    rng = np.random.RandomState(1)
+    y = (rng.rand(512) > 0.5).astype(np.int32)
+    return {
+        "X": X,
+        "kmeans": KMeans(n_clusters=4, random_state=0, max_iter=5).fit(X),
+        "logistic": LogisticRegression(max_iter=20).fit(X, y),
+        "pca": PCA(n_components=3, random_state=0).fit(X),
+    }
+
+
+@pytest.fixture(scope="module")
+def pfleet(fitted):
+    fleet = ProcessFleet(n_replicas=2, max_batch_rows=256,
+                         request_timeout_s=120.0, name="tpf")
+    fleet.register("kmeans", fitted["kmeans"])
+    fleet.register("logistic", fitted["logistic"])
+    fleet.register("pca", fitted["pca"])
+    fleet.start()
+    yield fleet
+    fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# the liveness primitive
+# ---------------------------------------------------------------------------
+
+
+def test_file_heartbeat_primitive(tmp_path):
+    """The factored PR-8 liveness layer: atomic mtime beats, tombstones
+    for graceful leavers, clear() as respawn hygiene."""
+    live = FileHeartbeat(str(tmp_path))
+    assert live.age("r0") is None  # never seen
+    live.beat("r0")
+    age = live.age("r0")
+    assert age is not None and age < 5.0
+    assert not live.has_tombstone("r0")
+    live.tombstone("r0")
+    assert live.has_tombstone("r0")
+    live.clear("r0")
+    assert live.age("r0") is None and not live.has_tombstone("r0")
+
+
+def test_elastic_run_rides_the_shared_liveness(tmp_path):
+    """ElasticRun's hb/tombstone files go through the same FileHeartbeat
+    primitive — one liveness layer for every fleet of processes."""
+    from dask_ml_tpu.parallel.elastic import ElasticRun
+
+    run = ElasticRun(str(tmp_path), rank=0, world=2,
+                     heartbeat_timeout=0.2)
+    assert os.path.exists(run._live.hb_path("host0"))
+    run.mark_dead(1)
+    assert run._live.has_tombstone("host1")
+    assert run.lost_hosts() == {1}
+
+
+# ---------------------------------------------------------------------------
+# process isolation + identity
+# ---------------------------------------------------------------------------
+
+
+def test_replicas_are_real_processes(pfleet):
+    pids = {rep.pid for rep in pfleet._procs}
+    assert len(pids) == 2
+    assert os.getpid() not in pids
+    for pid in pids:
+        os.kill(pid, 0)  # alive
+    remote = pfleet.remote_stats()
+    assert set(remote) == {"tpf-p0", "tpf-p1"}
+    for name, st in remote.items():
+        assert st["pid"] in pids
+        assert st["steady_compiles"] == 0  # warmed before rotation
+        assert st["warm_compiles"] > 0
+
+
+@pytest.mark.parametrize("name,method", [
+    ("kmeans", "predict"),
+    ("logistic", "predict_proba"),
+    ("pca", "transform"),
+])
+def test_bit_identity_across_processes(pfleet, fitted, name, method):
+    X = fitted["X"]
+    direct = getattr(fitted[name], method)
+    futs = [(n, pfleet.submit(name, X[:n], method=method))
+            for n in RAGGED_SIZES * 2]
+    for n, fut in futs:
+        assert np.array_equal(fut.result(120), direct(X[:n])), n
+
+
+def test_request_id_idempotent(pfleet, fitted):
+    """Submitting an id that is ALREADY IN FLIGHT returns the existing
+    future (client retry = same request). Pinned deterministically by
+    planting the in-flight entry — a served request retires its id, so
+    racing two real submits would test timing, not the contract."""
+    from concurrent.futures import Future
+
+    from dask_ml_tpu.parallel.procfleet import _PRequest
+
+    freq = _PRequest(rid="rid-Z", model="kmeans", method="predict",
+                     X=fitted["X"][:4], priority=0, deadline_abs=None,
+                     future=Future())
+    with pfleet._lock:
+        pfleet._inflight["rid-Z"] = freq
+    try:
+        f2 = pfleet.submit("kmeans", fitted["X"][:4], request_id="rid-Z")
+        assert f2 is freq.future
+    finally:
+        with pfleet._lock:
+            pfleet._inflight.pop("rid-Z", None)
+    # and a FRESH id routes normally
+    out = pfleet.submit("kmeans", fitted["X"][:4],
+                        request_id="rid-fresh").result(120)
+    assert np.array_equal(out, fitted["kmeans"].predict(fitted["X"][:4]))
+
+
+# ---------------------------------------------------------------------------
+# kill -9 under traffic: replay, respawn, zero steady-state compiles
+# ---------------------------------------------------------------------------
+
+
+def test_sigkill_respawn_zero_drops(pfleet, fitted):
+    """SIGKILL a replica PROCESS mid-traffic: zero dropped requests,
+    replay idempotent (every future resolved exactly once), the
+    respawned replica re-warms through the exact serving staging path
+    and serves bit-identical results with zero steady-state compiles."""
+    X = fitted["X"]
+    km = fitted["kmeans"]
+    victim = pfleet._procs[0]
+    old_pid, old_proc = victim.pid, victim.proc
+    results_before = pfleet.n_results
+    futs = [(i, pfleet.submit("kmeans", X[i:i + 8]))
+            for i in range(30)]
+    os.kill(old_pid, signal.SIGKILL)
+    for i, fut in futs:
+        assert np.array_equal(fut.result(180), km.predict(X[i:i + 8])), i
+    # exactly-once accounting: 30 futures, 30 first-resolutions — a
+    # replayed duplicate may compute twice but can only resolve once
+    assert pfleet.n_results - results_before == 30
+    assert pfleet.n_replica_deaths >= 1
+    # the kill was a real SIGKILL of a real process
+    old_proc.wait(30)
+    assert old_proc.returncode == -signal.SIGKILL
+    # respawn: fresh pid, warm before rotation, back to full strength
+    deadline = time.monotonic() + 180.0
+    while pfleet.replicas_up() < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert pfleet.replicas_up() == 2
+    assert pfleet.n_respawns >= 1
+    assert victim.pid != old_pid
+    # traffic after the respawn: bit-identical, and NO replica compiles
+    # anything in steady state (the respawned one warmed first)
+    for i in range(20):
+        out = pfleet.call("kmeans", X[i:i + 8], timeout=120)
+        assert np.array_equal(out, km.predict(X[i:i + 8]))
+    remote = pfleet.remote_stats()
+    assert len(remote) == 2
+    for name, st in remote.items():
+        assert st["steady_compiles"] == 0, (name, st)
+    assert victim.pid in {st["pid"] for st in remote.values()}
+
+
+# ---------------------------------------------------------------------------
+# hedging + telemetry mirror exactness
+# ---------------------------------------------------------------------------
+
+
+def test_hedging_rescues_straggler_and_mirrors_exactly(fitted):
+    """A real (wall-clock) intermittent straggler creates the tail;
+    hedging re-submits past the adaptive threshold and the hedge wins.
+    Every counter the router bumps mirrors EXACTLY into the telemetry
+    registry at its increment site, with per-replica labels carrying the
+    process pid where one exists."""
+    X = fitted["X"]
+    km = fitted["kmeans"]
+    telemetry.reset_telemetry()
+    with config.config_context(telemetry=True):
+        fleet = ProcessFleet(
+            n_replicas=2, max_batch_rows=256, name="thf",
+            straggle={0: (0.3, 3)}, hedge_min_s=0.02,
+            request_timeout_s=120.0)
+        fleet.register("kmeans", km)
+        fleet.start()
+        try:
+            lats = []
+            for i in range(36):
+                t0 = time.perf_counter()
+                out = fleet.call("kmeans", X[i:i + 8], timeout=120)
+                lats.append(time.perf_counter() - t0)
+                assert np.array_equal(out, km.predict(X[i:i + 8])), i
+            assert fleet.n_hedged >= 1
+            assert fleet.n_hedge_wins >= 1
+            # the hedge rescued the tail: no request paid the full
+            # straggle twice over
+            assert max(lats) < 2 * 0.3
+            stats = fleet.stats()
+        finally:
+            fleet.stop()
+        rep = telemetry.telemetry_report()
+    counters = rep["metrics"]["counters"]
+
+    def total(prefix):
+        return sum(v for k, v in counters.items()
+                   if k == prefix or k.startswith(prefix + "{"))
+
+    # mirror exactness: registry == the router's own counters
+    assert total("serving.hedged") == stats["hedged"]
+    assert total("serving.hedge_wins") == stats["hedge_wins"]
+    assert total("fleet.reroutes") == stats["reroutes"]
+    assert total("fleet.replica_deaths") == stats["replica_deaths"] == 0
+    # hedge labels name the target replica
+    assert any(k.startswith("serving.hedged{") and "replica=" in k
+               for k in counters)
+
+
+def test_death_and_respawn_counters_carry_pid(fitted):
+    """Mirror-exactness for the death/respawn counters, labels carrying
+    the OS pid of the incarnation that died / was born."""
+    X = fitted["X"]
+    km = fitted["kmeans"]
+    telemetry.reset_telemetry()
+    with config.config_context(telemetry=True):
+        fleet = ProcessFleet(n_replicas=2, max_batch_rows=256,
+                             name="tdf", request_timeout_s=120.0)
+        fleet.register("kmeans", km)
+        fleet.start()
+        try:
+            old_pid = fleet._procs[1].pid
+            fleet.call("kmeans", X[:8], timeout=120)
+            os.kill(old_pid, signal.SIGKILL)
+            deadline = time.monotonic() + 180.0
+            while fleet.n_respawns < 1 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            new_pid = fleet._procs[1].pid
+            assert fleet.n_respawns == 1 and fleet.n_replica_deaths == 1
+            stats = fleet.stats()
+        finally:
+            fleet.stop()
+        rep = telemetry.telemetry_report()
+    counters = rep["metrics"]["counters"]
+    # labels render sorted: pid before replica
+    assert counters[
+        f"fleet.replica_deaths{{pid={old_pid},replica=tdf-p1}}"] == 1
+    respawn_keys = [k for k in counters if k.startswith("fleet.respawns")]
+    assert len(respawn_keys) == 1
+    assert str(new_pid) in respawn_keys[0]
+    assert counters[respawn_keys[0]] == stats["respawns"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the full drill (slow tier; CI's chaos job runs the scaled-down variant
+# through bench.py directly)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_full_process_kill_drill_all_gates():
+    """The complete FLEET_r02 drill at its committed scale: kill -9 of a
+    live replica process under traffic, hedging A/B, respawn, drain —
+    nonzero exit on any gate."""
+    import json
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "bench.py"), "--fleet-proc"],
+        cwd=root, env=env, capture_output=True, text=True, timeout=1200)
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
+    with open(os.path.join(root, "FLEET_r02.json")) as f:
+        rec = json.load(f)
+    assert rec["all_gates_pass"], rec["gates"]
