@@ -16,6 +16,7 @@ pca.py:278-292).
 
 from __future__ import annotations
 
+import contextlib
 import logging
 from functools import partial
 
@@ -88,7 +89,16 @@ def _fit_program(X, w, key, n, *, k, n_power_iter, randomized, mesh,
     small SVD stay f32. ``None`` follows the data dtype; the exact tsqr
     path upcasts low-precision input itself (ops/linalg.py)."""
     from dask_ml_tpu.ops import linalg
+    from dask_ml_tpu.parallel import hierarchy as hier
 
+    # Feature-sharded fits (under an active model_metered scope, i.e. the
+    # facade staged X P(..., 'model')): record the model-axis collectives
+    # GSPMD/the tsqr in_specs insert, analytically, at TRACE time — the
+    # column gather that reassembles each row shard's full width for the
+    # factorization, and the (k, d) components gather on the way out.
+    hier.record_model_collective("pca.colgather", X.shape, X.dtype)
+    hier.record_model_collective("pca.components.gather",
+                                 (k, int(X.shape[1])), jnp.float32)
     mean = _weighted_mean(X, w)
     Xc = _center_and_mask(X, w, mean)
     if randomized:
@@ -200,10 +210,18 @@ class PCA(BaseEstimator, TransformerMixin):
 
         sketch_dtype = (precision_lib.resolve().compute_for("sketch")
                         if randomized else None)
+        from dask_ml_tpu.parallel import hierarchy as hier
+
         with telemetry.span("pca-fit-program", logger=logger,
-                    solver=solver, k=int(n_components)):
+                    solver=solver, k=int(n_components)), \
+                (hier.model_metered(mesh) if shard_features
+                 else contextlib.nullcontext()):
             # centering + masking + factorization + sign flip + total
-            # variance as one dispatch (see _fit_program)
+            # variance as one dispatch (see _fit_program). The metered
+            # scope makes the feature-sharded fit's model-axis collectives
+            # record INSIDE the traced program — per trace, so repeat fits
+            # (cache hits) add nothing and the compile-once <=> ledger
+            # gate holds.
             mean, U, S, Vt, tv = _fit_program(
                 data.X, data.weights, key, float(n_samples),
                 k=k_fit, n_power_iter=int(self.iterated_power),

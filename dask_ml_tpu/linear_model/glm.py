@@ -280,15 +280,21 @@ class _GLM(BaseEstimator):
             )
 
         from dask_ml_tpu.ops import sparse as sparse_ops
+        from dask_ml_tpu.parallel import hierarchy as hier
 
         with telemetry.span(f"glm-{self.solver}", logger=logger), \
                 (sparse_ops.metered(mesh) if sparse_in
+                 else contextlib.nullcontext()), \
+                (hier.model_metered(mesh) if tensor_parallel
                  else contextlib.nullcontext()):
-            # the metered scope makes the sparse contractions' cross-shard
-            # collectives (pullback/Gram reductions) record per-axis bytes
-            # into the hierarchy ledger AT TRACE TIME — cache hits record
-            # nothing, preserving the per-trace semantics docs/scale-out.md
-            # pins (zero steady-state compiles <=> zero ledger growth)
+            # the metered scopes make the cross-shard collectives record
+            # per-axis bytes into the hierarchy ledger AT TRACE TIME —
+            # sparse contractions (pullback/Gram reductions) under
+            # sparse_ops.metered; feature-sharded fits' GSPMD-implicit
+            # model-axis collectives (matvec/pullback/Gram seams) under
+            # hier.model_metered. Cache hits record nothing, preserving
+            # the per-trace semantics docs/scale-out.md pins (zero steady
+            # state compiles <=> zero ledger growth)
             results = [solve_one(y_dev) for y_dev in self._solve_targets(data)]
         betas = [np.asarray(b)[:d_true] for b, _ in results]  # drop padding
         self.n_iter_ = int(max(int(n) for _, n in results))

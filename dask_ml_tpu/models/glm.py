@@ -45,6 +45,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from dask_ml_tpu.ops import sparse as sparse_ops
+from dask_ml_tpu.parallel import hierarchy as hier
 from dask_ml_tpu.parallel import precision as px
 from dask_ml_tpu.parallel.hierarchy import hpsum
 from dask_ml_tpu.parallel.mesh import data_pspec, n_data_shards, shard_map
@@ -180,9 +181,18 @@ def _data_matvec(X, v):
     blocked-ELL gather/segment-sum kernels — the kernel swap behind this
     stable seam is the whole sparse-GLM story, the solvers above it are
     untouched. Dispatch is BY INPUT TYPE, never a flag: dense inputs take
-    the exact contraction they always did, bit-unchanged."""
+    the exact contraction they always did, bit-unchanged.
+
+    Under a :func:`~dask_ml_tpu.parallel.hierarchy.model_metered` scope
+    (feature-sharded GSPMD fits) the dense contraction additionally records
+    its analytic model-axis combining bytes — the (n,)-sized partial-eta
+    reduce GSPMD inserts when X's columns shard over 'model'. Recording is
+    per-trace inside the jitted program, same discipline as the sparse
+    meter."""
     if isinstance(X, sparse_ops.SparseRows):
         return sparse_ops.matvec(X, v)
+    hier.record_model_collective("glm.matvec", (int(X.shape[0]),),
+                                 px.state_dtype(X.dtype))
     return px.pmatmul(X, v, accum=px.state_dtype(X.dtype))
 
 
@@ -191,9 +201,14 @@ def _data_pullback(X, r):
     discipline as :func:`_data_matvec`: the f32 residual-like vector ``r``
     is cast to X's compute dtype, the contraction over the (possibly
     sharded) sample axis accumulates ≥f32. Sparse containers scatter-add
-    through ``segment_sum`` over the stored column indices."""
+    through ``segment_sum`` over the stored column indices. Feature-sharded
+    fits meter the gradient's model-axis gather (each shard owns a coef
+    slice; the full (d,) gradient reassembles across 'model') under the
+    :func:`~dask_ml_tpu.parallel.hierarchy.model_metered` scope."""
     if isinstance(X, sparse_ops.SparseRows):
         return sparse_ops.pullback(X, r)
+    hier.record_model_collective("glm.pullback", (int(X.shape[1]),),
+                                 px.state_dtype(X.dtype))
     return px.pdot(X, r, (((0,), (0,)), ((), ())),
                    accum=px.state_dtype(X.dtype))
 
@@ -206,9 +221,15 @@ def _weighted_gram(X, h):
     (MXU-native) while the Hessian itself lands f32 for the dense solve.
     Sparse containers build the same (d, d) matrix by chunked scatter-add
     of per-row nonzero outer products — O(nnz·k), only sensible where a
-    dense Hessian is sensible at all."""
+    dense Hessian is sensible at all. Feature-sharded fits meter the
+    Hessian's model-axis assembly (the (d, d) blocks each shard contracts
+    gather over 'model' for the replicated-RHS Newton solve) under the
+    :func:`~dask_ml_tpu.parallel.hierarchy.model_metered` scope."""
     if isinstance(X, sparse_ops.SparseRows):
         return sparse_ops.weighted_gram(X, h)
+    hier.record_model_collective(
+        "glm.gram.gather", (int(X.shape[1]), int(X.shape[1])),
+        px.state_dtype(X.dtype))
     Xh = (h[:, None] * X).astype(X.dtype)
     return px.pdot(X, Xh, (((0,), (0,)), ((), ())),
                    accum=px.state_dtype(X.dtype))

@@ -157,14 +157,28 @@ def _pallas_auto_wins(k: int, d: int, dtype) -> bool:
     Rule distilled from the table, conservative (pallas only where it won
     ≥1.5× reliably): large-k/small-d any dtype, or bf16 with d ≥ 128.
     TPU only — on other backends the kernel runs in interpret mode and the
-    measurements do not transfer."""
+    measurements do not transfer.
+
+    The decision cache (``parallel/decisions.py``) is consulted first:
+    where a bench run has TIMED this (k, d, dtype) regime on this backend,
+    its verdict overrides the distilled rule; everywhere else the rule
+    above is the cold-start fallback. The support bound stays outside the
+    cache — it is a correctness guard, not a speed question."""
     if not _pallas_lloyd_supported(k, d):
         return False
-    if jax.default_backend() != "tpu":
-        return False
-    if k >= 128 and d <= 128:
-        return True
-    return dtype == jnp.bfloat16 and d >= 128
+    from dask_ml_tpu.parallel import decisions
+
+    def _fallback():
+        if jax.default_backend() != "tpu":
+            return False
+        if k >= 128 and d <= 128:
+            return True
+        return dtype == jnp.bfloat16 and d >= 128
+
+    return decisions.lookup(
+        "kmeans.lloyd.pallas",
+        {"k": k, "d": d, "dtype": str(jnp.dtype(dtype))},
+        fallback=_fallback())
 
 
 def _lloyd_iter_pallas(centers, XT, w2d, n_loc: int):
@@ -278,9 +292,10 @@ def _lloyd_iter_pallas(centers, XT, w2d, n_loc: int):
     )(centers, XT, w2d)
 
 
-@partial(jax.jit, static_argnames=("mesh", "max_iter", "kernel"))
+@partial(jax.jit, static_argnames=("mesh", "max_iter", "kernel",
+                                   "shard_features"))
 def lloyd_loop_fused(X, w, centers0, tol, *, mesh, max_iter: int,
-                     kernel: str = "auto"):
+                     kernel: str = "auto", shard_features: bool = False):
     """Bandwidth-optimal Lloyd over a feature-major (transposed) copy of X.
 
     Two layout/scheduling facts dominate this kernel's speed on TPU, both
@@ -333,11 +348,28 @@ def lloyd_loop_fused(X, w, centers0, tol, *, mesh, max_iter: int,
     per iteration, with per-axis bytes metered in the traffic ledger
     (docs/scale-out.md). On a flat mesh the same call IS today's single
     psum over ``"data"`` — bit-identical program.
+
+    ``shard_features=True`` on a mesh with a ``model`` axis runs the
+    FEATURE-PARALLEL variant (docs/scale-out.md "The model axis"): X
+    enters sharded over both axes (``P(data_axes, 'model')``), centers
+    carry and return as ``P(None, 'model')`` column slices — per-chip
+    center state is (k, d/m), which is what lets k·d grow past one chip's
+    HBM. Each iteration's partial scores reduce over 'model'
+    (``mpsum``, op ``kmeans.scores``); the argmin, counts and inertia are
+    then model-invariant, and the M-step sums stay feature-local so the
+    (pod, chip) ``hpsum`` moves only (k·d/m + k + 1) floats per chip —
+    the model axis SHRINKS the sample-axis traffic m-fold. The pallas
+    kernel's accumulator layout is d-global, so the feature-parallel
+    variant is XLA-only (an explicit ``kernel='pallas'`` raises; 'auto'
+    never selects it here). With ``model=1`` (or a model-less mesh) the
+    flag is inert and the program is the 2-axis one, bit-identical.
     """
     from jax.sharding import PartitionSpec as P
 
-    from dask_ml_tpu.parallel.hierarchy import hpsum
-    from dask_ml_tpu.parallel.mesh import data_pspec, shard_map
+    from dask_ml_tpu.parallel.hierarchy import hpsum, mpsum
+    from dask_ml_tpu.parallel.mesh import (MODEL_AXIS, data_pspec,
+                                           feature_pspec, n_model_shards,
+                                           shard_map)
 
     k, d = centers0.shape
     if kernel not in ("auto", "pallas", "xla"):
@@ -345,16 +377,25 @@ def lloyd_loop_fused(X, w, centers0, tol, *, mesh, max_iter: int,
     if kernel == "pallas" and not _pallas_lloyd_supported(k, d):
         raise ValueError(
             f"kernel='pallas' supports k<=128, d<=512; got k={k}, d={d}")
-    use_pallas = kernel == "pallas" or (
-        kernel == "auto" and _pallas_auto_wins(k, d, X.dtype))
+    model = bool(shard_features) and n_model_shards(mesh) > 1
+    if model and kernel == "pallas":
+        raise ValueError(
+            "kernel='pallas' does not compose with feature sharding "
+            "(the single-pass kernel accumulates d-global state); use "
+            "kernel='xla' or 'auto'")
+    use_pallas = not model and (kernel == "pallas" or (
+        kernel == "auto" and _pallas_auto_wins(k, d, X.dtype)))
 
     dspec2, dspec1 = data_pspec(mesh, ndim=2), data_pspec(mesh, ndim=1)
+    if model:
+        dspec2 = feature_pspec(mesh, ndim=2)
+    cspec = P(None, MODEL_AXIS) if model else P()
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(dspec2, dspec1, P(), P()),
-        out_specs=(P(), P(), P(), P()),
+        in_specs=(dspec2, dspec1, cspec, P()),
+        out_specs=(cspec, P(), P(), P()),
         # vma typing can't see through a pallas_call (and interpret mode
         # trips on kernel-internal constants), so the pallas path runs
         # unchecked; the default XLA path keeps the check.
@@ -364,26 +405,34 @@ def lloyd_loop_fused(X, w, centers0, tol, *, mesh, max_iter: int,
         # One-time feature-major relayout; the barrier keeps XLA from fusing
         # the transpose into each iteration's reads (which would re-pad d
         # back onto the lane dimension).
-        XT = jax.lax.optimization_barrier(X_loc.T)  # (d, n_loc)
+        XT = jax.lax.optimization_barrier(X_loc.T)  # (d[/m], n_loc)
         if use_pallas:
             w2d = w_loc[None, :].astype(jnp.float32)
         else:
             x2 = jnp.sum(XT.astype(jnp.float32) ** 2, axis=0)  # invariant
+            if model:
+                # ‖x‖² needs every feature: one loop-hoisted model psum
+                x2 = mpsum(x2, mesh, op="kmeans.x2")
             kidx = jnp.arange(k, dtype=jnp.int32)[:, None]
 
         def local_stats_xla(centers):
             cx = centers.astype(XT.dtype)
-            c2 = jnp.sum(centers * centers, axis=1)  # (k,) f32
+            c2 = jnp.sum(centers * centers, axis=1)  # (k,) f32 [partial]
             prod = jax.lax.dot_general(
                 cx, XT, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)  # (k, n_loc)
             scores = c2[:, None] - 2.0 * prod
+            if model:
+                # feature-partial scores combine over 'model'; everything
+                # derived from them (argmin, counts, inertia) is then
+                # model-invariant by construction
+                scores = mpsum(scores, mesh, op="kmeans.scores")
             best = jnp.argmin(scores, axis=0).astype(jnp.int32)
             onehot = (kidx == best[None, :]).astype(jnp.float32)
             oh_w = onehot * w_loc[None, :]
             sums = jax.lax.dot_general(
                 oh_w, XT.astype(jnp.float32), (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)  # (k, d)
+                preferred_element_type=jnp.float32)  # (k, d[/m])
             counts = oh_w.sum(axis=1)
             mind = jnp.maximum(jnp.min(scores, axis=0) + x2, 0.0)
             inertia = jnp.sum(mind * w_loc)
@@ -403,6 +452,10 @@ def lloyd_loop_fused(X, w, centers0, tol, *, mesh, max_iter: int,
             inertia = hpsum(inertia, mesh, op="kmeans.mstep")
             new_centers = _new_centers(sums, counts, centers)
             shift = jnp.sum((new_centers - centers) ** 2)
+            if model:
+                # per-slice partial shift → global shift, so the model
+                # shards agree on the convergence decision exactly
+                shift = mpsum(shift, mesh, op="kmeans.shift")
             return new_centers, inertia, shift
 
         def cond(state):
@@ -464,8 +517,14 @@ def _bounded_auto_wins(n: int, k: int, d: int) -> bool:
     (k ≥ 4 — below that the assignment pass is already cheaper than the
     M-step it cannot skip). Small problems keep the plain fused loop:
     the bench trajectory (BOUNDS_r01.json) measures the crossover; this
-    rule is deliberately conservative so 'auto' never loses."""
-    return n >= (1 << 16) and k >= 4
+    rule is deliberately conservative so 'auto' never loses. Bench-timed
+    regimes in the decision cache (``parallel/decisions.py``) override
+    the rule point-wise; it remains the cold-start fallback."""
+    from dask_ml_tpu.parallel import decisions
+
+    return decisions.lookup(
+        "kmeans.lloyd.bounded", {"n": n, "k": k, "d": d},
+        fallback=n >= (1 << 16) and k >= 4)
 
 
 def _bounded_groups(k: int, groups):

@@ -116,14 +116,23 @@ def _fused_auto_wins(n: int, m: int, d: int, dtype, mesh) -> bool:
     TPU only — off-TPU the kernel runs in interpret mode, where the
     unfused XLA path always wins (the CPU CI mesh exercises pallas through
     the property tests, never through auto).
+
+    Bench-measured regimes in the decision cache
+    (``parallel/decisions.py``) override the roofline rule point-wise; the
+    support/mesh guards above stay outside the cache (correctness, not
+    speed).
     """
     if not _fused_supported(m, d):
         return False
-    if jax.default_backend() != "tpu":
-        return False
     if mesh is None and jax.device_count() > 1:
         return False  # no GSPMD rule for pallas_call: would gather the shard
-    return n >= (1 << 18) and m >= 16 and d <= 128
+    from dask_ml_tpu.parallel import decisions
+
+    return decisions.lookup(
+        "fused.distance.pallas",
+        {"n": n, "m": m, "d": d, "dtype": str(jnp.dtype(dtype))},
+        fallback=(jax.default_backend() == "tpu"
+                  and n >= (1 << 18) and m >= 16 and d <= 128))
 
 
 def _check_kernel(kernel: str, m: int, d: int) -> None:

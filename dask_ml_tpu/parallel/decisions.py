@@ -1,0 +1,163 @@
+"""Measured autotuner decision cache (the persisted side of auto-dispatch).
+
+The kernel-dispatch predicates — ``models/kmeans.py::_pallas_auto_wins`` /
+``_bounded_auto_wins``, ``ops/fused_distance.py::_fused_auto_wins``, and the
+sparse SpMM rule in ``ops/sparse.py::_use_pallas`` — are hand-written
+inequalities distilled from bench sweeps. Those stay as the COLD-START
+fallback; this module adds the measured tier on top: ``bench.py`` timings
+persist per-``(rule, backend)`` verdicts into a JSON cache, and the dispatch
+predicates consult it FIRST through one lookup helper.
+
+Entry shape (``_decisions.json``, committed next to this module)::
+
+    {"rule": "sparse.spmv.pallas",
+     "backend": "cpu",
+     "match": {"n": [4096, 16384], "k": 16},
+     "verdict": false,
+     "measured": {"xla_ms": 0.8, "pallas_ms": 41.0, "n": 8192}}
+
+``match`` values are either a scalar (exact equality; dtypes compare by
+``str``) or an inclusive ``[lo, hi]`` range. An entry applies only when its
+``backend`` equals ``jax.default_backend()`` at call time (read dynamically,
+so backend mocks in tests see their mocked world) and EVERY match key is
+present and satisfied. First matching entry wins; no entry → fallback.
+
+Ranges are kept deliberately NARROW (the bench writes ±50% brackets around
+each measured point): the cache answers where a measurement exists and the
+inequalities keep answering everywhere else, so a cache populated on one
+host never silently overrides regimes it has no data for.
+
+Guard predicates that are about CORRECTNESS, not speed — pallas support
+checks, row-count tiling, mesh-compatibility — always stay OUTSIDE the
+lookup in the calling predicate: the cache decides "would it be faster",
+never "is it legal".
+
+``DASK_ML_TPU_DECISIONS`` points the loader at an alternate cache file
+(bench drills, scratch experiments); ``save()`` is only ever invoked by
+``bench.py`` under ``DECISIONS_WRITE=1``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Optional
+
+__all__ = ["lookup", "record", "save", "reset_cache", "cache_path",
+           "entries"]
+
+_lock = threading.Lock()
+_cache: Optional[list] = None  # lazy-loaded entry list
+
+
+def cache_path() -> str:
+    """The active cache file: ``$DASK_ML_TPU_DECISIONS`` if set, else the
+    ``_decisions.json`` committed next to this module."""
+    env = os.environ.get("DASK_ML_TPU_DECISIONS")
+    if env:
+        return env
+    return os.path.join(os.path.dirname(__file__), "_decisions.json")
+
+
+def _load() -> list:
+    global _cache
+    with _lock:
+        if _cache is not None:
+            return _cache
+        path = cache_path()
+        entries_ = []
+        try:
+            with open(path) as fh:
+                raw = json.load(fh)
+            entries_ = list(raw.get("entries", []))
+        except (OSError, ValueError):  # missing/corrupt cache = cold start
+            entries_ = []
+        _cache = entries_
+        return _cache
+
+
+def reset_cache() -> None:
+    """Drop the in-memory cache; the next lookup reloads from disk. Tests
+    use this around ``DASK_ML_TPU_DECISIONS`` monkeypatching."""
+    global _cache
+    with _lock:
+        _cache = None
+
+
+def entries() -> list:
+    """The loaded entry list (a copy)."""
+    return list(_load())
+
+
+def _matches(spec, value) -> bool:
+    if isinstance(spec, (list, tuple)):
+        if len(spec) != 2:
+            return False
+        try:
+            return float(spec[0]) <= float(value) <= float(spec[1])
+        except (TypeError, ValueError):
+            return False
+    if isinstance(spec, str) or isinstance(value, str):
+        return str(spec) == str(value)
+    try:
+        return float(spec) == float(value)
+    except (TypeError, ValueError):
+        return spec == value
+
+
+def lookup(rule: str, params: dict, fallback: bool) -> bool:
+    """Measured verdict for ``rule`` at ``params``, else ``fallback``.
+
+    ``params`` holds the dispatch-relevant scalars (sizes as ints, dtypes
+    pre-stringified by the caller). Backend is matched dynamically against
+    ``jax.default_backend()``.
+    """
+    cached = _load()
+    if not cached:
+        return bool(fallback)
+    import jax
+
+    backend = jax.default_backend()
+    for e in cached:
+        if e.get("rule") != rule or e.get("backend") != backend:
+            continue
+        match = e.get("match", {})
+        if all(k in params and _matches(v, params[k])
+               for k, v in match.items()):
+            return bool(e.get("verdict"))
+    return bool(fallback)
+
+
+def record(rule: str, match: dict, verdict: bool, measured: dict = None,
+           backend: str = None) -> dict:
+    """Append a measured entry to the in-memory cache (bench-side; persist
+    with :func:`save`). Returns the entry."""
+    import jax
+
+    entry = {
+        "rule": rule,
+        "backend": backend or jax.default_backend(),
+        "match": match,
+        "verdict": bool(verdict),
+    }
+    if measured:
+        entry["measured"] = measured
+    cached = _load()
+    with _lock:
+        cached.append(entry)
+    return entry
+
+
+def save(path: str = None) -> str:
+    """Write the in-memory cache to ``path`` (default: the active cache
+    file). Only ``bench.py`` calls this, and only under
+    ``DECISIONS_WRITE=1`` — imports never write."""
+    path = path or cache_path()
+    cached = _load()
+    with _lock:
+        payload = {"entries": cached}
+        with open(path, "w") as fh:
+            json.dump(payload, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+    return path

@@ -44,6 +44,20 @@ DCN-exposed, which is what the MULTICHIP bench compares the hierarchical
 
 so cross-pod bytes shrink by ``(N - 1) / (n_pods - 1) >= chips_per_pod``.
 
+**The model axis.** ``make_hierarchical_mesh(..., model_parallel=m)`` adds
+a third, innermost ``'model'`` axis for feature-axis tensor parallelism:
+coef/center/component state shards over ``P(..., 'model')`` while sample
+reductions stay on the (pod, chip) path above. Feature-axis collectives —
+:func:`mpsum` (d-contraction partials), :func:`mpgather` (coef slice
+all-gather), :func:`mpsum_scatter` (gradient reduce-scatter) — record
+under their own ``model`` ledger axis, one reduction group per data
+coordinate; sample-axis collectives on a 3-axis mesh multiply their
+chip/pod terms by ``m`` (one group per model coordinate). Degenerate
+``model_parallel=1`` returns the plain two-axis mesh, and every model
+collective guards on axis size — the ``model=1`` path is zero-collective
+and bit-identical, with an EMPTY model row in the ledger
+(docs/scale-out.md "The model axis").
+
 Recording happens at the Python call site, i.e. once per TRACE of the
 enclosing program — the ledger counts logical bytes per traced execution of
 each collective site. Loops (``lax.while_loop`` bodies) re-execute sites
@@ -56,6 +70,7 @@ growth.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from typing import Optional, Sequence
 
@@ -67,12 +82,14 @@ from jax.sharding import Mesh
 from dask_ml_tpu.parallel.mesh import (
     CHIP_AXIS,
     DATA_AXIS,
+    MODEL_AXIS,
     POD_AXIS,
     data_axes,
     data_pspec,
     is_hierarchical,
     make_mesh,
     n_data_shards,
+    n_model_shards,
 )
 
 __all__ = [
@@ -80,6 +97,11 @@ __all__ = [
     "hpsum",
     "hpmean",
     "hpsum_scatter",
+    "mpsum",
+    "mpgather",
+    "mpsum_scatter",
+    "model_metered",
+    "record_model_collective",
     "TrafficLedger",
     "ledger",
     "reset_ledger",
@@ -94,8 +116,13 @@ def make_hierarchical_mesh(
     n_pods: int,
     chips_per_pod: Optional[int] = None,
     devices: Optional[Sequence[jax.Device]] = None,
+    model_parallel: int = 1,
 ) -> Mesh:
-    """An ``(n_pods, chips_per_pod)`` mesh with axes ``('pod', 'chip')``.
+    """An ``(n_pods, chips_per_pod)`` mesh with axes ``('pod', 'chip')`` —
+    or, with ``model_parallel=m > 1``, an ``(n_pods, chips_per_pod, m)``
+    mesh with axes ``('pod', 'chip', 'model')`` whose innermost axis lives
+    INSIDE pods (model-parallel groups never straddle the DCN; the feature
+    axis's chatty collectives stay on the ICI).
 
     ``chips_per_pod=None`` auto-factors from the device count. Devices fill
     the grid pod-major (row-major reshape of the device list), so shard
@@ -107,11 +134,23 @@ def make_hierarchical_mesh(
     stage is a size-1 identity and every program is bit-identical to the
     flat mesh on the same devices.
 
+    ``model_parallel=1`` returns the plain two-axis mesh — the degenerate
+    feature-parallel case is STRUCTURALLY the 2-axis path (no third axis,
+    no model collectives, no model ledger entries), which is the strongest
+    form of the "model=1 bit-identical" pin. A caller who builds an
+    explicit size-1 ``model`` axis via :func:`make_mesh` gets the same
+    behavior from the collective family's size-1 guards.
+
     On a real multi-host deployment, build it so the pod axis coincides
     with the host/pod boundary (processes own contiguous device ranges, so
     ``n_pods = process_count`` does exactly that — see
     ``tests/test_multihost.py``).
     """
+    if model_parallel and int(model_parallel) > 1:
+        return make_mesh(
+            devices=devices,
+            shape=(n_pods, chips_per_pod, int(model_parallel)),
+            axis_names=(POD_AXIS, CHIP_AXIS, MODEL_AXIS))
     return make_mesh(devices=devices, shape=(n_pods, chips_per_pod),
                      axis_names=(POD_AXIS, CHIP_AXIS))
 
@@ -199,14 +238,25 @@ def collective_bytes(mesh: Mesh, nbytes: int) -> dict:
     hierarchical meshes charge ``n_pods*(cpp-1)*B`` to ``"chip"`` (one
     combining tree per pod, over ICI) and ``(n_pods-1)*B`` to ``"pod"``
     (one tree over DCN). Axes of size 1 charge zero — the zero-collective
-    path the ledger pins must show as exactly 0."""
+    path the ledger pins must show as exactly 0.
+
+    On a mesh with a ``model`` axis every term additionally multiplies by
+    ``m = n_model_shards(mesh)``: a sample-axis psum runs one independent
+    reduction group per model coordinate. ``nbytes`` is the operand's bytes
+    AT THE CALL SITE — the per-device shape inside ``shard_map`` — so the
+    two feature layouts both come out honest: a model-REPLICATED operand
+    (in_specs that don't mention ``model``) charges ``m`` redundant groups
+    of the full operand, exactly what XLA executes; a model-SHARDED operand
+    charges ``m`` groups of ``1/m``-slices, i.e. the full logical bytes
+    once. ``m=1`` degenerates bit-exactly to the two-axis model."""
     nbytes = int(nbytes)
+    m = n_model_shards(mesh)
     if is_hierarchical(mesh):
         n_pods = int(mesh.shape[POD_AXIS])
         cpp = int(mesh.shape[CHIP_AXIS])
-        return {CHIP_AXIS: n_pods * (cpp - 1) * nbytes,
-                POD_AXIS: (n_pods - 1) * nbytes}
-    return {DATA_AXIS: (n_data_shards(mesh) - 1) * nbytes}
+        return {CHIP_AXIS: m * n_pods * (cpp - 1) * nbytes,
+                POD_AXIS: m * (n_pods - 1) * nbytes}
+    return {DATA_AXIS: m * (n_data_shards(mesh) - 1) * nbytes}
 
 
 def record_collective(op: str, mesh: Mesh, shape, dtype) -> None:
@@ -220,12 +270,26 @@ def record_collective(op: str, mesh: Mesh, shape, dtype) -> None:
 
 def record_axis_collective(op: str, mesh: Mesh, axis: str,
                            nbytes: int) -> None:
-    """Record a single-axis collective (a within-pod gather, a cross-pod
-    gather) with the same (size-1)*B-per-group model: the ``chip`` axis
-    runs one group per pod, every other axis one group total."""
+    """Record a single-axis collective with the (size-1)*B-per-group model.
+
+    Group counts follow the staged-reduction conventions of the collective
+    family: the ``chip`` stage runs one group per (pod, model) coordinate;
+    the ``pod`` stage runs after the chip fold, so one group per model
+    coordinate; a ``model``-axis collective runs one group per DATA
+    coordinate (feature-axis collectives are independent per sample shard);
+    any other axis one group total. All the extra factors are 1 on meshes
+    without the corresponding axes, so two-axis and flat accounting is
+    unchanged."""
     s = int(mesh.shape[axis])
-    groups = int(mesh.shape[POD_AXIS]) if (
-        axis == CHIP_AXIS and is_hierarchical(mesh)) else 1
+    m = n_model_shards(mesh)
+    if axis == CHIP_AXIS and is_hierarchical(mesh):
+        groups = int(mesh.shape[POD_AXIS]) * m
+    elif axis == POD_AXIS and is_hierarchical(mesh):
+        groups = m
+    elif axis == MODEL_AXIS:
+        groups = n_data_shards(mesh)
+    else:
+        groups = 1
     _ledger.record(op, axis, (s - 1) * int(nbytes) * groups)
 
 
@@ -280,5 +344,114 @@ def hpsum_scatter(x, mesh: Mesh, *, op: str = "psum_scatter"):
     return lax.psum_scatter(x, DATA_AXIS, tiled=True)
 
 
+# ---------------------------------------------------------------------------
+# the feature-axis ("model") collective family
+# ---------------------------------------------------------------------------
+
+
+def _local_nbytes(x) -> int:
+    return int(np.prod(x.shape, dtype=np.int64)) * int(x.dtype.itemsize)
+
+
+def mpsum(x, mesh: Mesh, *, op: str = "mpsum"):
+    """All-reduce-sum over the ``model`` axis (feature-axis partials: the
+    d-contraction of a feature-sharded matvec, partial squared norms).
+
+    On any mesh whose model axis is absent or size 1 this is an IDENTITY —
+    no psum, no ledger entry — which is the zero-collective ``model=1``
+    path the ledger pins check: degenerate meshes record exactly nothing
+    under the ``model`` axis. Otherwise records ``(m-1)*B`` per data
+    coordinate (``B`` = the per-device operand at this call site) under the
+    ``model`` ledger axis and reduces. Must be called inside a
+    ``shard_map`` that binds the axis (when m > 1)."""
+    if n_model_shards(mesh) <= 1:
+        return x
+    record_axis_collective(op, mesh, MODEL_AXIS, _local_nbytes(x))
+    return lax.psum(x, MODEL_AXIS)
+
+
+def mpgather(x, mesh: Mesh, *, op: str = "mpgather", axis: int = 0):
+    """All-gather of per-model-shard slices (coef slices, per-column stats)
+    into the full axis, tiled along ``axis``. Identity (no collective, no
+    ledger entry) when the model axis is absent or size 1. Records
+    ``(m-1)*B_shard`` per data coordinate — the (s-1) shard-sized messages
+    each participant's ring stage forwards."""
+    if n_model_shards(mesh) <= 1:
+        return x
+    record_axis_collective(op, mesh, MODEL_AXIS, _local_nbytes(x))
+    return lax.all_gather(x, MODEL_AXIS, axis=axis, tiled=True)
+
+
+def mpsum_scatter(x, mesh: Mesh, *, op: str = "mpsum_scatter"):
+    """Reduce-scatter over the ``model`` axis: each model shard keeps its
+    ``1/m`` slice of the full sum (axis 0 tiled) — the gradient shape:
+    every shard computes a full-width partial, each keeps its own coef
+    slice. Identity when the model axis is absent or size 1; same ledger
+    model as :func:`mpsum` (the combining bytes are identical; scatter
+    changes the LOWERING, not the logical count)."""
+    if n_model_shards(mesh) <= 1:
+        return x
+    record_axis_collective(op, mesh, MODEL_AXIS, _local_nbytes(x))
+    return lax.psum_scatter(x, MODEL_AXIS, tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# model-axis metering scope for GSPMD-implicit feature collectives
+# ---------------------------------------------------------------------------
+
+_model_scope = threading.local()
+
+
+@contextlib.contextmanager
+def model_metered(mesh: Optional[Mesh]):
+    """Meter the GSPMD-implicit feature-axis collectives of plain-jit
+    programs traced in this dynamic scope.
+
+    The shard_map solvers call :func:`mpsum`/:func:`mpgather` explicitly,
+    so their model-axis traffic records at the call site. The jit-compiled
+    solvers (newton/lbfgs/…, the PCA fit program) never name mesh axes —
+    GSPMD inserts the d-axis collectives from the input shardings — so
+    their contraction seams (``_data_matvec``/``_data_pullback``/
+    ``_weighted_gram``, the PCA/tsqr column gathers) instead call
+    :func:`record_model_collective`, which records the ANALYTIC bytes of
+    the collective GSPMD must insert, but only inside this scope and only
+    when ``mesh`` actually has a model axis of size > 1. Recording happens
+    at trace time like every other ledger site: cache hits record nothing,
+    preserving zero-steady-state-compiles ⟺ zero-ledger-growth."""
+    active = mesh if (mesh is not None and n_model_shards(mesh) > 1) else None
+    prev = getattr(_model_scope, "mesh", None)
+    _model_scope.mesh = active
+    try:
+        yield
+    finally:
+        _model_scope.mesh = prev
+
+
+def model_metered_mesh() -> Optional[Mesh]:
+    """The mesh of the innermost active :func:`model_metered` scope (None
+    outside any scope, or when the scope's mesh has no model axis)."""
+    return getattr(_model_scope, "mesh", None)
+
+
+def record_model_collective(op: str, shape, dtype) -> None:
+    """Record one feature-axis collective of a GLOBAL ``(shape, dtype)``
+    operand under the active :func:`model_metered` scope: ``(m-1)*B`` total
+    on the ``model`` ledger axis (the per-group slices of a model-sharded
+    result, summed over the data groups, telescope back to the full operand
+    bytes). No-op outside a scope — direct core-solver calls and every
+    data-parallel fit record nothing, so existing ledger pins see no new
+    entries."""
+    mesh = model_metered_mesh()
+    if mesh is None:
+        return
+    m = n_model_shards(mesh)
+    nbytes = int(np.prod(shape, dtype=np.int64)) \
+        * int(jax.numpy.dtype(dtype).itemsize)
+    _ledger.record(op, MODEL_AXIS, (m - 1) * nbytes)
+
+
+__all__ += ["model_metered_mesh"]
+
 # re-exported for consumers that already import hierarchy
-__all__ += ["data_axes", "data_pspec", "is_hierarchical", "n_data_shards"]
+__all__ += ["data_axes", "data_pspec", "is_hierarchical", "n_data_shards",
+            "n_model_shards"]

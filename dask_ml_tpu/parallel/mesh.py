@@ -168,8 +168,10 @@ def make_2d_mesh(
 
 
 def is_hierarchical(mesh: Optional[Mesh] = None) -> bool:
-    """True for a two-level ``('pod', 'chip')`` mesh
-    (:func:`dask_ml_tpu.parallel.hierarchy.make_hierarchical_mesh`)."""
+    """True for a mesh with the two-level ``('pod', 'chip')`` sample axes
+    (:func:`dask_ml_tpu.parallel.hierarchy.make_hierarchical_mesh`) —
+    including the 3-axis ``('pod', 'chip', 'model')`` feature-parallel
+    variant, whose SAMPLE axis still shards over (pod, chip)."""
     mesh = mesh or default_mesh()
     return POD_AXIS in mesh.axis_names and CHIP_AXIS in mesh.axis_names
 
@@ -215,15 +217,34 @@ def data_sharding(mesh: Optional[Mesh] = None, ndim: int = 2) -> NamedSharding:
     return NamedSharding(mesh, data_pspec(mesh, ndim=ndim))
 
 
-def feature_sharding(mesh: Optional[Mesh] = None, ndim: int = 2) -> NamedSharding:
-    """Both-axes sharding for (n, d) data on a 2-D mesh:
-    ``P('data', 'model')`` (or ``P('model')`` for per-feature vectors)."""
+def has_model_axis(mesh: Optional[Mesh] = None) -> bool:
+    """True when ``mesh`` carries a feature-parallel ``model`` axis of size
+    > 1 — a 2-D ``('data', 'model')`` mesh or the 3-axis
+    ``('pod', 'chip', 'model')`` hierarchical mesh."""
+    mesh = mesh or default_mesh()
+    return n_model_shards(mesh) > 1
+
+
+def feature_pspec(mesh: Optional[Mesh] = None, ndim: int = 2) -> PartitionSpec:
+    """The feature-sharded PartitionSpec for ``mesh``: rows over the data
+    axes (``'data'``, or ``('pod', 'chip')`` on a hierarchical mesh — same
+    rule as :func:`data_pspec`), columns over ``'model'``. ``ndim=1`` is the
+    per-feature-vector case (coef slices, per-column stats): ``P('model')``.
+    """
     mesh = mesh or default_mesh()
     if ndim == 1:
-        return NamedSharding(mesh, PartitionSpec(MODEL_AXIS))
-    return NamedSharding(
-        mesh, PartitionSpec(DATA_AXIS, MODEL_AXIS, *([None] * (ndim - 2)))
-    )
+        return PartitionSpec(MODEL_AXIS)
+    axes = data_axes(mesh)
+    first = axes[0] if len(axes) == 1 else axes
+    return PartitionSpec(first, MODEL_AXIS, *([None] * (ndim - 2)))
+
+
+def feature_sharding(mesh: Optional[Mesh] = None, ndim: int = 2) -> NamedSharding:
+    """Both-axes sharding for (n, d) data on a mesh with a ``model`` axis:
+    ``P('data', 'model')`` flat, ``P(('pod', 'chip'), 'model')`` on the
+    3-axis hierarchical mesh (or ``P('model')`` for per-feature vectors)."""
+    mesh = mesh or default_mesh()
+    return NamedSharding(mesh, feature_pspec(mesh, ndim=ndim))
 
 
 def replicated_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:

@@ -188,6 +188,28 @@ def bucket_nnz(k: int, min_slots: int = 1, record: bool = True) -> int:
     return bucket
 
 
+def bucket_cols(d: int, align: int = 1, record: bool = True) -> int:
+    """Padded FEATURE count for ``d`` true columns on a mesh whose model
+    axis has ``align`` shards: plain align-rounding, deliberately WITHOUT
+    the row policy's waste-capped bucketing. Fitted-state shapes (coef
+    vectors, components, Hessian blocks) follow the padded width, so
+    bucketing d would let nearby feature counts silently change the shape
+    of returned model state; columns only ever pad to the exact model
+    multiple. ``record=True`` notes the pair into
+    ``compile_stats()['col_buckets']`` so the compile census shows which
+    feature paddings actually staged; size queries pass ``record=False``.
+    """
+    d = int(d)
+    if d < 0:
+        raise ValueError(f"d must be >= 0, got {d}")
+    align = max(int(align), 1)
+    padded = -(-d // align) * align
+    if record:
+        with _stats_lock:
+            _col_buckets.setdefault(int(padded), set()).add(d)
+    return padded
+
+
 def pad_tail(arrays: Sequence[np.ndarray], rows: int) -> tuple:
     """Zero-pad every array of a block tuple along axis 0 up to ``rows``.
 
@@ -236,6 +258,8 @@ _stats = {
 _buckets: dict = {}
 # padded ELL width -> set of distinct true max-row-nnz values staged into it
 _nnz_buckets: dict = {}
+# padded feature count -> set of distinct true column counts staged into it
+_col_buckets: dict = {}
 _listeners_installed = False
 
 
@@ -321,6 +345,8 @@ def compile_stats() -> dict:
         out["shape_buckets"] = {k: sorted(v) for k, v in _buckets.items()}
         out["nnz_buckets"] = {k: sorted(v)
                               for k, v in _nnz_buckets.items()}
+        out["col_buckets"] = {k: sorted(v)
+                              for k, v in _col_buckets.items()}
     return out
 
 
@@ -333,10 +359,13 @@ def reset_compile_stats() -> dict:
         out["shape_buckets"] = {k: sorted(v) for k, v in _buckets.items()}
         out["nnz_buckets"] = {k: sorted(v)
                               for k, v in _nnz_buckets.items()}
+        out["col_buckets"] = {k: sorted(v)
+                              for k, v in _col_buckets.items()}
         _stats.update(n_compiles=0, compile_seconds=0.0,
                       n_traces=0, trace_seconds=0.0)
         _buckets.clear()
         _nnz_buckets.clear()
+        _col_buckets.clear()
     return out
 
 

@@ -322,18 +322,37 @@ def shard_2d(
     cores need no extra masking for them (their coefficients stay 0 under
     any ridge/prox that fixes 0 at 0; callers slice results back to
     ``d_valid``).
+
+    Host inputs stage through the same no-compile path as
+    :func:`shard_rows`: cast + zero-pad in numpy, one sharded device_put.
+    The padded width routes through ``shapes.bucket_cols`` (a plain
+    model-multiple round-up; recorded into ``compile_stats()['col_buckets']``
+    so width-padding decisions are observable next to the row buckets).
     """
+    from dask_ml_tpu.parallel import shapes
+
     mesh = mesh or mesh_lib.default_mesh()
-    x = jnp.asarray(x, dtype=dtype)
+    on_host = not isinstance(x, jax.Array)
+    if on_host:
+        x = np.asarray(x)
+        if dtype is not None and x.dtype != np.dtype(dtype):
+            x = x.astype(dtype)
+    else:
+        x = jnp.asarray(x, dtype=dtype)
     n, d = int(x.shape[0]), int(x.shape[1])
     # sample axis takes the shape bucket (same rule as shard_rows: weight-0
     # rows are inert); the feature axis keeps exact model-multiple padding —
-    # fitted-state shapes follow d, and only cores written for padded
-    # features enable this path at all (see prepare_data)
+    # fitted-state shapes follow the padded width, and only cores written
+    # for padded features enable this path at all (see prepare_data)
     pad_n = _padded_rows(n, mesh) - n
-    pad_d = pad_rows(d, mesh_lib.n_model_shards(mesh))
+    pad_d = shapes.bucket_cols(d, mesh_lib.n_model_shards(mesh)) - d
     if pad_n or pad_d:
-        x = jnp.pad(x, [(0, pad_n), (0, pad_d)])
+        if on_host:
+            padded = np.zeros((n + pad_n, d + pad_d), x.dtype)
+            padded[:n, :d] = x
+            x = padded
+        else:
+            x = jnp.pad(x, [(0, pad_n), (0, pad_d)])
     return jax.device_put(x, mesh_lib.feature_sharding(mesh)), n, d
 
 

@@ -616,6 +616,8 @@ def telemetry_report() -> dict:
         str(k): v for k, v in compile_["shape_buckets"].items()}
     compile_["nnz_buckets"] = {
         str(k): v for k, v in compile_.get("nnz_buckets", {}).items()}
+    compile_["col_buckets"] = {
+        str(k): v for k, v in compile_.get("col_buckets", {}).items()}
     with _lock:
         n_recorded, n_dropped, cap = len(_ring), _dropped, _ring.maxlen
     return {

@@ -67,6 +67,12 @@ SECTIONS = [
      "custom VJP, and the per-trace collective metering scope — see "
      "docs/sparse.md for the layout, bucketing, wire format, and when "
      "sparse wins."),
+    ("dask_ml_tpu.parallel.decisions", "Measured autotuner decisions",
+     "The persisted side of kernel auto-dispatch: bench-measured "
+     "per-(rule, backend) verdicts in a committed JSON cache, consulted "
+     "point-wise (narrow match ranges) by the dispatch predicates "
+     "before their hand-written cold-start inequalities — see "
+     "docs/kernels.md and the DASK_ML_TPU_DECISIONS override."),
     ("dask_ml_tpu.parallel.precision", "Mixed precision",
      "The bf16-wire/bf16-compute/f32-accumulation execution policy "
      "(storage, compute, and accumulation dtypes plus per-op overrides), "
@@ -132,15 +138,19 @@ SECTIONS = [
      "(encode_payload/decode_payload): a capped JSON control envelope "
      "with dtype/shape-tagged numpy buffers, no object deserialization "
      "anywhere."),
-    ("dask_ml_tpu.parallel.hierarchy", "Two-level mesh scale-out",
-     "The (pod, chip) hierarchical mesh and its communication-avoiding "
-     "collective family: hpsum/hpmean/hpsum_scatter lower every hot "
-     "sample-axis reduction as reduce-within-pod (ICI) then across pods "
-     "(DCN) — bit-identical to the flat mesh in the degenerate n_pods=1 "
-     "case — with per-axis logical combining bytes recorded in the "
-     "traffic ledger and mirrored to telemetry as collective.bytes/"
-     "collective.calls; see docs/scale-out.md for the mesh anatomy, "
-     "which reductions are hierarchical, and how to read the MULTICHIP "
+    ("dask_ml_tpu.parallel.hierarchy", "Hierarchical mesh scale-out",
+     "The (pod, chip) hierarchical mesh — optionally with a third "
+     "innermost 'model' axis for feature parallelism — and its "
+     "communication-avoiding collective families: hpsum/hpmean/"
+     "hpsum_scatter lower every hot sample-axis reduction as "
+     "reduce-within-pod (ICI) then across pods (DCN), and mpsum/"
+     "mpgather/mpsum_scatter are the feature-axis family (identity on "
+     "meshes whose model axis is absent or size 1) — bit-identical to "
+     "the flat mesh in the degenerate cases — with per-axis logical "
+     "combining bytes recorded in the traffic ledger and mirrored to "
+     "telemetry as collective.bytes/collective.calls; see "
+     "docs/scale-out.md for the mesh anatomy, which reductions are "
+     "hierarchical, the model axis, and how to read the MULTICHIP "
      "numbers."),
     ("dask_ml_tpu.parallel.elastic", "Elastic data plane",
      "Multi-host sharded ingestion for the streamed tier: the seeded "
@@ -185,6 +195,8 @@ EXTRA = {
     ],
     "dask_ml_tpu.parallel.hierarchy": [
         "make_hierarchical_mesh", "hpsum", "hpmean", "hpsum_scatter",
+        "mpsum", "mpgather", "mpsum_scatter", "model_metered",
+        "record_model_collective", "record_axis_collective",
         "TrafficLedger", "ledger", "ledger_snapshot", "reset_ledger",
         "collective_bytes", "record_collective",
     ],

@@ -1,0 +1,146 @@
+"""The measured autotuner decision cache (parallel/decisions.py).
+
+Match semantics, dynamic backend gating, the ``DASK_ML_TPU_DECISIONS``
+override, the record→save→reload round trip, and the integration contract:
+a cached verdict overrides a dispatch predicate's hand-written fallback
+point-wise, and cold-start (no cache / no matching entry) IS the fallback.
+"""
+
+import json
+from unittest import mock
+
+import pytest
+
+import jax
+
+from dask_ml_tpu.parallel import decisions
+
+
+@pytest.fixture
+def scratch_cache(tmp_path, monkeypatch):
+    """Point the loader at a per-test cache file; reload the committed one
+    afterwards so the pinned dispatch-rule tests keep seeing it."""
+    path = tmp_path / "decisions.json"
+    monkeypatch.setenv("DASK_ML_TPU_DECISIONS", str(path))
+    decisions.reset_cache()
+    yield path
+    decisions.reset_cache()
+
+
+def _write(path, entries):
+    path.write_text(json.dumps({"entries": entries}))
+    decisions.reset_cache()
+
+
+def _entry(rule="r", backend=None, match=None, verdict=True, **kw):
+    e = {"rule": rule, "backend": backend or jax.default_backend(),
+         "match": match or {}, "verdict": verdict}
+    e.update(kw)
+    return e
+
+
+def test_matches_semantics():
+    assert decisions._matches([4, 8], 4) and decisions._matches([4, 8], 8)
+    assert decisions._matches([4, 8], 6.5)
+    assert not decisions._matches([4, 8], 3)
+    assert not decisions._matches([4, 8], 9)
+    assert not decisions._matches([4, 8, 12], 6)  # malformed range
+    assert decisions._matches("float32", "float32")
+    assert not decisions._matches("float32", "bfloat16")
+    assert decisions._matches(16, 16.0)  # numeric equality across types
+    assert not decisions._matches(16, 17)
+    assert not decisions._matches([4, 8], "not-a-number")
+
+
+def test_lookup_falls_back_without_cache(scratch_cache):
+    # the env-pointed file does not exist: cold start
+    assert decisions.entries() == []
+    assert decisions.lookup("any.rule", {"n": 1}, fallback=True) is True
+    assert decisions.lookup("any.rule", {"n": 1}, fallback=False) is False
+
+
+def test_lookup_matches_and_falls_back(scratch_cache):
+    _write(scratch_cache, [
+        _entry(rule="sparse.spmv.pallas",
+               match={"n": [2048, 8192], "dtype": "float32"}, verdict=True),
+    ])
+    hit = dict(n=4096, dtype="float32")
+    assert decisions.lookup("sparse.spmv.pallas", hit, fallback=False) is True
+    # out of range / wrong dtype / missing key / other rule → fallback
+    assert decisions.lookup("sparse.spmv.pallas",
+                            dict(n=100000, dtype="float32"),
+                            fallback=False) is False
+    assert decisions.lookup("sparse.spmv.pallas",
+                            dict(n=4096, dtype="bfloat16"),
+                            fallback=False) is False
+    assert decisions.lookup("sparse.spmv.pallas", dict(n=4096),
+                            fallback=False) is False
+    assert decisions.lookup("other.rule", hit, fallback=True) is True
+
+
+def test_lookup_first_matching_entry_wins(scratch_cache):
+    _write(scratch_cache, [
+        _entry(match={"n": [0, 100]}, verdict=False),
+        _entry(match={"n": [0, 1000]}, verdict=True),
+    ])
+    assert decisions.lookup("r", {"n": 50}, fallback=True) is False
+    assert decisions.lookup("r", {"n": 500}, fallback=False) is True
+
+
+def test_lookup_backend_gated_dynamically(scratch_cache):
+    """Entries from another backend never apply — and the backend is read
+    at CALL time, so a mocked backend sees its own entries."""
+    _write(scratch_cache, [
+        _entry(backend="tpu", match={"k": 16}, verdict=True),
+    ])
+    assert decisions.lookup("r", {"k": 16}, fallback=False) is False
+    with mock.patch.object(jax, "default_backend", return_value="tpu"):
+        assert decisions.lookup("r", {"k": 16}, fallback=False) is True
+
+
+def test_record_save_reload_round_trip(scratch_cache):
+    e = decisions.record("bench.rule", {"n": [512, 2048]}, True,
+                         measured={"xla_ms": 2.0, "pallas_ms": 1.0})
+    assert e["backend"] == jax.default_backend()
+    assert decisions.lookup("bench.rule", {"n": 1024}, fallback=False) is True
+    path = decisions.save()
+    assert path == str(scratch_cache)
+    # a fresh load from disk sees the persisted entry
+    decisions.reset_cache()
+    assert decisions.entries() == [e]
+    assert decisions.lookup("bench.rule", {"n": 1024}, fallback=False) is True
+    payload = json.loads(scratch_cache.read_text())
+    assert payload["entries"][0]["measured"]["pallas_ms"] == 1.0
+
+
+def test_missing_or_corrupt_cache_is_cold_start(scratch_cache):
+    scratch_cache.write_text("{not json")
+    decisions.reset_cache()
+    assert decisions.entries() == []
+    assert decisions.lookup("r", {}, fallback=True) is True
+
+
+def test_dispatch_rule_overridden_pointwise(scratch_cache):
+    """Integration: a cached verdict flips ``_bounded_auto_wins`` exactly at
+    the measured point while the hand-written inequality keeps answering
+    everywhere else (narrow-range discipline)."""
+    from dask_ml_tpu.models.kmeans import _bounded_auto_wins
+
+    # cold start: the inequality (n >= 2^16 and k >= 4)
+    assert _bounded_auto_wins(1 << 20, 8, 24) is True
+    assert _bounded_auto_wins(1 << 10, 8, 24) is False
+
+    _write(scratch_cache, [
+        _entry(rule="kmeans.lloyd.bounded",
+               match={"n": [24000, 44000], "k": [6, 12], "d": [16, 32]},
+               verdict=True),
+        _entry(rule="kmeans.lloyd.bounded",
+               match={"n": [500000, 2000000], "k": [6, 12], "d": [16, 32]},
+               verdict=False),
+    ])
+    # measured point: overrides the inequality in BOTH directions
+    assert _bounded_auto_wins(32768, 8, 24) is True
+    assert _bounded_auto_wins(1 << 20, 8, 24) is False
+    # outside every bracket: still the inequality
+    assert _bounded_auto_wins(1 << 10, 8, 24) is False
+    assert _bounded_auto_wins(1 << 18, 8, 24) is True

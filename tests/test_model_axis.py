@@ -8,14 +8,17 @@ over the model axis and inserts the d-axis collectives itself. The contract
 pinned down: a d-sharded fit matches the 1-D data-parallel result.
 """
 
+from functools import partial
+
 import numpy as np
 import pytest
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from dask_ml_tpu.linear_model import LinearRegression, LogisticRegression
+from dask_ml_tpu.parallel import hierarchy as hier
 from dask_ml_tpu.parallel import mesh as mesh_lib
 from dask_ml_tpu.parallel.sharding import prepare_data, shard_2d
 
@@ -231,3 +234,376 @@ def test_facade_2d_admm_falls_back_to_data_parallel(mesh2d):
         est = LogisticRegression(solver="admm", C=1.0, max_iter=50).fit(X, y)
     assert est.coef_.shape == (6,)
     assert est.score(X, y) > 0.8
+
+
+# ===========================================================================
+# 3-axis ('pod', 'chip', 'model') meshes: the feature axis on top of the
+# hierarchical sample axes (docs/scale-out.md "The model axis")
+# ===========================================================================
+
+
+@pytest.fixture(params=[(2, 2, 2), (1, 2, 4)], ids=["mesh2x2x2", "mesh1x2x4"])
+def mesh3d(request):
+    p, c, m = request.param
+    return hier.make_hierarchical_mesh(p, c, model_parallel=m)
+
+
+def _mesh_pc1():
+    """An EXPLICIT size-1 model axis — the other degenerate layout (the
+    constructor's own ``model_parallel=1`` never builds a third axis)."""
+    devs = jax.devices()[:8]
+    return Mesh(np.asarray(devs, dtype=object).reshape(2, 4, 1),
+                (mesh_lib.POD_AXIS, mesh_lib.CHIP_AXIS, mesh_lib.MODEL_AXIS))
+
+
+def test_make_hierarchical_mesh_model_axis():
+    m3 = hier.make_hierarchical_mesh(2, 2, model_parallel=2)
+    assert m3.axis_names == ("pod", "chip", "model")
+    assert dict(m3.shape) == {"pod": 2, "chip": 2, "model": 2}
+    assert mesh_lib.is_hierarchical(m3)
+    assert mesh_lib.has_model_axis(m3)
+    assert mesh_lib.n_model_shards(m3) == 2
+    assert mesh_lib.n_data_shards(m3) == 4
+    assert mesh_lib.data_axes(m3) == ("pod", "chip")
+    assert mesh_lib.feature_pspec(m3) == P(("pod", "chip"), "model")
+    assert mesh_lib.feature_pspec(m3, ndim=1) == P("model")
+    # model_parallel=1 is STRUCTURALLY the 2-axis mesh: no third axis at all
+    m2 = hier.make_hierarchical_mesh(2, 4, model_parallel=1)
+    assert m2.axis_names == ("pod", "chip")
+    assert not mesh_lib.has_model_axis(m2)
+    # chips_per_pod auto-factors around the model axis
+    ma = hier.make_hierarchical_mesh(2, model_parallel=2)
+    assert dict(ma.shape) == {"pod": 2, "chip": 2, "model": 2}
+
+
+def test_collective_bytes_model_multiplier(mesh3d):
+    """A sample-axis reduction on a 3-axis mesh runs one group per model
+    coordinate: every 2-axis term multiplies by m."""
+    p = mesh3d.shape["pod"]
+    c = mesh3d.shape["chip"]
+    m = mesh3d.shape["model"]
+    B = 400
+    assert hier.collective_bytes(mesh3d, B) == {
+        "chip": m * p * (c - 1) * B, "pod": m * (p - 1) * B}
+
+
+def test_collective_bytes_size1_model_matches_2axis():
+    m2 = hier.make_hierarchical_mesh(2, 4)
+    assert hier.collective_bytes(_mesh_pc1(), 112) \
+        == hier.collective_bytes(m2, 112)
+
+
+def test_shard_2d_3axis_feature_sharding(mesh3d):
+    from dask_ml_tpu.parallel.shapes import compile_stats
+
+    X = np.arange(37 * 10, dtype=np.float32).reshape(37, 10)
+    Xs, n, d = shard_2d(X, mesh=mesh3d)
+    m = mesh3d.shape["model"]
+    assert (n, d) == (37, 10)
+    assert Xs.sharding.spec == P(("pod", "chip"), "model")
+    assert Xs.shape[1] == -(-10 // m) * m  # exact model multiple, unbucketed
+    np.testing.assert_array_equal(np.asarray(Xs)[:37, :10], X)
+    assert float(np.abs(np.asarray(Xs)[37:, :]).sum()) == 0.0
+    assert float(np.abs(np.asarray(Xs)[:, 10:]).sum()) == 0.0
+    assert 10 in compile_stats()["col_buckets"][int(Xs.shape[1])]
+
+
+# ---------------------------------------------------------------------------
+# the mpsum/mpgather/mpsum_scatter collective family + its ledger model
+# ---------------------------------------------------------------------------
+
+
+def test_model_collectives_values_and_ledger(mesh3d):
+    m = mesh3d.shape["model"]
+    shards = mesh3d.shape["pod"] * mesh3d.shape["chip"]
+    x = np.arange(8 * m, dtype=np.float32)
+
+    hier.reset_ledger()
+    f_sum = mesh_lib.shard_map(
+        lambda xs: hier.mpsum(jnp.sum(xs), mesh3d, op="t.sum"),
+        mesh=mesh3d, in_specs=(P("model"),), out_specs=P())
+    total = jax.jit(f_sum)(jnp.asarray(x))
+    assert float(total) == pytest.approx(float(x.sum()))
+
+    f_gather = mesh_lib.shard_map(
+        lambda xs: hier.mpgather(xs, mesh3d, op="t.gather"),
+        mesh=mesh3d, in_specs=(P("model"),), out_specs=P())
+    full = jax.jit(f_gather)(jnp.asarray(x))
+    np.testing.assert_array_equal(np.asarray(full), x)
+
+    # each shard contributes an m-fold tile of its slice; the reduce-scatter
+    # leaves every model shard holding the cross-shard slice sum
+    f_scatter = mesh_lib.shard_map(
+        lambda xs: hier.mpsum_scatter(jnp.tile(xs, m), mesh3d,
+                                      op="t.scatter"),
+        mesh=mesh3d, in_specs=(P("model"),), out_specs=P("model"))
+    scat = jax.jit(f_scatter)(jnp.asarray(x))
+    expect = np.tile(x.reshape(m, 8).sum(axis=0), m)
+    np.testing.assert_array_equal(np.asarray(scat), expect)
+
+    # ledger: (m-1) * local operand bytes, one group per DATA coordinate,
+    # recorded once per trace
+    snap = hier.ledger_snapshot()
+    assert snap["ops"]["t.sum"] == {"model": shards * (m - 1) * 4}
+    assert snap["ops"]["t.gather"] == {"model": shards * (m - 1) * 8 * 4}
+    assert snap["ops"]["t.scatter"] == {"model": shards * (m - 1) * 8 * m * 4}
+    assert snap["calls"]["model/t.sum"] == 1
+    assert snap["calls"]["model/t.gather"] == 1
+    assert snap["calls"]["model/t.scatter"] == 1
+
+
+def test_model_collectives_identity_on_size1_model():
+    """On any mesh whose model axis is absent or size 1 the family is an
+    identity — no collective, no ledger entry (the zero-collective pin)."""
+    x = jnp.arange(8.0)
+    for mesh in (mesh_lib.make_mesh(), hier.make_hierarchical_mesh(2, 4),
+                 _mesh_pc1()):
+        hier.reset_ledger()
+        np.testing.assert_array_equal(np.asarray(hier.mpsum(x, mesh)),
+                                      np.asarray(x))
+        np.testing.assert_array_equal(np.asarray(hier.mpgather(x, mesh)),
+                                      np.asarray(x))
+        np.testing.assert_array_equal(np.asarray(hier.mpsum_scatter(x, mesh)),
+                                      np.asarray(x))
+        assert hier.ledger_snapshot()["bytes"] == {}
+
+
+def test_model_metered_seam_bytes(mesh3d):
+    """The GSPMD contraction seams record their analytic model-axis bytes —
+    (m-1)·B of the global operand — only inside a model_metered scope."""
+    from dask_ml_tpu.models import glm as core
+
+    m = mesh3d.shape["model"]
+    X = jnp.ones((32, 8), jnp.float32)
+    v = jnp.zeros((8,), jnp.float32)
+    r = jnp.zeros((32,), jnp.float32)
+    h = jnp.ones((32,), jnp.float32)
+
+    hier.reset_ledger()
+    with hier.model_metered(mesh3d):
+        core._data_matvec(X, v)
+        core._data_pullback(X, r)
+        core._weighted_gram(X, h)
+    snap = hier.ledger_snapshot()
+    assert snap["ops"]["glm.matvec"] == {"model": (m - 1) * 32 * 4}
+    assert snap["ops"]["glm.pullback"] == {"model": (m - 1) * 8 * 4}
+    assert snap["ops"]["glm.gram.gather"] == {"model": (m - 1) * 8 * 8 * 4}
+
+    # outside a scope — and under a scope whose mesh has no model axis —
+    # the seams record nothing
+    for ctx in (None, mesh_lib.make_mesh(), hier.make_hierarchical_mesh(2, 4)):
+        hier.reset_ledger()
+        if ctx is None:
+            core._data_matvec(X, v)
+        else:
+            with hier.model_metered(ctx):
+                core._data_matvec(X, v)
+        assert hier.ledger_snapshot()["bytes"] == {}
+
+
+# ---------------------------------------------------------------------------
+# core + facade solvers on the 3-axis mesh
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("solver", ["newton", "lbfgs"])
+def test_core_solver_3axis_matches_flat(mesh3d, solver):
+    from dask_ml_tpu.models import glm as core
+
+    X, y = _problem(n=240, d=12)
+    kw = dict(family="logistic", regularizer="l2", lamduh=0.1, tol=1e-6,
+              max_iter=50)
+
+    data1 = prepare_data(X, y=y.astype(np.float32), mesh=mesh_lib.make_mesh())
+    beta0 = jnp.zeros((12,), jnp.float32)
+    mask = jnp.ones((12,), jnp.float32)
+    fn = core.newton if solver == "newton" else core.lbfgs
+    beta1, _ = fn(data1.X, data1.y, data1.weights, beta0, mask, **kw)
+
+    data3 = prepare_data(X, y=y.astype(np.float32), mesh=mesh3d,
+                         shard_features=True)
+    assert data3.X.sharding.spec == P(("pod", "chip"), "model")
+    d_pad = int(data3.X.shape[1])
+    beta0p = jnp.zeros((d_pad,), jnp.float32)
+    maskp = jnp.zeros((d_pad,), jnp.float32).at[:12].set(1.0)
+    beta3, _ = fn(data3.X, data3.y, data3.weights, beta0p, maskp, **kw)
+
+    np.testing.assert_allclose(np.asarray(beta3)[:12], np.asarray(beta1),
+                               rtol=2e-3, atol=2e-4)
+    assert float(np.abs(np.asarray(beta3)[12:]).max(initial=0.0)) < 1e-6
+
+
+def test_core_newton_3axis_hessian_is_model_sharded(mesh3d):
+    X, _ = _problem(n=240, d=16)
+    data = prepare_data(X, mesh=mesh3d, shard_features=True)
+
+    @jax.jit
+    def hessian(Xs):
+        return Xs.T @ Xs
+
+    H = hessian(data.X)
+    assert "model" in str(H.sharding.spec)
+
+
+def test_facade_3axis_matches_flat_with_model_ledger(mesh3d):
+    """Facade LR on a 3-axis mesh: matches the flat fit, its feature-axis
+    collectives land on the 'model' ledger axis ONLY with the analytic
+    (m-1)·B bytes, and an identical refit is zero compiles AND zero ledger
+    growth (per-trace recording ⟺ the compile-once discipline).
+
+    d is chosen so the model-padded width (14 on m=2, 16 on m=4) differs
+    from the flat fit's 13 columns: recording is per-TRACE, so a model-mesh
+    fit whose avals exactly match an already-traced flat program would hit
+    the trace cache and (correctly — nothing new compiles) record nothing."""
+    from dask_ml_tpu.parallel.shapes import track_compiles
+
+    X, y = _problem(n=320, d=12, seed=7)
+    kw = dict(solver="newton", C=2.0, max_iter=40, tol=1e-6)
+    ref = LogisticRegression(**kw)
+    with mesh_lib.use_mesh(mesh_lib.make_mesh()):
+        ref.fit(X, y)
+
+    m = mesh3d.shape["model"]
+    hier.reset_ledger()
+    tp = LogisticRegression(**kw)
+    with mesh_lib.use_mesh(mesh3d):
+        tp.fit(X, y)
+    np.testing.assert_allclose(tp.coef_, ref.coef_, rtol=5e-3, atol=5e-4)
+    np.testing.assert_allclose(tp.intercept_, ref.intercept_,
+                               rtol=5e-3, atol=5e-4)
+
+    snap = hier.ledger_snapshot()
+    with mesh_lib.use_mesh(mesh3d):
+        dd = prepare_data(X, y=y.astype(np.float32), mesh=mesh3d,
+                          shard_features=True, append_ones=True)
+    n_pad, d_pad = int(dd.X.shape[0]), int(dd.X.shape[1])
+    for op in ("glm.matvec", "glm.gram.gather"):
+        assert set(snap["ops"][op]) == {"model"}, op
+    assert snap["ops"]["glm.matvec"]["model"] \
+        == snap["calls"]["model/glm.matvec"] * (m - 1) * n_pad * 4
+    assert snap["ops"]["glm.gram.gather"]["model"] \
+        == snap["calls"]["model/glm.gram.gather"] * (m - 1) * d_pad * d_pad * 4
+
+    hier.reset_ledger()
+    with mesh_lib.use_mesh(mesh3d), track_compiles() as tc:
+        LogisticRegression(**kw).fit(X, y)
+    assert tc["n_compiles"] == 0
+    assert hier.ledger_snapshot()["bytes"] == {}
+
+
+def test_facade_3axis_pca_matches_flat(mesh3d):
+    from dask_ml_tpu.decomposition import PCA
+
+    rng = np.random.RandomState(11)
+    X = (rng.randn(256, 8) @ np.diag(np.linspace(2, 0.3, 8))).astype(
+        np.float32)
+    with mesh_lib.use_mesh(mesh_lib.make_mesh()):
+        ref = PCA(n_components=3, svd_solver="tsqr").fit(X)
+    hier.reset_ledger()
+    with mesh_lib.use_mesh(mesh3d):
+        tp = PCA(n_components=3, svd_solver="tsqr").fit(X)
+    np.testing.assert_allclose(tp.explained_variance_,
+                               ref.explained_variance_, rtol=1e-3)
+    np.testing.assert_allclose(np.abs(tp.components_),
+                               np.abs(ref.components_), rtol=1e-2, atol=1e-3)
+
+    # both PCA gathers meter on the model axis only; the column gather moves
+    # the full padded (n, d) operand once per model peer
+    snap = hier.ledger_snapshot()
+    for op in ("pca.colgather", "pca.components.gather"):
+        assert set(snap["ops"][op]) == {"model"}, op
+    m = mesh3d.shape["model"]
+    with mesh_lib.use_mesh(mesh3d):
+        n_pad = int(prepare_data(X, mesh=mesh3d,
+                                 shard_features=True).X.shape[0])
+    assert snap["ops"]["pca.colgather"]["model"] \
+        == snap["calls"]["model/pca.colgather"] * (m - 1) * n_pad * 8 * 4
+    assert snap["ops"]["pca.components.gather"]["model"] > 0
+
+    with mesh_lib.use_mesh(mesh3d):
+        Xt = tp.transform(X[:16])
+    assert Xt.shape == (16, 3)
+
+
+# ---------------------------------------------------------------------------
+# feature-parallel KMeans (centers as P(None, 'model') column slices)
+# ---------------------------------------------------------------------------
+
+
+def _blobs(rng, n_per=64, k=4, d=8):
+    cents = (rng.randn(k, d) * 4).astype(np.float32)
+    X = np.concatenate([cents[i] + 0.3 * rng.randn(n_per, d)
+                        for i in range(k)]).astype(np.float32)
+    c0 = jnp.asarray(X[::n_per][:k])
+    return X, c0
+
+
+def test_kmeans_feature_parallel_lloyd(mesh3d):
+    from dask_ml_tpu.models import kmeans as km
+
+    rng = np.random.RandomState(5)
+    k, d = 4, 8
+    X, c0 = _blobs(rng, k=k, d=d)
+    tol0 = jnp.asarray(0.0, jnp.float32)
+
+    mf = mesh_lib.make_mesh()
+    df = prepare_data(X, mesh=mf)
+    ref = km.lloyd_loop_fused(df.X, df.weights, c0, tol0, mesh=mf, max_iter=6)
+
+    m = mesh3d.shape["model"]
+    p, c = mesh3d.shape["pod"], mesh3d.shape["chip"]
+    shards = p * c
+    hier.reset_ledger()
+    dm = prepare_data(X, mesh=mesh3d, shard_features=True)
+    out = km.lloyd_loop_fused(dm.X, dm.weights, c0, tol0, mesh=mesh3d,
+                              max_iter=6, shard_features=True)
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(ref[0]),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(out[1]), float(ref[1]), rtol=1e-4)
+    assert int(out[2]) == int(ref[2])
+    # per-chip center state is the (k, d/m) column slice
+    assert out[0].sharding.spec == P(None, "model")
+
+    # ledger exactness: feature collectives on 'model' only, sample-axis
+    # M-step on (chip, pod) with the m-fold-SMALLER (k·d/m + k + 1) operand
+    snap = hier.ledger_snapshot()
+    n_pad = int(dm.X.shape[0])
+    for op in ("kmeans.scores", "kmeans.x2", "kmeans.shift"):
+        assert set(snap["ops"][op]) == {"model"}, op
+    assert snap["ops"]["kmeans.scores"]["model"] \
+        == snap["calls"]["model/kmeans.scores"] * (m - 1) * k * n_pad * 4
+    assert snap["ops"]["kmeans.x2"]["model"] \
+        == snap["calls"]["model/kmeans.x2"] * (m - 1) * n_pad * 4
+    assert snap["ops"]["kmeans.shift"]["model"] \
+        == snap["calls"]["model/kmeans.shift"] * shards * (m - 1) * 4
+    unit = (k * (d // m) + k + 1) * 4
+    tr = snap["calls"]["chip/kmeans.mstep"] // 3
+    assert snap["ops"]["kmeans.mstep"]["chip"] == m * p * (c - 1) * unit * tr
+    assert snap["ops"]["kmeans.mstep"].get("pod", 0) \
+        == m * (p - 1) * unit * tr
+
+    # the single-pass pallas kernel accumulates d-global state: refuses
+    with pytest.raises(ValueError, match="feature sharding"):
+        km.lloyd_loop_fused(dm.X, dm.weights, c0, tol0, mesh=mesh3d,
+                            max_iter=2, kernel="pallas", shard_features=True)
+
+
+def test_kmeans_shard_features_inert_without_model_axis():
+    """shard_features=True is bit-identical to the plain 2-axis program on
+    meshes without a real model axis — including an EXPLICIT size-1 axis."""
+    from dask_ml_tpu.models import kmeans as km
+
+    rng = np.random.RandomState(6)
+    X, c0 = _blobs(rng)
+    tol0 = jnp.asarray(0.0, jnp.float32)
+    m2 = hier.make_hierarchical_mesh(2, 4)
+
+    outs = []
+    for mesh, flag in ((m2, False), (m2, True), (_mesh_pc1(), True)):
+        data = prepare_data(X, mesh=mesh, shard_features=flag)
+        outs.append(km.lloyd_loop_fused(data.X, data.weights, c0, tol0,
+                                        mesh=mesh, max_iter=5,
+                                        shard_features=flag))
+    for other in outs[1:]:
+        assert np.array_equal(np.asarray(other[0]), np.asarray(outs[0][0]))
+        assert int(other[2]) == int(outs[0][2])

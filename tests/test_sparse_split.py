@@ -1,0 +1,152 @@
+"""Column-range splitting of blocked-ELL containers (ops/sparse.py
+split_cols/merge_cols) — the sparse leg of the feature-parallel tier.
+
+All identities here are pinned on INTEGER-valued f32 data so every
+contraction is exact arithmetic and the checks are bit-equality, not
+tolerance: ``matvec(A, v) == Σ_j matvec(B_j, v[lo_j:hi_j])``, pullbacks
+concatenate, and ``weighted_gram(B_j, h)`` is the j-th diagonal block of
+the full Gram (docs/sparse.md "Column splitting").
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dask_ml_tpu.ops import sparse
+from dask_ml_tpu.parallel import mesh as mesh_lib
+from dask_ml_tpu.parallel.sharding import shard_sparse_rows
+
+EDGES = [4, 9]
+BOUNDS = [0, 4, 9, 12]
+
+
+def _int_matrix(rng, n=16, d=12, density=0.45):
+    """Integer-valued f32 matrix with exact small-int contractions."""
+    D = rng.randint(-4, 5, size=(n, d)).astype(np.float32)
+    return D * (rng.rand(n, d) < density)
+
+
+@pytest.fixture
+def problem():
+    rng = np.random.RandomState(0)
+    D = _int_matrix(rng)
+    # ensure at least one stored nonzero per range so no block degenerates
+    D[0, 0], D[1, 5], D[2, 10] = 1.0, 2.0, 3.0
+    return D, sparse.ell_from_dense(D)
+
+
+def test_split_cols_round_trip(problem):
+    D, A = problem
+    blocks = sparse.split_cols(A, EDGES)
+    assert [b.d for b in blocks] == [4, 5, 3]
+    assert all(b.values.shape == A.values.shape for b in blocks)
+    # each block IS the dense column slice, and the merge inverts exactly
+    for b, lo, hi in zip(blocks, BOUNDS, BOUNDS[1:]):
+        np.testing.assert_array_equal(np.asarray(sparse.to_dense(b)),
+                                      D[:, lo:hi])
+    merged = sparse.merge_cols(blocks)
+    assert merged.d == A.d
+    np.testing.assert_array_equal(np.asarray(sparse.to_dense(merged)),
+                                  np.asarray(sparse.to_dense(A)))
+    # no interior edges: the trivial single-block split
+    (only,) = sparse.split_cols(A, [])
+    np.testing.assert_array_equal(np.asarray(sparse.to_dense(only)), D)
+
+
+def test_split_cols_rejects_bad_edges(problem):
+    _, A = problem
+    with pytest.raises(ValueError, match="nondecreasing"):
+        sparse.split_cols(A, [9, 4])
+    with pytest.raises(ValueError, match="nondecreasing"):
+        sparse.split_cols(A, [4, 20])
+    with pytest.raises(ValueError, match="nondecreasing"):
+        sparse.split_cols(A, [-1, 4])
+    with pytest.raises(ValueError, match="at least one block"):
+        sparse.merge_cols([])
+
+
+def test_split_blanked_slots_alias_column_zero(problem):
+    """The documented caveat: out-of-range slots blank to (col=0, value=0),
+    so a split block's RAW cols array aliases column 0 many times over —
+    but unstored slots never count as duplicates, so the quadratic-moment
+    precondition check still passes on every block."""
+    _, A = problem
+    assert not bool(sparse.has_duplicate_slots(A))
+    blocks = sparse.split_cols(A, EDGES)
+    # raw appearance: more zero column ids than the original layout held
+    assert any(int(np.sum(np.asarray(b.cols) == 0))
+               > int(np.sum(np.asarray(A.cols) == 0)) for b in blocks)
+    # semantic check: value-0 slots are unstored, never duplicates
+    assert all(not bool(sparse.has_duplicate_slots(b)) for b in blocks)
+
+
+@pytest.mark.parametrize("kernel", ["xla", "pallas"])
+def test_split_matvec_bit_identical(problem, kernel):
+    _, A = problem
+    rng = np.random.RandomState(1)
+    v = rng.randint(-3, 4, size=(A.d,)).astype(np.float32)
+    full = np.asarray(sparse.matvec(A, jnp.asarray(v), kernel=kernel))
+    acc = np.zeros_like(full)
+    for b, lo, hi in zip(sparse.split_cols(A, EDGES), BOUNDS, BOUNDS[1:]):
+        acc = acc + np.asarray(
+            sparse.matvec(b, jnp.asarray(v[lo:hi]), kernel=kernel))
+    np.testing.assert_array_equal(acc, full)
+
+
+def test_split_matmat_bit_identical(problem):
+    _, A = problem
+    rng = np.random.RandomState(2)
+    V = rng.randint(-3, 4, size=(A.d, 3)).astype(np.float32)
+    full = np.asarray(sparse.matmat(A, jnp.asarray(V)))
+    acc = np.zeros_like(full)
+    for b, lo, hi in zip(sparse.split_cols(A, EDGES), BOUNDS, BOUNDS[1:]):
+        acc = acc + np.asarray(sparse.matmat(b, jnp.asarray(V[lo:hi])))
+    np.testing.assert_array_equal(acc, full)
+
+
+def test_split_pullback_concatenates(problem):
+    _, A = problem
+    rng = np.random.RandomState(3)
+    r = rng.randint(-3, 4, size=(A.shape[0],)).astype(np.float32)
+    full = np.asarray(sparse.pullback(A, jnp.asarray(r)))
+    parts = [np.asarray(sparse.pullback(b, jnp.asarray(r)))
+             for b in sparse.split_cols(A, EDGES)]
+    np.testing.assert_array_equal(np.concatenate(parts), full)
+
+
+def test_split_weighted_gram_is_diagonal_block(problem):
+    _, A = problem
+    rng = np.random.RandomState(4)
+    h = rng.randint(0, 4, size=(A.shape[0],)).astype(np.float32)
+    G = np.asarray(sparse.weighted_gram(A, jnp.asarray(h)))
+    for b, lo, hi in zip(sparse.split_cols(A, EDGES), BOUNDS, BOUNDS[1:]):
+        np.testing.assert_array_equal(
+            np.asarray(sparse.weighted_gram(b, jnp.asarray(h))),
+            G[lo:hi, lo:hi])
+
+
+def test_split_blocks_stage_and_lower_on_mesh():
+    """Blocks survive the real staging path: shard_sparse_rows places every
+    block P('data', None) over the 8-device mesh and the sharded split
+    contraction is bit-identical to the sharded unsplit one."""
+    mesh = mesh_lib.make_mesh()
+    rng = np.random.RandomState(5)
+    D = _int_matrix(rng, n=64, d=12)
+    A = sparse.ell_from_dense(D)
+    v = rng.randint(-3, 4, size=(12,)).astype(np.float32)
+
+    sA, n = shard_sparse_rows(A, mesh=mesh)
+    assert n == 64
+    full = np.asarray(sparse.matvec(sA, jnp.asarray(v)))
+    acc = np.zeros_like(full)
+    for b, lo, hi in zip(sparse.split_cols(A, EDGES), BOUNDS, BOUNDS[1:]):
+        sB, _ = shard_sparse_rows(b, mesh=mesh)
+        assert sB.sharding.spec == P("data", None)
+        assert sB.values.shape[0] == sA.values.shape[0]  # same row bucket
+        acc = acc + np.asarray(sparse.matvec(sB, jnp.asarray(v[lo:hi])))
+    np.testing.assert_array_equal(acc, full)
+    # padded rows contribute exactly zero on both sides
+    np.testing.assert_array_equal(full[:64], D @ v)
+    assert float(np.abs(full[64:]).sum()) == 0.0
