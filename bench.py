@@ -4410,6 +4410,218 @@ def bench_sparse(_rtt):
                                      if not vv))
 
 
+# ---------------------------------------------------------------------------
+# sketched-assignment drill (ISSUE 17): learned fast-transform centers +
+# Nyström kernel k-means, with the gated-quality contract — speedup AND
+# inertia-ratio/ARI-vs-exact gates, committed as SKETCH_r01.json
+# ---------------------------------------------------------------------------
+
+
+def bench_sketch(_rtt):
+    """Sketched k-means drill (docs/kernels.md, "Sketched assignment"):
+
+    1. **Assignment-phase speedup** at n x d x k the exact fused kernel is
+       strong at: exact ``fused_argmin_min`` per iteration vs the sketched
+       path, both measured as the jitted programs production runs (the
+       Lloyd loop and ``predict_labels_sketched`` are jitted; eager
+       dispatch overhead is not the thing being bought). The sketched
+       side is staged the way the estimator stages it: the (d, p) support
+       slice is materialized ONCE at fit time, per-batch staging is one
+       affine matmul ``X @ Wp - off`` (no centered temporary, no row-norm
+       pass — labels are invariant to the per-row |x - mu|^2 constant),
+       amortized over 10 Lloyd iterations. Gate: amortized speedup >= 3x.
+    2. **Quality vs exact** on the KDD-character synthetic (same recipe as
+       the bounded-Lloyd drill): a full ``algorithm='sketched'`` fit vs
+       the exact fit from the same seed. Gates: inertia ratio <= 1.05 and
+       ARI >= 0.9 — approximation is allowed to move labels, but only
+       within the committed quality envelope.
+    3. **Kernel k-means beats dense Lloyd where convexity is the wall**:
+       the XOR problem (four gaussian blobs at (+-2, +-2), class =
+       sign(x1*x2)) — no convex partition separates the classes, so dense
+       KMeans must FAIL (ARI < 0.5 control) while a degree-2 polynomial
+       kernel exposes the x1*x2 monomial and Nystrom KernelKMeans must
+       recover the partition (ARI >= 0.9), with predict(train) ==
+       labels_ exactly.
+    4. **Compile-once**: a repeat sketched predict at a warmed shape adds
+       ZERO compiles.
+    5. **Serving**: a registered sketched model served through the batch
+       loop returns labels bit-equal to the direct predict path.
+
+    With ``DECISIONS_WRITE=1`` the measured exact-vs-sketched verdict is
+    persisted as decision rule ``kmeans.sketched.assign`` (the hand
+    inequality in ``models.kmeans.sketched_assign_wins`` stays as the
+    cold-start fallback). All sizes env-scalable: SKETCH_N/SKETCH_D
+    (speedup grid), SKETCH_QN/SKETCH_QD (quality problem), SKETCH_KN
+    (kernel XOR problem).
+    """
+    import jax
+    import jax.numpy as jnp
+    from sklearn.metrics import adjusted_rand_score
+
+    from dask_ml_tpu.cluster import KernelKMeans, KMeans
+    from dask_ml_tpu.models import kmeans as core
+    from dask_ml_tpu.ops import fast_transform as ftm
+    from dask_ml_tpu.ops.fused_distance import (
+        fused_argmin_min,
+        fused_argmin_min_sketched,
+    )
+    from dask_ml_tpu.parallel.serving import ModelRegistry, ServingLoop
+    from dask_ml_tpu.parallel.shapes import track_compiles
+
+    gates = {}
+
+    # -- 1. assignment-phase speedup ---------------------------------------
+    n = int(os.environ.get("SKETCH_N", 262144))
+    d = int(os.environ.get("SKETCH_D", 512))
+    k, p, n_sweeps = 23, 32, 16
+    X, mesh = _bounds_synth(n, d, key_seed=17)
+    c0 = jnp.take(X, jnp.arange(k) * (n // k), axis=0)
+    mu = jnp.mean(X, axis=0)
+    ft, support, vals, _ = ftm.palm4msa_fit(
+        c0 - mu[None, :], p, n_iter=n_sweeps)
+
+    # Fit-time staging, exactly as k_means._finish_sketched sets it up:
+    # the (d, p) support slice is materialized ONCE; the per-batch work
+    # is the affine map below, and the label-only path skips the
+    # |x - mu|^2 row pass entirely (argmin is invariant to a per-row
+    # constant — models.kmeans._predict_sketched_fast).
+    Wp = jax.jit(ftm.support_matrix)(ft, support)
+    off = mu @ Wp
+    zero = jnp.zeros((n,), jnp.float32)
+
+    exact_j = jax.jit(lambda Xs: fused_argmin_min(Xs, c0, mesh=mesh)[0])
+    stage_j = jax.jit(
+        lambda Xs: Xs @ Wp.astype(Xs.dtype) - off[None, :].astype(Xs.dtype))
+    sketch_j = jax.jit(
+        lambda Zs: fused_argmin_min_sketched(Zs, vals, x2=zero)[0])
+    Zp = stage_j(X)
+    t_exact = measure(lambda: exact_j(X), reps=3)
+    t_stage = measure(lambda: stage_j(X), reps=3)
+    t_sketch = measure(lambda: sketch_j(Zp), reps=3)
+    speedup_iter = t_exact / max(t_sketch, 1e-9)
+    amort_iters = 10
+    speedup_amort = t_exact / max(t_sketch + t_stage / amort_iters, 1e-9)
+    gates["assign_speedup_amortized_ge_3x"] = bool(speedup_amort >= 3.0)
+
+    # -- 2. quality envelope vs the exact fit ------------------------------
+    qn = int(os.environ.get("SKETCH_QN", 65536))
+    qd = int(os.environ.get("SKETCH_QD", 41))
+    Xq = np.asarray(_bounds_synth(qn, qd)[0])
+    t0 = time.perf_counter()
+    exact = KMeans(n_clusters=23, random_state=11, max_iter=100).fit(Xq)
+    t_exact_fit = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    sk = KMeans(n_clusters=23, random_state=11, max_iter=100,
+                algorithm="sketched", sketch_cols=36,
+                sketch_iters=16).fit(Xq)
+    t_sketch_fit = time.perf_counter() - t0
+    ratio = float(sk.inertia_) / max(float(exact.inertia_), 1e-12)
+    ari = float(adjusted_rand_score(exact.labels_, sk.labels_))
+    gates["inertia_ratio_le_1.05"] = bool(ratio <= 1.05)
+    gates["ari_vs_exact_ge_0.9"] = bool(ari >= 0.9)
+
+    # -- 3. kernel k-means where dense Lloyd structurally fails ------------
+    # XOR: four gaussian blobs at (+-2, +-2), class = sign(x1*x2). No
+    # convex partition separates the classes, so dense Lloyd sits near
+    # ARI 0; the degree-2 polynomial kernel's feature map contains the
+    # x1*x2 monomial, which separates them linearly.
+    kn = int(os.environ.get("SKETCH_KN", 4096))
+    rng = np.random.RandomState(0)
+    signs = rng.randint(0, 2, (kn, 2)) * 2 - 1
+    Xr = (signs * 2.0 + rng.randn(kn, 2) * 0.6).astype(np.float32)
+    y_xor = (signs[:, 0] * signs[:, 1] > 0).astype(np.int32)
+    ari_dense = float(adjusted_rand_score(
+        y_xor, KMeans(n_clusters=2, random_state=3).fit(Xr).labels_))
+    kk = KernelKMeans(n_clusters=2, n_components=min(128, kn // 4),
+                      affinity="polynomial", degree=2, coef0=1.0,
+                      gamma=0.5, random_state=5).fit(Xr)
+    ari_kernel = float(adjusted_rand_score(y_xor, kk.labels_))
+    gates["dense_lloyd_fails_xor"] = bool(ari_dense < 0.5)
+    gates["kernel_kmeans_xor_ari_ge_0.9"] = bool(ari_kernel >= 0.9)
+    gates["kernel_predict_matches_labels"] = bool(
+        np.array_equal(kk.predict(Xr), kk.labels_))
+
+    # -- 4. compile-once + 5. serving bit-identity -------------------------
+    probe = Xq[:2048]
+    lab_direct = sk.predict(probe)  # warms the predict shape bucket
+    with track_compiles() as tc:
+        lab_direct = sk.predict(probe)
+    gates["zero_steady_state_compiles"] = int(tc["n_compiles"]) == 0
+    reg = ModelRegistry()
+    reg.register("sketched", sk)
+    with ServingLoop(reg, max_batch_rows=2048) as lp:
+        lp.submit("sketched", probe).result(600)  # warm serving buckets
+        with track_compiles() as tcs:
+            served = lp.submit("sketched", probe).result(600)
+    gates["serving_bit_equal"] = bool(np.array_equal(served, lab_direct))
+    gates["serving_zero_compiles"] = int(tcs["n_compiles"]) == 0
+
+    # -- measured autotuner verdict (DECISIONS_WRITE=1 only) ---------------
+    decisions_info = None
+    if os.environ.get("DECISIONS_WRITE"):
+        from dask_ml_tpu.parallel import decisions
+
+        decisions.record(
+            "kmeans.sketched.assign",
+            {"n": [n // 2, n * 2], "k": [k // 2, k * 2],
+             "d": [d // 2, d * 2], "p": [p // 2, p * 2]},
+            bool(speedup_amort > 1.0),
+            measured={"exact_s": round(t_exact, 6),
+                      "sketch_s": round(t_sketch, 6),
+                      "stage_s": round(t_stage, 6),
+                      "amortized_speedup": round(speedup_amort, 3)},
+            backend=jax.default_backend())
+        path = decisions.save()
+        decisions_info = {"path": path,
+                          "n_entries": len(decisions.entries())}
+
+    rec = {
+        "metric": "sketched_kmeans",
+        "value": round(speedup_amort, 3),
+        "unit": "assignment-phase speedup vs exact fused Lloyd "
+                f"(staging amortized over {amort_iters} iters)",
+        "vs_baseline": None,
+        "backend": jax.default_backend(),
+        "all_gates_pass": all(gates.values()),
+        "gates": gates,
+        "speedup": {"rows": n, "cols": d, "n_clusters": k, "p": p,
+                    "exact_assign_s": round(t_exact, 4),
+                    "sketch_assign_s": round(t_sketch, 4),
+                    "stage_s": round(t_stage, 4),
+                    "per_iter_speedup": round(speedup_iter, 3),
+                    "amortized_speedup": round(speedup_amort, 3)},
+        "quality": {"rows": qn, "cols": qd, "n_clusters": 23,
+                    "sketch_cols": 36, "sketch_iters": 16,
+                    "inertia_ratio_vs_exact": round(ratio, 6),
+                    "ari_vs_exact": round(ari, 4),
+                    "exact_fit_s": round(t_exact_fit, 3),
+                    "sketched_fit_s": round(t_sketch_fit, 3)},
+        "kernel_kmeans": {"rows": kn, "problem": "xor",
+                          "affinity": "polynomial(degree=2)",
+                          "landmarks": int(min(128, kn // 4)),
+                          "ari_dense_control": round(ari_dense, 4),
+                          "ari_kernel": round(ari_kernel, 4)},
+        "decisions": decisions_info,
+        "note": "quality gates are the contract change this drill "
+                "commits: sketched assignment is NOT bit-identical to "
+                "exact Lloyd — it is allowed to trade labels for speed "
+                "only inside the inertia-ratio/ARI envelope above. "
+                "Off-TPU the speedup measures the XLA lowering of both "
+                "paths on the 8-device host mesh; the structured-matmul "
+                "epilogue's Pallas lowering is pinned by interpret-mode "
+                "parity tests (tests/test_fast_transform.py).",
+    }
+    emit(rec)
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "SKETCH_r01.json")
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=1)
+    if not all(gates.values()):
+        raise SystemExit(
+            "sketched drill: failed gates: "
+            + ", ".join(g for g, v in gates.items() if not v))
+
+
 def main():
     _enable_compilation_cache()
     rtt = measure_rtt()
@@ -4533,6 +4745,18 @@ if __name__ == "__main__":
             bench_fleet(measure_rtt())
         else:
             bench_serving(measure_rtt())
+        emit_summary()
+    elif "--sketch" in sys.argv:
+        # sketched-assignment drill (ISSUE 17); CI's sketch job runs this
+        # scaled down (SKETCH_N/SKETCH_QN/... env): amortized assignment
+        # speedup vs exact fused Lloyd, the inertia-ratio/ARI-vs-exact
+        # quality envelope, the kernel-k-means nonlinear-boundary gate
+        # with its dense-Lloyd-fails control, compile-once, and the
+        # serving bit-identity drill — nonzero exit on any gate failure
+        # (committed as SKETCH_r01.json); with DECISIONS_WRITE=1 it also
+        # persists the measured kmeans.sketched.assign verdict
+        _enable_compilation_cache()
+        bench_sketch(measure_rtt())
         emit_summary()
     elif "--sparse" in sys.argv:
         # sparse-tier drill (ISSUE 13); CI's sparse job runs this scaled

@@ -1,5 +1,6 @@
 """Clustering estimators (reference: dask_ml/cluster/__init__.py)."""
 
+from dask_ml_tpu.cluster.kernel_kmeans import KernelKMeans  # noqa: F401
 from dask_ml_tpu.cluster.k_means import (  # noqa: F401
     KMeans,
     compute_inertia,
@@ -16,7 +17,7 @@ from dask_ml_tpu.cluster.minibatch import (  # noqa: F401
 )
 from dask_ml_tpu.cluster.spectral import SpectralClustering, embed  # noqa: F401
 
-__all__ = ["KMeans", "MiniBatchKMeans", "SpectralClustering",
-           "PartialMiniBatchKMeans",
+__all__ = ["KMeans", "KernelKMeans", "MiniBatchKMeans",
+           "SpectralClustering", "PartialMiniBatchKMeans",
            "k_means", "compute_inertia", "evaluate_cost", "embed",
            "k_init", "init_pp", "init_random", "init_scalable"]

@@ -45,7 +45,8 @@ class KMeans(TransformerMixin, BaseEstimator):
         sklearn and the reference.
     random_state : int, jax PRNG key, or None
     init_max_iter : int or None — cap on k-means|| rounds.
-    algorithm : {'full', 'lloyd', 'bounded', 'elkan', 'auto'}, default 'full'
+    algorithm : {'full', 'lloyd', 'bounded', 'elkan', 'auto', 'sketched'},
+        default 'full'.
         Lloyd-iteration implementation. 'full' (alias 'lloyd') is the
         plain fused loop; 'bounded' (alias 'elkan', sklearn's name for
         the idea) carries Elkan/Yinyang center-movement bounds and skips
@@ -54,7 +55,21 @@ class KMeans(TransformerMixin, BaseEstimator):
         are bit-identical to 'full' (pinned by test), only the work
         differs; 'auto' picks 'bounded' in its winning regimes
         (``models.kmeans._bounded_auto_wins``). A bounded fit exposes
-        its pruning counters as ``lloyd_pruning_``.
+        its pruning counters as ``lloyd_pruning_``. 'sketched' is the
+        APPROXIMATE QuicK-means path (arxiv 1908.08713): centers are
+        constrained to a learned fast-transform sketch
+        (ops/fast_transform.py) and the Lloyd loop runs in the
+        ``sketch_cols``-column transform space — O(n·k·p) assignments
+        instead of O(n·k·d), at a quality cost gated by bench.py
+        ``--sketch`` (inertia-ratio and ARI vs exact; docs/kernels.md,
+        "Sketched assignment"). A sketched fit additionally exposes
+        ``fast_transform_``, ``sketch_support_``, ``sketch_vals_``, and
+        ``sketch_loss_``.
+    sketch_cols : int or None, default None ('sketched' only)
+        Columns p of the shared sketch support; None picks
+        ``max(4, n_features // 4)``.
+    sketch_iters : int, default 8 ('sketched' only)
+        palm4MSA alternations fitting the transform to the init centers.
     n_jobs / precompute_distances / copy_x are accepted for signature
         parity and ignored (placement is the mesh's job).
 
@@ -79,6 +94,8 @@ class KMeans(TransformerMixin, BaseEstimator):
         n_jobs: int = 1,
         algorithm: str = "full",
         init_max_iter=None,
+        sketch_cols=None,
+        sketch_iters: int = 8,
     ):
         self.n_clusters = n_clusters
         self.init = init
@@ -91,6 +108,8 @@ class KMeans(TransformerMixin, BaseEstimator):
         self.n_jobs = n_jobs
         self.algorithm = algorithm
         self.init_max_iter = init_max_iter
+        self.sketch_cols = sketch_cols
+        self.sketch_iters = sketch_iters
 
     def _check_params(self, n_samples=None):
         if self.n_clusters < 1:
@@ -102,10 +121,14 @@ class KMeans(TransformerMixin, BaseEstimator):
                 f"n_clusters={self.n_clusters} must be <= n_samples={n_samples}"
             )
         if self.algorithm not in ("full", "lloyd", "bounded", "elkan",
-                                  "auto"):
+                                  "auto", "sketched"):
             raise ValueError(
-                "algorithm must be 'full'/'lloyd', 'bounded'/'elkan', or "
-                f"'auto'; got {self.algorithm!r}")
+                "algorithm must be 'full'/'lloyd', 'bounded'/'elkan', "
+                f"'auto', or 'sketched'; got {self.algorithm!r}")
+        if self.sketch_cols is not None and int(self.sketch_cols) < 1:
+            raise ValueError("sketch_cols must be >= 1")
+        if int(self.sketch_iters) < 0:
+            raise ValueError("sketch_iters must be >= 0")
 
     def _use_bounded(self, n: int, d: int) -> bool:
         if self.algorithm in ("bounded", "elkan"):
@@ -144,6 +167,9 @@ class KMeans(TransformerMixin, BaseEstimator):
             )
         t_init = tic()
         logger.info("init (%s) finished in %.2fs", self.init, t_init - t0)
+
+        if self.algorithm == "sketched":
+            return self._finish_sketched(data, centers, t0, t_init)
 
         tol = core.scaled_tolerance(data.X, data.weights, self.tol)
         bounded = self._use_bounded(data.n, data.n_features)
@@ -245,6 +271,132 @@ class KMeans(TransformerMixin, BaseEstimator):
             "init": t_init - t0, "lloyd": tic() - t_init}
         return self
 
+    def _finish_sketched(self, data, centers, t0, t_init):
+        """The QuicK-means fit: palm4MSA-fit a fast transform + shared
+        support to the init centers, transform the data ONCE (amortized
+        over every Lloyd iteration), and run the STANDARD fused Lloyd
+        loop on the support-restricted columns. The restricted loop IS
+        the constrained optimization: for an orthogonal transform with a
+        fixed support, the full-space M-step followed by re-projection
+        onto the transform product equals the plain M-step on the
+        restricted data (mean of restrictions == restriction of the
+        mean), and restricted distances differ from full-space distances
+        to the sketched centers by a per-row constant — identical
+        argmins. So the sketched path inherits the fused loop whole:
+        hierarchy-metered ``kmeans.mstep`` collectives, compile-once
+        buckets, kernel auto-dispatch.
+
+        Two QuicK-means alternation rounds: the first transform is fit
+        on the INIT centers, which are the wrong geometry once Lloyd has
+        moved — so after the loop converges, refit transform + support
+        on the reconstructed converged centers and run a second (short —
+        it starts converged) restricted loop. Finalization is honest
+        data-space accounting: ``labels_`` come from the sketched
+        assignment the served model will actually run, and
+        ``cluster_centers_``/``inertia_`` are the EXACT weighted means
+        of that partition and its exact within-partition SSE (one
+        O(n·k·d) polish pass — for a fixed partition the exact means are
+        optimal, so the sketch approximation is confined to where it
+        belongs, the partition itself, and the inertia-ratio bench gate
+        measures partition quality, not reconstruction roundoff)."""
+        from dask_ml_tpu.ops import fast_transform as ftm
+
+        d = data.n_features
+        p = (int(self.sketch_cols) if self.sketch_cols is not None
+             else max(4, d // 4))
+        with telemetry.span("kmeans.sketch-fit", p=p,
+                            iters=int(self.sketch_iters)):
+            # Center on the weighted data mean before sketching: k-means
+            # geometry is translation-invariant, and a shared mean
+            # component would waste support budget on a direction that
+            # cancels in every distance comparison.
+            w32 = data.weights.astype(jnp.float32)
+            mu = (w32 @ data.X.astype(jnp.float32)
+                  ) / jnp.maximum(jnp.sum(w32), 1e-12)
+            ft, support, vals0, fit_loss = ftm.palm4msa_fit(
+                centers - mu[None, :].astype(centers.dtype), p,
+                n_iter=int(self.sketch_iters))
+            Zp = _sketch_stage(ft, data.X, mu, support)
+        with telemetry.span("kmeans-lloyd", logger=logger,
+                            algorithm="sketched"):
+            tol = core.scaled_tolerance(Zp, data.weights, self.tol)
+            vals, _, n_iter1, _ = core.lloyd_loop_fused(
+                Zp, data.weights, vals0, tol,
+                mesh=data.mesh, max_iter=self.max_iter)
+            # round 2: refit on the converged (centered) reconstruction,
+            # re-stage, continue the loop in the refreshed support
+            with telemetry.span("kmeans.sketch-refit", p=p):
+                ft, support, vals0, fit_loss = ftm.palm4msa_fit(
+                    ftm.reconstruct(ft, vals, support), p,
+                    n_iter=int(self.sketch_iters))
+                Zp = _sketch_stage(ft, data.X, mu, support)
+            tol = core.scaled_tolerance(Zp, data.weights, self.tol)
+            vals, _, n_iter2, _ = core.lloyd_loop_fused(
+                Zp, data.weights, vals0, tol,
+                mesh=data.mesh, max_iter=self.max_iter)
+            n_iter = int(n_iter1) + int(n_iter2)
+        with telemetry.span("kmeans.finalize"):
+            centers_sk = ftm.reconstruct(ft, vals, support) + mu[None, :]
+            # materialize the (d, p) staging slice ONCE: every predict
+            # (and the serving runner) is then one affine matmul, with
+            # no per-call factor-ladder replay (support_matrix docstring)
+            Wp = _support_matrix_j(ft, support)
+            off = mu @ Wp
+            labels = core.predict_labels_sketched(
+                data.X, Wp, off, vals, centers_sk)
+            centers_dense = _polish_centers(
+                data.X, data.weights, labels, centers_sk)
+            inertia = _assigned_inertia(
+                data.X, data.weights, labels, centers_dense)
+        t_done = tic()
+        logger.info(
+            "sketched Lloyd finished in %.2fs: %d iterations (p=%d), "
+            "inertia %.4g", t_done - t_init, int(n_iter), p,
+            float(inertia))
+        if telemetry.enabled():
+            reg = telemetry.metrics()
+            reg.histogram("kmeans.lloyd.iterations").observe(int(n_iter))
+            reg.histogram("kmeans.lloyd.seconds_per_iter").observe(
+                (t_done - t_init) / max(int(n_iter), 1))
+        self.cluster_centers_ = np.asarray(centers_dense)
+        self.fast_transform_ = ftm.FastTransform(
+            np.asarray(ft.angles), ft.d, ft.d_pad)
+        self.sketch_mean_ = np.asarray(mu)
+        self.sketch_centers_ = np.asarray(centers_sk)
+        self.sketch_support_ = np.asarray(support)
+        self.sketch_vals_ = np.asarray(vals)
+        self.sketch_staging_ = np.asarray(Wp)
+        self.sketch_offset_ = np.asarray(off)
+        self.sketch_loss_ = float(fit_loss)
+        if self.n_clusters <= 255:
+            labels = labels.astype(jnp.uint8)
+        self.labels_ = np.asarray(
+            unpad_rows(labels, data.n)).astype(np.int32)
+        self.inertia_ = float(inertia)
+        self.n_iter_ = int(n_iter)
+        self.n_features_in_ = data.n_features
+        self.fit_phase_seconds_ = {
+            "init": t_init - t0, "lloyd": tic() - t_init}
+        return self
+
+    def _sketch_args(self):
+        """Device-side (Wp, off, vals, centers) of a sketched fit — the
+        argument pack of ``models.kmeans.predict_labels_sketched``,
+        shared by :meth:`predict` and the serving runner
+        (parallel/serving.py) so both call the SAME jitted program.
+        ``Wp``/``off`` are the fit-time-materialized staging slice and
+        its centering offset (one affine matmul per predict, no ladder
+        replay). The dense-centers slot is ``sketch_centers_`` (the
+        reconstruction ``G·Wᵀ + μ``), NOT the polished
+        ``cluster_centers_``: the facade's exact-dispatch branch must
+        assign against the centers the sketch actually encodes, so both
+        branches produce identical labels and the dispatch stays a pure
+        perf decision."""
+        return (jnp.asarray(self.sketch_staging_),
+                jnp.asarray(self.sketch_offset_),
+                jnp.asarray(self.sketch_vals_),
+                jnp.asarray(self.sketch_centers_))
+
     def _check_fitted(self):
         if not hasattr(self, "cluster_centers_"):
             raise AttributeError("Model not fitted; call fit first")
@@ -259,7 +411,12 @@ class KMeans(TransformerMixin, BaseEstimator):
         self._check_fitted()
         X = check_array(X)
         data = prepare_data(X)
-        labels = core.predict_labels(data.X, jnp.asarray(self.cluster_centers_))
+        if getattr(self, "fast_transform_", None) is not None:
+            labels = core.predict_labels_sketched(
+                data.X, *self._sketch_args())
+        else:
+            labels = core.predict_labels(
+                data.X, jnp.asarray(self.cluster_centers_))
         from dask_ml_tpu.config import get_config
 
         if not get_config()["device_outputs"]:
@@ -390,6 +547,52 @@ def k_means(X, n_clusters, init="k-means||", precompute_distances="auto",
 def _assigned_inertia(Xs, w, labels_padded, centers):
     assigned = centers[labels_padded]
     return jnp.sum(w * jnp.sum((Xs - assigned) ** 2, axis=1))
+
+
+@jax.jit
+def _polish_centers(Xs, w, labels_padded, fallback_centers):
+    """Exact data-space M-step for a FIXED partition: the weighted mean
+    of every cluster's rows (one-hot matmul, so the sample-axis
+    contraction stays a GSPMD-reducible dot like the fused M-step, not a
+    serializing scatter-add). Empty clusters keep their fallback center.
+    Used by the sketched finalize: for a given partition the exact means
+    are SSE-optimal, so polishing confines the sketch approximation to
+    the partition itself."""
+    k = fallback_centers.shape[0]
+    oh = (jax.nn.one_hot(labels_padded, k, dtype=jnp.float32)
+          * w.astype(jnp.float32)[:, None])  # (n, k)
+    cnt = jnp.sum(oh, axis=0)  # (k,)
+    sums = jax.lax.dot_general(
+        oh, Xs.astype(jnp.float32), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)  # (k, d)
+    means = sums / jnp.maximum(cnt, 1e-12)[:, None]
+    return jnp.where((cnt > 0.0)[:, None], means, fallback_centers)
+
+
+@jax.jit
+def _sketch_stage(ft, Xs, mu, support):
+    """Center + transform + support restriction of the staged data as
+    ONE program: ``Z_p = (X - mu) @ Wᵀ[:, support]`` (n, p), the array
+    the sketched Lloyd loop runs on. The thin transform slice is
+    materialized once (ops/fast_transform.py ``support_matrix`` — see
+    its docstring for why the slice-matmul, not the factor ladder, is
+    the production staging path) so staging is one O(n·d·p) matmul.
+    Row-wise, so GSPMD keeps it sharded with X."""
+    from dask_ml_tpu.ops.fast_transform import support_matrix
+
+    Wp = support_matrix(ft, support)
+    return (Xs - mu.astype(Xs.dtype)[None, :]) @ Wp.astype(Xs.dtype)
+
+
+@jax.jit
+def _support_matrix_j(ft, support):
+    """Jitted ``support_matrix``: the fit runs it once per sketched
+    model to materialize the (d, p) staging slice predict/serving reuse
+    — under jit the 8·sweeps sequential rotate levels fuse into one
+    program instead of that many eager dispatches."""
+    from dask_ml_tpu.ops.fast_transform import support_matrix
+
+    return support_matrix(ft, support)
 
 
 def compute_inertia(X, labels, centers):

@@ -1,0 +1,289 @@
+"""Nyström-approximate kernel k-means.
+
+Dense Lloyd partitions by Euclidean proximity to mean vectors, so it can
+only ever carve the space into convex (Voronoi) cells — a nonlinear
+class boundary (concentric rings, interleaved arcs) is structurally out
+of reach no matter how many restarts it gets. Kernel k-means (Dhillon,
+Guan & Kulis 2004) lifts the rows through a kernel feature map and runs
+the SAME Lloyd objective on inner products, which makes it equivalent to
+a weighted graph cut — but the exact algorithm needs the full n×n Gram
+matrix, per iteration. The scalable middle road implemented here
+(following the landmark treatment of arxiv 2601.17136 and the Nyström
+seam this repo already trusts for spectral clustering): sample l ≪ n
+landmark rows, build the thin kernel strip ``C = K(X, X_l)`` (n, l)
+sharded over the sample axis, and factor the degree-normalized Nyström
+approximant ``K̂ = D^-½ C A⁺ Cᵀ D^-½ = Φ Φᵀ`` through the EXPLICIT
+l-dimensional feature rows ``Φ = D^-½ C D_l^-½ · U S^-½``. Euclidean
+k-means on Φ IS kernel k-means on K̂ (the lift makes the kernel-space
+centroid distances literal vector distances), so the whole fused Lloyd
+stack — assignment kernels, compile-once buckets, hierarchy-metered
+M-step collectives — is inherited unchanged by handing Φ to the inner
+:class:`~dask_ml_tpu.cluster.k_means.KMeans`.
+
+The shared seam with spectral clustering is
+:func:`~dask_ml_tpu.cluster.spectral._nystrom_map`: spectral consumes it
+row-normalized with the top-k eigenmap (Eq. 4 of Ng-Jordan-Weiss),
+kernel k-means consumes it UN-normalized with the FULL l-column
+whitening map — row-normalizing would destroy the inner products the
+kernel-space centroids live in, and truncating to k columns would make
+this spectral clustering by another name. Small eigenvalues are
+THRESHOLDED, not inverted (``1/√S`` only where ``S > S₀·1e-6``, zero
+otherwise): A's trailing spectrum is noise the pseudo-inverse would
+amplify into the features.
+
+The fit's one sample-axis collective is the Gram-strip column degree
+``Σ_rows C`` (every other reduction lives inside the inner KMeans, which
+meters its own M-step). It routes through
+:func:`~dask_ml_tpu.parallel.hierarchy.hpsum` on hierarchical meshes
+(ledger op ``kernel.gram.colsum`` — chip-then-pod staged accounting) and
+is recorded flat otherwise, the ``fused.argmin_weight`` convention.
+
+Out-of-sample ``predict`` mirrors the spectral landmark-assignment path:
+one jitted program (kernel strip → un-normalized extension → fused
+nearest-center assignment), shared with the serving runners so served
+predictions are bit-identical to direct calls.
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from sklearn.base import BaseEstimator, ClusterMixin
+
+from dask_ml_tpu.cluster.k_means import KMeans
+from dask_ml_tpu.cluster.spectral import _check_affinity, _nystrom_map
+from dask_ml_tpu.ops.pairwise import pairwise_kernels
+from dask_ml_tpu.parallel import telemetry
+from dask_ml_tpu.parallel.sharding import shard_rows, unpad_rows
+from dask_ml_tpu.utils._log import log_array
+from dask_ml_tpu.utils.validation import check_array, check_random_state_np
+
+logger = logging.getLogger(__name__)
+
+
+@partial(jax.jit, static_argnames=("metric", "params_t"))
+def _kernel_blocks(Xs, keep_idx, n_valid, *, metric, params_t):
+    """Landmark Gram block A (l, l) and sharded kernel strip C (n_pad, l)
+    with padding rows zeroed (so sample-axis degree sums stay exact) —
+    the staging half of the fit, one jitted program."""
+    params = dict(params_t)
+    Xk = jnp.take(Xs, keep_idx, axis=0)  # (l, d), replicated by GSPMD
+    A = pairwise_kernels(Xk, Xk, metric=metric, **params)
+    C = pairwise_kernels(Xs, Xk, metric=metric, **params)
+    row_valid = jnp.arange(C.shape[0]) < n_valid
+    return A, jnp.where(row_valid[:, None], C, 0.0)
+
+
+def _gram_colsum(C, mesh):
+    """Column degree of the sharded kernel strip — the fit's one
+    sample-axis collective. Hierarchical meshes stage it chip-then-pod
+    through ``hpsum`` (ledger op ``kernel.gram.colsum``); flat meshes
+    keep the plain GSPMD reduction and record the same logical bytes, so
+    flat-vs-hierarchical per-op accounting covers the same reduction
+    regardless of lowering (the ``fused.argmin_weight`` convention)."""
+    if mesh is None:
+        return jnp.sum(C, axis=0)
+    from dask_ml_tpu.parallel.hierarchy import hpsum, record_collective
+    from dask_ml_tpu.parallel.mesh import data_axes, is_hierarchical, \
+        shard_map
+    from jax.sharding import PartitionSpec as P
+
+    if not is_hierarchical(mesh):
+        record_collective("kernel.gram.colsum", mesh, (C.shape[1],),
+                          jnp.float32)
+        return jnp.sum(C, axis=0)
+    axes = data_axes(mesh)
+    a = axes[0] if len(axes) == 1 else axes
+    fn = shard_map(
+        lambda Cl: hpsum(jnp.sum(Cl, axis=0), mesh,
+                         op="kernel.gram.colsum"),
+        mesh=mesh, in_specs=(P(a, None),), out_specs=P(),
+        check_vma=False)
+    return fn(C)
+
+
+@jax.jit
+def _feature_core(A, C, colsum, keep_idx, n_true):
+    """The post-collective Nyström feature math: unified degree
+    normalization (the spectral ``_nystrom_core`` identities — keep rows
+    of the strip ARE A's rows, so one formula covers all rows), the
+    small replicated eigensolve, and the THRESHOLDED full-width
+    whitening map. Returns ``(Φ (n_pad, l), extension factors)`` where
+    the factors are exactly the ``_nystrom_map`` argument pack that
+    ``predict`` replays on new rows."""
+    A_inv = jnp.linalg.pinv(A)
+    ainv_colsum = A_inv @ colsum  # (l,) degree functional
+    d_all = C @ ainv_colsum  # (n_pad,) approximate row degrees
+    d_si = 1.0 / jnp.sqrt(jnp.maximum(d_all, 1e-12))
+    d1_si = jnp.take(d_si, keep_idx)  # landmark rows' exact degrees
+
+    A2 = d1_si[:, None] * A * d1_si[None, :]
+    U_A, S_A, _ = jnp.linalg.svd(A2)
+    # full l-column whitening, trailing spectrum thresholded not inverted
+    inv_sqrt = jnp.where(S_A > S_A[0] * 1e-6, 1.0 / jnp.sqrt(S_A), 0.0)
+    map_full = U_A * inv_sqrt[None, :]  # (l, l)
+    scale = jnp.sqrt(keep_idx.shape[0] / n_true)
+    Phi = _nystrom_map(C, ainv_colsum, d1_si, map_full, scale,
+                       row_normalize=False)
+    return Phi, (ainv_colsum, d1_si, map_full)
+
+
+@partial(jax.jit, static_argnames=("metric", "params_t", "mesh"))
+def _kernel_assign_program(Xs, Xk, ainv_colsum, d1_si, map_full, scale,
+                           centers, *, metric, params_t, mesh):
+    """Out-of-sample kernel-k-means assignment as ONE jitted program:
+    kernel strip against the fitted landmarks, the un-normalized Nyström
+    feature extension, nearest-center assignment through the fused
+    distance-reduction family — the kernel-k-means sibling of
+    spectral's ``_nystrom_assign_program``, shared by :meth:`predict`
+    and the serving runners (parallel/serving.py) so served labels are
+    bit-identical to direct calls by construction."""
+    from dask_ml_tpu.ops.fused_distance import fused_argmin_min
+
+    C = pairwise_kernels(Xs, Xk, metric=metric, **dict(params_t))
+    V = _nystrom_map(C, ainv_colsum, d1_si, map_full, scale,
+                     row_normalize=False)
+    labels, _ = fused_argmin_min(V, centers, mesh=mesh)
+    return labels
+
+
+class KernelKMeans(BaseEstimator, ClusterMixin):
+    """Landmark (Nyström) kernel k-means — see the module docstring for
+    the algorithm and how it shares seams with SpectralClustering and
+    KMeans. String kernel names only (the jitted programs take the
+    metric as a static argument; callables belong to the spectral eager
+    path, which this estimator deliberately does not duplicate).
+
+    Parameters follow :class:`SpectralClustering` where they overlap:
+    ``n_components`` is the landmark count l, ``affinity``/``gamma``/
+    ``degree``/``coef0``/``kernel_params`` the kernel, ``kmeans_params``
+    forwards to the inner :class:`KMeans` that clusters the feature
+    rows, and ``n_init`` runs that inner k-means from several seeds on
+    the once-computed features, keeping the lowest-inertia run. Fitted attributes: ``labels_``, ``cluster_centers_`` (k, l —
+    centers in FEATURE space), ``inertia_`` (feature-space SSE),
+    ``n_iter_``, plus the landmark/extension state ``predict`` replays.
+    """
+
+    def __init__(self, n_clusters=8, n_components=100, affinity="rbf",
+                 gamma=1.0, degree=3, coef0=1, kernel_params=None,
+                 n_init=3, random_state=None, kmeans_params=None):
+        self.n_clusters = n_clusters
+        self.n_components = n_components
+        self.affinity = affinity
+        self.gamma = gamma
+        self.degree = degree
+        self.coef0 = coef0
+        self.kernel_params = kernel_params
+        self.n_init = n_init
+        self.random_state = random_state
+        self.kmeans_params = kmeans_params
+
+    def _kernel_params(self) -> dict:
+        params = dict(self.kernel_params or {})
+        params["gamma"] = self.gamma
+        params["degree"] = self.degree
+        params["coef0"] = self.coef0
+        return params
+
+    def fit(self, X, y=None):
+        if callable(self.affinity):
+            raise ValueError(
+                "KernelKMeans requires a string kernel name; callable "
+                "affinities are supported by SpectralClustering's eager "
+                "path")
+        _check_affinity(self.affinity)
+        X = check_array(X)
+        n = int(X.shape[0])
+        l = int(self.n_components)
+        if n <= l:
+            raise ValueError(
+                "'n_components' must be smaller than the number of "
+                f"samples. Got {l} components and {n} samples")
+        rng = check_random_state_np(self.random_state)
+
+        from dask_ml_tpu.parallel.mesh import default_mesh
+
+        Xs, n_valid = shard_rows(X)
+        log_array(logger, "kernel-kmeans: staged X", Xs)
+        keep = rng.choice(np.arange(n), l, replace=False)
+        keep.sort()
+        params_t = tuple(sorted(self._kernel_params().items()))
+        with telemetry.span("kernel-kmeans-nystrom",
+                            landmarks=int(l), k=int(self.n_clusters)):
+            A, C = _kernel_blocks(
+                Xs, jnp.asarray(keep), jnp.asarray(n_valid, jnp.int32),
+                metric=self.affinity, params_t=params_t)
+            colsum = _gram_colsum(C, default_mesh())
+            Phi, ext = _feature_core(
+                A, C, colsum, jnp.asarray(keep),
+                jnp.asarray(float(n), jnp.float32))
+        # best-of-n_init restarts of the inner k-means: the feature rows
+        # are computed once and stay on device, so extra inits cost only
+        # the small (n, l) Lloyd loops — the whitened embedding has flat
+        # directions that can trap a single init in a bad local minimum
+        U = unpad_rows(Phi, n_valid)
+        km = None
+        for _ in range(max(1, int(self.n_init))):
+            cand = KMeans(n_clusters=self.n_clusters,
+                          random_state=rng.randint(2**31 - 1))
+            if self.kmeans_params:
+                cand.set_params(**self.kmeans_params)
+            cand.fit(U)
+            if km is None or cand.inertia_ < km.inertia_:
+                km = cand
+
+        self._landmarks_ = np.asarray(jnp.take(Xs, jnp.asarray(keep),
+                                               axis=0))
+        self._extension_ = tuple(np.asarray(e) for e in ext)
+        self._n_fit_rows_ = float(n)
+        self.assign_kmeans_ = km
+        self.labels_ = np.asarray(km.labels_)
+        self.cluster_centers_ = np.asarray(km.cluster_centers_)
+        self.inertia_ = float(km.inertia_)
+        self.n_iter_ = int(km.n_iter_)
+        self.n_features_in_ = int(X.shape[1])
+        return self
+
+    def fit_predict(self, X, y=None):
+        self.fit(X)
+        return self.labels_
+
+    def _assign_staged(self, Xs):
+        """Labels for STAGED (padded, row-sharded) rows through the one
+        jitted assignment program — PADDED device labels; callers slice
+        to the true row count. Shared by :meth:`predict` and the serving
+        batch runners."""
+        from dask_ml_tpu.parallel.mesh import default_mesh
+
+        ainv_colsum, d1_si, map_full = (
+            jnp.asarray(e) for e in self._extension_)
+        scale = jnp.asarray(
+            np.sqrt(int(self.n_components) / self._n_fit_rows_),
+            jnp.float32)
+        return _kernel_assign_program(
+            Xs, jnp.asarray(self._landmarks_), ainv_colsum, d1_si,
+            map_full, scale, jnp.asarray(self.cluster_centers_),
+            metric=self.affinity,
+            params_t=tuple(sorted(self._kernel_params().items())),
+            mesh=default_mesh())
+
+    def predict(self, X):
+        """Labels for NEW rows: kernel strip against the fitted
+        landmarks, the same un-normalized extension the fit used
+        (training rows re-extend to their fit features exactly), fused
+        nearest-center assignment. Exact kernel k-means has no
+        out-of-sample story; the landmark factorization gives one for
+        free."""
+        if not hasattr(self, "cluster_centers_"):
+            raise AttributeError("Model not fitted; call fit first")
+        X = check_array(X)
+        from dask_ml_tpu.parallel import precision as precision_lib
+
+        Xs, n_valid = shard_rows(
+            X, dtype=precision_lib.staging_wire_dtype())
+        return np.asarray(
+            self._assign_staged(Xs))[:n_valid].astype(np.int32)

@@ -290,19 +290,35 @@ def _nystrom_program(Xs, keep_idx, n_valid, n_true, *, metric, params_t,
     return V2, S_A, Xk, ext
 
 
-def _nystrom_extend(C, ainv_colsum, d1_si, map_k, scale):
-    """Map a kernel strip ``C = K(rows, landmarks)`` through the fitted
+def _nystrom_map(C, ainv_colsum, d1_si, map_k, scale, *,
+                 row_normalize: bool = True):
+    """Map a kernel strip ``C = K(rows, landmarks)`` through fitted
     Nyström machinery: approximate degree, unified normalization, the
-    Eq. 16 eigenmap, row normalization. ONE definition used for the
-    training rows (:func:`_nystrom_core`) and for out-of-sample rows
-    (:meth:`SpectralClustering.predict`) — training-row re-extension
-    reproduces the fit embedding exactly."""
+    eigenmap, optional row normalization. The ONE extension seam of the
+    Nyström family — spectral clustering consumes it row-normalized
+    (Eq. 4) with the top-k eigenmap, kernel k-means
+    (cluster/kernel_kmeans.py) consumes it UN-normalized with the full
+    l-column whitening map (its feature rows must keep their kernel
+    geometry: ``Φ Φᵀ ≈ D^-½ K D^-½``, and row-normalizing would destroy
+    the inner products the kernel-space centroids live in)."""
     d_row = C @ ainv_colsum  # approximate row degrees
     d_si = 1.0 / jnp.sqrt(jnp.maximum(d_row, 1e-12))
     C2 = d_si[:, None] * C * d1_si[None, :]
     V = scale * (C2 @ map_k)
+    if not row_normalize:
+        return V
     # Row-normalize (Eq. 4, reference: spectral.py:266).
     return V / jnp.maximum(jnp.linalg.norm(V, axis=1, keepdims=True), 1e-12)
+
+
+def _nystrom_extend(C, ainv_colsum, d1_si, map_k, scale):
+    """The spectral-clustering view of :func:`_nystrom_map` (always
+    row-normalized) — ONE definition used for the training rows
+    (:func:`_nystrom_core`) and for out-of-sample rows
+    (:meth:`SpectralClustering.predict`) — training-row re-extension
+    reproduces the fit embedding exactly."""
+    return _nystrom_map(C, ainv_colsum, d1_si, map_k, scale,
+                        row_normalize=True)
 
 
 def _nystrom_core(A, C, keep_idx, n_valid, n_true, k: int):

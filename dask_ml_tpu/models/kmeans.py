@@ -34,8 +34,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from dask_ml_tpu.ops.fused_distance import (
+    _row_sumsq,
     fused_argmin_min,
     fused_argmin_min2,
+    fused_argmin_min_sketched,
     fused_argmin_weight,
     fused_rowwise_min,
     row_block_evaluated,
@@ -914,6 +916,68 @@ def compute_inertia(X, w, centers):
 @jax.jit
 def predict_labels(X, centers):
     return fused_argmin_min(X, centers)[0]
+
+
+def sketched_assign_wins(n: int, k: int, d: int, p: int) -> bool:
+    """Should assignment against a fast-transform sketch run the SKETCHED
+    contraction (transform + O(n·k·p) support matmul —
+    ops/fused_distance.py ``fused_argmin_min_sketched``) or the EXACT
+    dense contraction against the reconstructed centers (O(n·k·d))? Both
+    paths assign to the same sketched model — mathematically identical
+    labels (orthogonal transform: restricted and reconstructed distances
+    agree), so this is a pure perf dispatch, the
+    ``_bounded_auto_wins``/``_fused_auto_wins`` pattern: bench-measured
+    verdicts in the decision cache (``DECISIONS_WRITE=1 bench.py
+    --sketch`` records them, rule ``kmeans.sketched.assign``) override
+    the hand-written cold-start inequality point-wise. The fallback asks
+    for the arithmetic win to be structural — the sketched path pays an
+    O(n·d·p) staging matmul per batch, so the support must be genuinely
+    narrow and k large enough that the k·p term, not the staging
+    overhead, is the bill."""
+    from dask_ml_tpu.parallel import decisions
+
+    return decisions.lookup(
+        "kmeans.sketched.assign",
+        {"n": n, "k": k, "d": d, "p": p},
+        fallback=(2 * p <= d and k >= 8))
+
+
+@jax.jit
+def _predict_sketched_fast(X, Wp, off, vals):
+    # Zp = (X - mu) @ Wp folded into one affine map: X @ Wp - (mu @ Wp).
+    # No (n, d) centered temporary, no per-call factor-ladder replay (Wp
+    # is materialized ONCE at fit time — support_matrix docstring), and
+    # no |x - mu|^2 pass: the argmin is invariant to the per-row x2
+    # constant the epilogue would add back, and labels are all this
+    # program returns, so x2=0 skips a full read-square-reduce sweep
+    # over X — measured, this halves staging cost at the bench shape.
+    Zp = X @ Wp.astype(X.dtype) - off[None, :].astype(X.dtype)
+    zero = jnp.zeros((X.shape[0],), jnp.float32)
+    return fused_argmin_min_sketched(Zp, vals, x2=zero)[0]
+
+
+def predict_labels_sketched(X, Wp, off, vals, centers):
+    """Labels for X under a sketched k-means model — THE one assignment
+    program for the sketched family, shared by ``KMeans.fit`` (post-loop
+    labels), ``KMeans.predict``, and the serving runner
+    (parallel/serving.py), so served predictions are bit-identical to
+    direct calls by construction. Dispatches sketched-vs-exact through
+    :func:`sketched_assign_wins` at facade level (shapes are static), so
+    the jitted program itself stays branch-free and compiles once per
+    shape bucket). ``Wp`` is the fit-time-materialized (d, p) support
+    slice of the learned transform and ``off = mu @ Wp`` its centering
+    offset — the weighted data mean the fit centered on before
+    sketching folds into the staging matmul as an affine shift (k-means
+    geometry is translation-invariant; centering keeps the shared-mean
+    direction from eating support budget). The dense ``centers`` are
+    the reconstruction with the mean added back, so both dispatch
+    branches assign to the SAME model and return identical labels."""
+    n, d = X.shape
+    k = vals.shape[0]
+    p = Wp.shape[1]
+    if sketched_assign_wins(n, k, d, p):
+        return _predict_sketched_fast(X, Wp, off, vals)
+    return predict_labels(X, centers)
 
 
 @jax.jit

@@ -1,0 +1,334 @@
+"""Learned fast-transform operator family for sketched k-means
+(QuicK-means, Giffon et al., arxiv 1908.08713).
+
+The exact assignment contraction is O(n·k·d). QuicK-means replaces the
+dense center matrix C (k, d) with ``C ≈ G · Wᵀ`` where W is a product of
+SPARSE orthogonal factors (a learned fast transform) and G is a k-row
+sketch supported on p ≪ d transform columns. Because W is orthogonal,
+``‖x − Wᵀg‖² = ‖Wx − g‖²``: transform the DATA once (O(n·d·log d),
+amortized over every subsequent assignment), and each assignment pass
+becomes a (n, p) × (p, k) contraction — O(n·k·p) instead of O(n·k·d).
+
+The operator here is a product of Givens BUTTERFLY sweeps interleaved
+with fixed permutations. One sweep is log₂(d_pad) levels; level ℓ pairs
+lanes at stride 2^ℓ inside groups of 2·stride and rotates each pair by
+its own angle, so every trainable factor is exactly 2-sparse per row and
+the whole product stays orthogonal by construction (no projection step
+needed to keep the factors feasible, unlike free-form palm4MSA sparse
+factors). A single butterfly ladder can only mix lanes at power-of-two
+distances, which leaves residual center energy stranded at the other
+distances — the classic FFT fix applies: put a fixed (non-trainable)
+permutation in front of every sweep after the first, exactly the role
+bit-reversal plays inside the FFT factorization. Permutations are
+orthogonal and cost one gather, so the product stays fast and exactly
+invertible; sweep r's permutation is derived deterministically from r
+(``jax.random.permutation(PRNGKey(r), d_pad)``, identity for r = 0), so
+it is part of the operator *family*, not a stored parameter.
+
+The sketch G uses one GLOBAL column support shared by all k centers
+(``support`` (p,) distinct transform columns + dense ``vals`` (k, p))
+rather than per-center sparsity: a shared support turns assignment into a
+dense gather-then-matmul that wins on any backend (per-center supports
+need a gather per nonzero and lose to the dense contraction on memory
+traffic), and it makes the sketched Lloyd M-step exact — restricting the
+transformed data to ``support`` and running the ORDINARY weighted-mean
+M-step there IS the full-space M-step followed by re-projection onto the
+transform product (mean of restrictions == restriction of the mean).
+
+Fitting (:func:`palm4msa_fit`) is the palm4MSA alternation of QuicK-means
+specialized to this parameterization, with both blocks solved in CLOSED
+FORM. The angle block is one parallel-Jacobi sweep: for each lane pair
+(a, b) the angle that maximizes the energy concentrated in the a-lane of
+the transformed centers is ``θ = −½·atan2(2·S_ab, S_aa − S_bb)`` (the
+2×2 symmetric eigenproblem), computed for every pair of every level from
+the paired column statistics of the current transformed centers. The
+sketch block is the exact prox: top-p transform columns by total center
+energy — for an orthogonal W the off-support column energy IS the
+squared reconstruction error. A sweep-granular monotone accept keeps the
+best prefix of sweeps (including the zero-sweep identity), so the fit
+can never end worse than its identity init: with ``p ≥`` the number of
+energetic columns the identity start is already a zero-loss fixed point
+and the fit returns it unchanged, angles exactly zero.
+
+Compute precision follows the policy facade
+(:func:`dask_ml_tpu.parallel.precision.fast_transform_dtype`): the factor
+fit and the transform application run at an f32 floor regardless of the
+bf16 data wire — rotation angles are solver state, exactly the
+silent-low-precision-state case ``state_dtype`` exists to close — and
+:func:`ft_apply` casts back to the data dtype on the way out so the
+staging wire contract is preserved.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _pad_dim(d: int) -> int:
+    """Smallest power of two ≥ d (min 2): the butterfly levels need a
+    power-of-two lane count; extra columns are zero-padded and carry no
+    energy (the top-p support never selects them at identity)."""
+    return max(2, 1 << (int(d) - 1).bit_length())
+
+
+@jax.tree_util.register_pytree_node_class
+class FastTransform:
+    """A product of Givens butterfly sweeps over ``d_pad`` lanes with a
+    fixed permutation in front of every sweep after the first, acting on
+    row vectors: ``z = ft_apply(ft, x)`` computes ``x · Wᵀ`` level by
+    level. ``angles`` is the (n_sweeps · log₂(d_pad), d_pad//2)
+    trainable parameter array — row ℓ holds the rotation angle of every
+    lane pair at stride ``2^(ℓ mod log₂ d_pad)``; the permutations are
+    derived from the sweep index and carry no parameters. Registered as
+    a pytree (angles are the children, the static (d, d_pad) the aux
+    data), so the object passes through ``jax.jit``/``jax.grad`` like
+    any array."""
+
+    def __init__(self, angles, d: int, d_pad: int):
+        self.angles = angles  # (n_sweeps * log2(d_pad), d_pad // 2)
+        self.d = int(d)
+        self.d_pad = int(d_pad)
+
+    @property
+    def levels(self) -> int:
+        return self.angles.shape[0]
+
+    def tree_flatten(self):
+        return (self.angles,), (self.d, self.d_pad)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], *aux)
+
+
+def identity(d: int) -> FastTransform:
+    """The one-sweep zero-angle transform: sweep 0 has no permutation
+    and ``cos 0 = 1``/``sin 0 = 0`` are exact in every float dtype, so
+    ``ft_apply`` is the exact identity (modulo zero-padding)."""
+    dp = _pad_dim(d)
+    levels = dp.bit_length() - 1
+    return FastTransform(jnp.zeros((levels, dp // 2), jnp.float32), d, dp)
+
+
+def _sweep_perm(r: int, d_pad: int):
+    """Fixed lane permutation in front of sweep r (None ⇒ identity for
+    sweep 0). Derived deterministically from the sweep index so the
+    permutations are structure, not state — every caller (fit, apply,
+    transpose) reconstructs the same sequence."""
+    if r == 0:
+        return None
+    return jax.random.permutation(jax.random.PRNGKey(r), d_pad)
+
+
+def _rotate_level(Z, theta, stride: int):
+    """One butterfly factor: pair lanes (i, i + stride) inside groups of
+    2·stride and rotate each pair by its own angle. The reshape makes the
+    2-sparsity structural — the factor never materializes."""
+    n, dp = Z.shape
+    g = dp // (2 * stride)
+    Zr = Z.reshape(n, g, 2, stride)
+    th = theta.reshape(1, g, stride).astype(Z.dtype)
+    c, s = jnp.cos(th), jnp.sin(th)
+    a, b = Zr[:, :, 0, :], Zr[:, :, 1, :]
+    return jnp.stack([c * a - s * b, s * a + c * b],
+                     axis=2).reshape(n, dp)
+
+
+def _apply_levels(Z, angles, d_pad: int, transpose: bool):
+    """Shared forward/transpose ladder: the transpose of an orthogonal
+    product is its inverse — the same factors with negated angles in
+    reverse order and inverse permutations (ONE definition, so
+    apply/apply_t can never drift, and the FIT applies exactly this
+    ladder, so fit and inference can't drift either)."""
+    n_levels = int(angles.shape[0])
+    L = d_pad.bit_length() - 1
+    n_sweeps = n_levels // L
+    if transpose:
+        for r in range(n_sweeps - 1, -1, -1):
+            for lvl in range(L - 1, -1, -1):
+                Z = _rotate_level(Z, -angles[r * L + lvl], 1 << lvl)
+            prm = _sweep_perm(r, d_pad)
+            if prm is not None:
+                Z = jnp.take(Z, jnp.argsort(prm), axis=1)
+    else:
+        for r in range(n_sweeps):
+            prm = _sweep_perm(r, d_pad)
+            if prm is not None:
+                Z = jnp.take(Z, prm, axis=1)
+            for lvl in range(L):
+                Z = _rotate_level(Z, angles[r * L + lvl], 1 << lvl)
+    return Z
+
+
+def _pad_cols(X, d_pad: int):
+    d = X.shape[1]
+    if d == d_pad:
+        return X
+    return jnp.pad(X, ((0, 0), (0, d_pad - d)))
+
+
+def ft_apply(ft: FastTransform, X):
+    """``X (n, d) → Z (n, d_pad)``: zero-pad to the butterfly width and
+    run the factor ladder at the policy compute dtype (f32 floor —
+    :func:`~dask_ml_tpu.parallel.precision.fast_transform_dtype`), then
+    cast back to the data dtype so the staging wire is preserved. For
+    the one-sweep zero-angle :func:`identity` transform this is the
+    exact identity on the first d columns."""
+    from dask_ml_tpu.parallel.precision import fast_transform_dtype
+
+    ct = fast_transform_dtype(X.dtype)
+    Z = _pad_cols(X, ft.d_pad).astype(ct)
+    Z = _apply_levels(Z, ft.angles, ft.d_pad, transpose=False)
+    return Z.astype(X.dtype)
+
+
+def ft_apply_t(ft: FastTransform, Z):
+    """``Z (n, d_pad) → (n, d_pad)`` through ``W`` (the transpose ladder
+    — for this orthogonal product, also the inverse: ``ft_apply_t(ft,
+    ft_apply(ft, X))`` recovers X up to roundoff, exactly at zero
+    angles). Callers wanting data-space rows slice ``[:, :ft.d]``."""
+    from dask_ml_tpu.parallel.precision import fast_transform_dtype
+
+    ct = fast_transform_dtype(Z.dtype)
+    out = _apply_levels(Z.astype(ct), ft.angles, ft.d_pad, transpose=True)
+    return out.astype(Z.dtype)
+
+
+def sketch_project(ft: FastTransform, centers, p: int):
+    """The EXACT sketch prox for a fixed transform: transform the centers,
+    keep the p columns with the largest total energy (one shared support —
+    see the module docstring for why global, not per-center), restrict.
+    Returns ``(support (p,) int32 sorted distinct, vals (k, p) f32)``.
+    For orthogonal W the dropped energy ``Σ_offsupport T²`` IS the
+    squared reconstruction error — this step is optimal, not heuristic."""
+    T = ft_apply(ft, centers.astype(jnp.float32))  # (k, d_pad) f32
+    energy = jnp.sum(T * T, axis=0)
+    p = min(int(p), ft.d_pad)
+    _, support = jax.lax.top_k(energy, p)
+    support = jnp.sort(support).astype(jnp.int32)
+    return support, jnp.take(T, support, axis=1)
+
+
+def support_matrix(ft: FastTransform, support):
+    """Dense (d, p) slice ``Wᵀ[:d, support]`` of the transform: the thin
+    matrix that maps raw data rows straight to their support-restricted
+    transform coordinates, ``Z_p = (X − μ) @ support_matrix(ft, s)``.
+
+    This is the production staging path. Running the factor ladder over
+    the data costs O(n·d_pad) PER LEVEL — sweeps·log₂(d_pad)
+    memory-bound passes that dwarf the assignment contraction being
+    bought. But only p ≪ d_pad transform columns are ever consumed, so
+    materializing the slice once (apply the ladder to the identity —
+    O(d_pad²·levels), independent of n) turns staging into a single
+    O(n·d·p) matmul on the MXU. The fast-transform STRUCTURE still does
+    its job where it pays: the fit touches only the k center rows and
+    stores O(d log d) angles instead of a dense d×d rotation."""
+    E = jnp.eye(ft.d_pad, dtype=jnp.float32)
+    Wt = _apply_levels(E, ft.angles, ft.d_pad, transpose=False)
+    return jnp.take(Wt[: ft.d, :], support, axis=1)
+
+
+def reconstruct(ft: FastTransform, vals, support):
+    """Dense data-space centers ``Ĉ = G · Wᵀ`` (k, d) from a sketch:
+    scatter onto the support, run the transpose ladder, drop padding."""
+    k = vals.shape[0]
+    G = jnp.zeros((k, ft.d_pad), jnp.float32)
+    G = G.at[:, support].set(vals.astype(jnp.float32))
+    return ft_apply_t(ft, G)[:, : ft.d]
+
+
+def sketch_loss(ft: FastTransform, centers, support):
+    """Squared reconstruction error of the support-restricted sketch at
+    the current angles — by orthogonality, the off-support column energy
+    of the transformed centers (no reconstruction pass needed)."""
+    T = ft_apply(ft, centers.astype(jnp.float32))
+    keep = jnp.zeros((ft.d_pad,), jnp.float32).at[support].set(1.0)
+    off = T * (1.0 - keep)[None, :]
+    return jnp.sum(off * off)
+
+
+@partial(jax.jit, static_argnames=("p", "n_sweeps", "d", "d_pad"))
+def _palm4msa_impl(Cp, *, p: int, n_sweeps: int, d: int, d_pad: int):
+    L = d_pad.bit_length() - 1
+    k = Cp.shape[0]
+
+    def off_top_energy(T):
+        en = jnp.sum(T * T, axis=0)
+        return jnp.sum(en) - jnp.sum(jax.lax.top_k(en, p)[0])
+
+    # Run every sweep, recording the sketch loss after each. Each level's
+    # angle is the closed-form 2×2 concentrator for its lane pairs:
+    # θ = −½·atan2(2·S_ab, S_aa − S_bb) maximizes the post-rotation
+    # a-lane energy Σ_centers a'², i.e. one parallel-Jacobi step on the
+    # center column-energy matrix restricted to this level's pairing.
+    T = Cp
+    losses = [off_top_energy(T)]
+    rows = []
+    for r in range(n_sweeps):
+        prm = _sweep_perm(r, d_pad)
+        if prm is not None:
+            T = jnp.take(T, prm, axis=1)
+        for lvl in range(L):
+            stride = 1 << lvl
+            g = d_pad // (2 * stride)
+            Tr = T.reshape(k, g, 2, stride)
+            a, b = Tr[:, :, 0, :], Tr[:, :, 1, :]
+            Saa = jnp.sum(a * a, axis=0)
+            Sbb = jnp.sum(b * b, axis=0)
+            Sab = jnp.sum(a * b, axis=0)
+            th = (-0.5 * jnp.arctan2(2.0 * Sab, Saa - Sbb)).reshape(-1)
+            rows.append(th)
+            T = _rotate_level(T, th, stride)
+        losses.append(off_top_energy(T))
+
+    # Monotone accept at sweep granularity: keep the best prefix of
+    # sweeps (argmin takes the FIRST minimum, so exact ties fall back to
+    # the earlier — ultimately the identity — state). Zeroed trailing
+    # sweeps still permute, but a permutation can't change the column
+    # energy multiset, so the kept loss is exactly the recorded one.
+    # Clamp at zero before the argmin: the loss is mathematically >= 0,
+    # but f32 sum-minus-top_k can round a later sweep to a tiny negative
+    # and steal the tie from the identity state.
+    losses = jnp.maximum(jnp.stack(losses), 0.0)
+    best = jnp.argmin(losses)
+    keep = (jnp.arange(n_sweeps * L) // L) < best
+    angles = jnp.stack(rows) * keep[:, None].astype(Cp.dtype)
+
+    # Exact sketch prox for the accepted transform, computed through the
+    # SAME ladder inference uses (no fit/apply drift possible).
+    T2 = _apply_levels(Cp, angles, d_pad, transpose=False)
+    en = jnp.sum(T2 * T2, axis=0)
+    _, support = jax.lax.top_k(en, p)
+    support = jnp.sort(support).astype(jnp.int32)
+    vals = jnp.take(T2, support, axis=1)
+    loss = jnp.maximum(jnp.sum(en) - jnp.sum(jnp.take(en, support)), 0.0)
+    return angles, support, vals, loss
+
+
+def palm4msa_fit(centers, p: int, *, n_iter: int = 8):
+    """Fit ``(transform, support, vals)`` to dense centers (k, d) by the
+    closed-form palm4MSA alternation (see module docstring): ``n_iter``
+    permutation-interleaved Jacobi sweeps on the angles, exact top-p
+    prox on the sketch, best-prefix monotone accept. Never worse than
+    the identity init; identity-EXACT (angles all zero) whenever ``p``
+    covers every energetic column. Returns ``(FastTransform, support
+    (p,) int32, vals (k, p) f32, loss (f32 scalar))``.
+
+    Callers should center the rows they sketch (k-means geometry is
+    translation-invariant and a shared mean component wastes support
+    budget on a direction that cancels in every distance comparison) —
+    the estimator's sketched path subtracts the weighted data mean
+    before fitting and adds it back after reconstruction."""
+    from dask_ml_tpu.parallel.precision import fast_transform_dtype
+
+    d = int(centers.shape[1])
+    dp = _pad_dim(d)
+    ct = fast_transform_dtype(jnp.asarray(centers).dtype)
+    Cp = _pad_cols(jnp.asarray(centers, ct), dp).astype(jnp.float32)
+    p = min(int(p), dp)
+    angles, support, vals, loss = _palm4msa_impl(
+        Cp, p=p, n_sweeps=int(n_iter), d=d, d_pad=dp)
+    return FastTransform(angles, d, dp), support, vals, loss

@@ -26,6 +26,11 @@ slots never need a ``jnp.inf`` re-masking pass over an (n × m) matrix):
   lower bound.
 - :func:`fused_argmin_weight` — per-row argmin plus the per-target sum of
   row weights (the candidate-weighting / M-step-count contraction).
+- :func:`fused_argmin_min_sketched` — argmin + full-space min against
+  SKETCHED targets (a shared transform-column support + dense values —
+  the fast-transform center sketches of ops/fast_transform.py): the
+  contraction runs over the p support columns, O(n·k·p) instead of
+  O(n·k·d) (docs/kernels.md, "Sketched assignment").
 
 Row-level work skipping (``row_need=``): :func:`fused_rowwise_min` and
 :func:`fused_argmin_min2` accept an optional boolean ``row_need`` over X
@@ -211,6 +216,25 @@ def _argmin_min_ref(X, Y, mask):
     return idx, mind
 
 
+def _argmin_min_sk_ref(Zp, vals, x2, mask):
+    """Sketched-assignment reference: targets live in the transform space
+    as dense ``vals`` (k, p) on one shared column support — see
+    ops/fast_transform.py. ``Zp`` (n, p) is the data already restricted
+    to the support columns and the reduction contracts over them only
+    (that is the O(n·k·p) being bought), which is exact for the ARGMIN:
+    restricted and full-space distances to support-sparse targets differ
+    by the per-row constant ``|z_offsupport|²``. The returned VALUE is
+    the true full-space squared distance — the add-back term ``x2`` (n,)
+    is the caller-computed full-space ``|x − μ|²``, not the restricted
+    block's own norm. Same mask/tie-break/all-masked contracts as the
+    rest of the family; the support entries must be distinct
+    (sketch_project guarantees it) or the ``|y|²`` term double-counts."""
+    s = _scores_ref(Zp, vals, mask)
+    idx = jnp.argmin(s, axis=1).astype(jnp.int32)
+    mind = jnp.maximum(jnp.min(s, axis=1) + x2, 0.0)
+    return idx, mind
+
+
 def _argmin_min2_ref(X, Y, mask):
     """(argmin, min d², second-best d²) — the reduction scores' best value
     and the best value with the argmin column masked out. With m == 1 (or
@@ -302,6 +326,34 @@ def _blocked_xla(X, Y, mask, row_need, epilogue: str):
             mind2.reshape(-1)[:n])
 
 
+def _blocked_xla_sk(Zp, vals, x2, mask, row_need):
+    """The sketched analogue of :func:`_blocked_xla` (same ``lax.map`` +
+    scalar ``lax.cond`` blocking, same skip identities — zeros for the
+    argmin consumer, overlaid via :func:`row_block_evaluated`)."""
+    n, p = Zp.shape
+    nb, n_pad = _row_blocks(n)
+    blk = _FUSED_BLK
+    Zpp = jnp.pad(Zp, ((0, n_pad - n), (0, 0))) if n_pad != n else Zp
+    x2p = jnp.pad(x2, (0, n_pad - n)) if n_pad != n else x2
+    needp = (jnp.pad(row_need, (0, n_pad - n))
+             if n_pad != n else row_need)
+    Zb = Zpp.reshape(nb, blk, p)
+    x2b = x2p.reshape(nb, blk)
+    needb = needp.reshape(nb, blk)
+
+    def one(args):
+        zb, xb, nd = args
+        return jax.lax.cond(
+            jnp.any(nd),
+            lambda z, x: _argmin_min_sk_ref(z, vals, x, mask),
+            lambda z, x: (jnp.zeros((blk,), jnp.int32),
+                          jnp.zeros((blk,), jnp.float32)),
+            zb, xb)
+
+    idx, mind = jax.lax.map(one, (Zb, x2b, needb))
+    return idx.reshape(-1)[:n], mind.reshape(-1)[:n]
+
+
 def _argmin_weight_ref(X, w, Y, mask):
     s = _scores_ref(X, Y, mask)
     idx = jnp.argmin(s, axis=1).astype(jnp.int32)
@@ -323,7 +375,7 @@ def _argmin_weight_ref(X, w, Y, mask):
 # ---------------------------------------------------------------------------
 
 
-def _fused_pallas(X, Y, maskf, w2d, epilogue: str, need2d=None):
+def _fused_pallas(X, Y, maskf, w2d, epilogue: str, need2d=None, x2d=None):
     """One pass over row blocks of X with the whole (m, d) Y resident in
     VMEM. Per block: scores on the MXU in (m, blk) layout (m on sublanes —
     the block's minor dim stays the 128-lane-aligned ``blk``), then the
@@ -334,10 +386,16 @@ def _fused_pallas(X, Y, maskf, w2d, epilogue: str, need2d=None):
 
     ``maskf`` is the (m, 1) f32 validity mask (1=real row); ``w2d`` the
     (1, n) f32 row weights (``epilogue='argmin_weight'`` only); ``need2d``
-    the optional (1, n) f32 row-need vector (``'min'``/``'argmin_min2'``
-    only): grid steps none of whose rows need evaluation skip the matmul +
-    epilogue under ``pl.when`` and write the reduction identity instead —
-    only the tiny need-block read reaches VMEM for a skipped block.
+    the optional (1, n) f32 row-need vector (``'min'``/``'argmin_min'``/
+    ``'argmin_min2'``): grid steps none of whose rows need evaluation skip
+    the matmul + epilogue under ``pl.when`` and write the reduction
+    identity instead — only the tiny need-block read reaches VMEM for a
+    skipped block. ``x2d`` (optional (1, n) f32, ``'argmin_min'`` only) is
+    an externally-computed per-row ``|x|²`` used in place of the block's
+    own: the sketched-assignment consumer contracts over the SUPPORT
+    columns but owes the caller full-space squared distances, so the
+    add-back term comes from the full transformed row, not the gathered
+    block (:func:`fused_argmin_min_sketched`).
     """
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -357,7 +415,10 @@ def _fused_pallas(X, Y, maskf, w2d, epilogue: str, need2d=None):
             else:
                 am_ref, mn_ref, mn2_ref = rest
         elif epilogue == "argmin_min":
-            am_ref, mn_ref = rest
+            rrest = list(rest)
+            need_ref = rrest.pop(0) if need2d is not None else None
+            x2_ref = rrest.pop(0) if x2d is not None else None
+            am_ref, mn_ref = rrest
         else:  # "min"
             if need2d is not None:
                 need_ref, mn_ref = rest
@@ -385,7 +446,8 @@ def _fused_pallas(X, Y, maskf, w2d, epilogue: str, need2d=None):
                 else:
                     am_ref[:] = jnp.zeros_like(am_ref)
                     mn_ref[:] = jnp.zeros_like(mn_ref)
-                    mn2_ref[:] = jnp.zeros_like(mn2_ref)
+                    if epilogue == "argmin_min2":
+                        mn2_ref[:] = jnp.zeros_like(mn2_ref)
 
         def block_scores():
             # the ONE definition of the block's masked scores, shared by
@@ -440,12 +502,19 @@ def _fused_pallas(X, Y, maskf, w2d, epilogue: str, need2d=None):
             if epilogue == "argmin_min":
                 best = jnp.argmin(scores, axis=0, keepdims=True)
                 am_ref[:] = best.astype(jnp.int32)
-            # min value: add the per-row |x|² back, clamp cancellation at 0
-            x2 = row_x2(Xb)
+            # min value: add the per-row |x|² back, clamp cancellation at
+            # 0. The sketched consumer supplies its own full-space |x|²
+            # (select OOB lanes of the final partial block to 0 — their
+            # contents are undefined, the 0·NaN discipline again).
+            if x2d is not None:
+                x2 = jnp.where(valid_col, x2_ref[:], 0.0)
+            else:
+                x2 = row_x2(Xb)
             mn_ref[:] = jnp.maximum(
                 jnp.min(scores, axis=0, keepdims=True) + x2, 0.0)
 
-        if epilogue in ("min", "argmin_min2") and need2d is not None:
+        if (epilogue in ("min", "argmin_min", "argmin_min2")
+                and need2d is not None):
             pl.when(evaluate)(compute)
             return
         if epilogue in ("min", "argmin_min", "argmin_min2"):
@@ -506,17 +575,25 @@ def _fused_pallas(X, Y, maskf, w2d, epilogue: str, need2d=None):
         )(Yc, y2f, maskf, X, w2d)
         return am[0], cw[:, 0]
     if epilogue == "argmin_min":
+        in_specs = [y_spec, col_spec, col_spec, x_spec]
+        args = [Yc, y2f, maskf, X]
+        if need2d is not None:
+            in_specs.append(row_spec)
+            args.append(need2d)
+        if x2d is not None:
+            in_specs.append(row_spec)
+            args.append(x2d)
         am, mn = pl.pallas_call(
             kernel,
             grid=(grid,),
-            in_specs=[y_spec, col_spec, col_spec, x_spec],
+            in_specs=in_specs,
             out_specs=[row_spec, row_spec],
             out_shape=[
                 jax.ShapeDtypeStruct((1, n), jnp.int32),
                 jax.ShapeDtypeStruct((1, n), jnp.float32),
             ],
             interpret=interpret,
-        )(Yc, y2f, maskf, X)
+        )(*args)
         return am[0], mn[0]
     if epilogue == "argmin_min2":
         in_specs = [y_spec, col_spec, col_spec, x_spec]
@@ -644,6 +721,103 @@ def fused_argmin_min(X, Y, mask=None, *, kernel: str = "auto", mesh=None):
         mesh=mesh, in_specs=(d2, P(), P()),
         out_specs=(d1, d1), check_vma=False)
     return fn(X, Y, maskf)
+
+
+def fused_argmin_min_sketched(Z, vals, support=None, mask=None, *,
+                              x2=None, kernel: str = "auto", mesh=None,
+                              row_need=None):
+    """Per-row (argmin index int32, min FULL-SPACE squared distance f32)
+    against SKETCHED targets ``vals`` (k, p) living on one shared
+    transform-column support (see ops/fast_transform.py). The
+    contraction runs over the p support columns — O(n·k·p) instead of
+    O(n·k·d) — which is exact for the argmin (restricted and full
+    distances differ per row by the constant off-support energy); the
+    returned value is the true full-space d² (the full-space ``|x − μ|²``
+    is added back, then clamped at 0).
+
+    Two input modes. With ``support`` (p,) int32 (entries distinct),
+    ``Z`` (n, d_pad) is the fully fast-transformed data
+    (:func:`~dask_ml_tpu.ops.fast_transform.ft_apply`) and the gather +
+    full-row ``|z|²`` happen here (both row-wise, so GSPMD shards them
+    with Z). With ``support=None``, ``Z`` IS the already-restricted
+    (n, p) block — the staging that matters in production, where the
+    thin transform slice is applied as one matmul
+    (:func:`~dask_ml_tpu.ops.fast_transform.support_matrix`) and the
+    full (n, d_pad) array never exists — and ``x2`` (n,) f32, the
+    caller's full-space ``|x − μ|²``, is then REQUIRED (orthogonality
+    makes it equal to the untaken ``|z|²``). ``x2`` may also be passed
+    alongside ``support`` to skip the recompute.
+
+    Same family contracts as :func:`fused_argmin_min`: ties break to the
+    lowest index identically across implementations, masked target rows
+    never win, all-masked returns (0, +inf). ``row_need`` enables the
+    block-wise row skipping of :func:`fused_argmin_min2` (skipped blocks
+    return zeros — overlay via :func:`row_block_evaluated`). The pallas
+    path keeps the gather OUTSIDE the kernel (Mosaic has no dynamic lane
+    gather) and feeds the standard argmin_min kernel at (n, k, p) with
+    the full-space norm as an extra row input — so the in-kernel matmul
+    really is the p-wide one, and auto dispatch reuses the measured
+    ``fused.distance.pallas`` regime table at the restricted shape.
+    Whether sketched assignment beats EXACT assignment at a given
+    (n, k, d, p) is a different question, answered by the
+    ``kmeans.sketched.assign`` decision rule
+    (models/kmeans.py ``sketched_assign_wins``)."""
+    k, p = vals.shape
+    if support is not None:
+        Zp = jnp.take(Z, support, axis=1)
+        if x2 is None:
+            x2 = _row_sumsq(Z)
+    else:
+        if x2 is None:
+            raise ValueError(
+                "fused_argmin_min_sketched: support=None means Z is the "
+                "restricted (n, p) block; the full-space |x - mu|^2 must "
+                "then be supplied via x2=")
+        Zp = Z
+    use_pallas = _use_pallas(kernel, Zp.shape[0], k, p, Zp.dtype, mesh)
+    if row_need is None:
+        if not use_pallas:
+            return _argmin_min_sk_ref(Zp, vals, x2, mask)
+        maskf = _maskf(mask, k)
+        x2d = x2[None, :]
+        if mesh is None:
+            return _fused_pallas(Zp, vals, maskf, None, "argmin_min",
+                                 x2d=x2d)
+        from dask_ml_tpu.parallel.mesh import shard_map
+
+        d2, d1, d1m = _row_specs(mesh)
+        fn = shard_map(
+            lambda Zl, Yl, ml, xl: _fused_pallas(Zl, Yl, ml, None,
+                                                 "argmin_min", x2d=xl),
+            mesh=mesh, in_specs=(d2, P(), P(), d1m),
+            out_specs=(d1, d1), check_vma=False)
+        return fn(Zp, vals, maskf, x2d)
+    if not use_pallas:
+        if mesh is None:
+            return _blocked_xla_sk(Zp, vals, x2, mask, row_need)
+        from dask_ml_tpu.parallel.mesh import shard_map
+
+        d2, d1, _ = _row_specs(mesh)
+        fn = shard_map(
+            lambda Zl, xl, nl: _blocked_xla_sk(Zl, vals, xl, mask, nl),
+            mesh=mesh, in_specs=(d2, d1, d1),
+            out_specs=(d1, d1), check_vma=False)
+        return fn(Zp, x2, row_need)
+    maskf = _maskf(mask, k)
+    x2d = x2[None, :]
+    need2d = row_need.astype(jnp.float32)[None, :]
+    if mesh is None:
+        return _fused_pallas(Zp, vals, maskf, None, "argmin_min",
+                             need2d=need2d, x2d=x2d)
+    from dask_ml_tpu.parallel.mesh import shard_map
+
+    d2, d1, d1m = _row_specs(mesh)
+    fn = shard_map(
+        lambda Zl, Yl, ml, nl, xl: _fused_pallas(
+            Zl, Yl, ml, None, "argmin_min", need2d=nl, x2d=xl),
+        mesh=mesh, in_specs=(d2, P(), P(), d1m, d1m),
+        out_specs=(d1, d1), check_vma=False)
+    return fn(Zp, vals, maskf, need2d, x2d)
 
 
 def fused_argmin_min2(X, Y, mask=None, *, kernel: str = "auto", mesh=None,

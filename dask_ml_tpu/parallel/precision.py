@@ -71,6 +71,7 @@ __all__ = [
     "resolve",
     "state_dtype",
     "lloyd_bounds_dtype",
+    "fast_transform_dtype",
     "pdot",
     "pmatmul",
     "neumaier_add",
@@ -241,6 +242,28 @@ def lloyd_bounds_dtype(data_dtype, policy=None):
     p = resolve() if policy is None else policy
     base = state_dtype(data_dtype, accum=p.accum)
     override = p.compute_for("lloyd_bounds")
+    if override is None:
+        return base
+    return jnp.promote_types(state_dtype(override), base)
+
+
+def fast_transform_dtype(data_dtype, policy=None):
+    """Compute dtype of the fast-transform factor fits and applications
+    (:mod:`dask_ml_tpu.ops.fast_transform`) under the active policy: the
+    ``"fast_transform"`` op override when the policy sets one, else
+    :func:`state_dtype` of the data dtype — and in EITHER case never
+    below f32, exactly the :func:`lloyd_bounds_dtype` contract. Rotation
+    angles and the palm4MSA loss ladder are SOLVER STATE: the sketched
+    quality gates (inertia-ratio, ARI — bench.py ``--sketch``) budget for
+    the approximation error of the p-column sketch, not for bf16 drift in
+    the factors themselves, so the bf16 wire policy must not narrow the
+    fit (``fast_transform: bf16`` still yields f32). The override can
+    only *raise* the floor (f64 for an audit fit). Resolved at FACADE
+    level; :func:`~dask_ml_tpu.ops.fast_transform.ft_apply` casts back to
+    the data dtype on exit so the staging wire is unchanged."""
+    p = resolve() if policy is None else policy
+    base = state_dtype(data_dtype, accum=p.accum)
+    override = p.compute_for("fast_transform")
     if override is None:
         return base
     return jnp.promote_types(state_dtype(override), base)

@@ -217,6 +217,22 @@ def _kmeans_runners(est) -> dict:
 
     from dask_ml_tpu.models import kmeans as km_core
 
+    if getattr(est, "fast_transform_", None) is not None:
+        # sketched model: serve through the SAME dispatch facade
+        # KMeans.predict uses (against sketch_centers_, so whichever
+        # branch the decisions cache picks, served labels are
+        # bit-identical to direct predict calls by construction)
+        sketch_args = est._sketch_args()
+
+        def run(Xs):
+            labels = km_core.predict_labels_sketched(Xs, *sketch_args)
+            if int(est.n_clusters) <= 255:
+                return np.asarray(
+                    labels.astype(jnp.uint8)).astype(np.int32)
+            return np.asarray(labels)
+
+        return {"predict": _Runner("device", run)}
+
     centers = jnp.asarray(est.cluster_centers_)
 
     def run(Xs):
@@ -275,12 +291,21 @@ def _build_runners(est, methods=None) -> dict:
     served surface; by default every servable method the family supports
     is exposed."""
     from dask_ml_tpu.cluster.k_means import KMeans
+    from dask_ml_tpu.cluster.kernel_kmeans import KernelKMeans
+    from dask_ml_tpu.cluster.minibatch import MiniBatchKMeans
     from dask_ml_tpu.cluster.spectral import SpectralClustering
     from dask_ml_tpu.decomposition.pca import PCA
     from dask_ml_tpu.linear_model.glm import _GLM
 
     if isinstance(est, KMeans):
         runners = _kmeans_runners(est)
+    elif isinstance(est, MiniBatchKMeans):
+        # same fitted surface as KMeans (cluster_centers_, n_clusters,
+        # never sketched), so the same staged runner serves it
+        runners = _kmeans_runners(est)
+    elif isinstance(est, KernelKMeans):
+        # landmark assignment program, shared with predict (bit-equal)
+        runners = _spectral_runners(est)
     elif isinstance(est, SpectralClustering):
         km = getattr(est, "assign_labels_", None)
         if isinstance(km, KMeans) and not callable(est.affinity):
@@ -309,8 +334,10 @@ def _build_runners(est, methods=None) -> dict:
 
 def _n_features_of(est) -> Optional[int]:
     for attr, width in (
-        ("cluster_centers_", lambda a: a.shape[1]),
+        # landmark models first: their cluster_centers_ live in the
+        # l-dimensional Nyström feature space, not the input space
         ("_landmarks_", lambda a: a.shape[1]),
+        ("cluster_centers_", lambda a: a.shape[1]),
         ("mean_", lambda a: a.shape[0]),
     ):
         a = getattr(est, attr, None)

@@ -32,9 +32,10 @@ SECTIONS = [
      "or streamed (incremental) training."),
     ("dask_ml_tpu.cluster", "Clustering",
      "Scalable KMeans (k-means|| + fused Lloyd, with bound-based "
-     "Elkan/Yinyang pruning via `algorithm='bounded'` — see "
-     "docs/kernels.md), Nyström spectral clustering, and streaming "
-     "mini-batch KMeans."),
+     "Elkan/Yinyang pruning via `algorithm='bounded'` and the learned "
+     "fast-transform sketch via `algorithm='sketched'` — see "
+     "docs/kernels.md), Nyström spectral clustering, Nyström kernel "
+     "k-means, and streaming mini-batch KMeans."),
     ("dask_ml_tpu.decomposition", "Matrix Decomposition",
      "PCA / TruncatedSVD via distributed tall-skinny QR and randomized "
      "SVD."),
@@ -52,6 +53,13 @@ SECTIONS = [
      "argmin / weighted-accumulation epilogues) with measured "
      "fused-vs-XLA dispatch — see docs/kernels.md for the family's "
      "design, thresholds, and measurement method."),
+    ("dask_ml_tpu.ops.fast_transform", "Learned fast transforms",
+     "The sketched tier's operator family (docs/kernels.md, \"Sketched "
+     "assignment\"): orthogonal products of sparse Givens/butterfly "
+     "factors fit to center matrices by a palm4MSA-style Jacobi sweep "
+     "loop, with the shared-support sketch (support + per-center "
+     "values) and the fit-time-materialized (d, p) staging slice that "
+     "makes per-batch staging one affine matmul."),
     ("dask_ml_tpu.parallel.shapes", "Shape bucketing & compile observability",
      "Bucketed sample-axis padding — any sample count lands in a small set "
      "of padded sizes with weight-0 (inert) pad rows, so compile counts "
@@ -182,7 +190,13 @@ EXTRA = {
     ],
     "dask_ml_tpu.ops.fused_distance": [
         "fused_rowwise_min", "fused_argmin_min", "fused_argmin_min2",
-        "fused_argmin_weight", "row_block_evaluated",
+        "fused_argmin_weight", "fused_argmin_min_sketched",
+        "row_block_evaluated",
+    ],
+    "dask_ml_tpu.ops.fast_transform": [
+        "FastTransform", "identity", "ft_apply", "ft_apply_t",
+        "sketch_project", "support_matrix", "reconstruct",
+        "sketch_loss", "palm4msa_fit",
     ],
     "dask_ml_tpu.parallel.shapes": [
         "PadPolicy", "active_policy", "bucket_rows", "pad_tail",

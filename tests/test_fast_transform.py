@@ -1,0 +1,305 @@
+"""Property tests for the learned fast-transform operator family
+(ops/fast_transform.py) and its sketched assignment epilogue
+(ops/fused_distance.py): orthogonality/roundtrip invariants of the
+butterfly-with-permutations product, structural 2-sparsity of every
+trainable factor, identity-init EXACTNESS of the palm4MSA fit whenever
+the support covers all energetic columns (the monotone-accept guarantee
+— the fit can never end worse than doing nothing), monotone improvement
+on problems the identity cannot solve, support_matrix consistency with
+the factor ladder, and the sketched epilogue's mask/tie-break/row_need
+contracts against the jnp reference — with the Pallas path in INTERPRET
+mode on the CPU CI mesh (the sketch CI job runs exactly this file), and
+the f32-floor precision facade under a bf16 data wire."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dask_ml_tpu.ops import fast_transform as ftm
+from dask_ml_tpu.ops import fused_distance as fd
+
+
+@pytest.fixture(autouse=True)
+def small_blocks():
+    """Multi-block grids even at test sizes (same discipline as
+    tests/test_fused_distance.py)."""
+    old = fd._FUSED_BLK
+    fd._FUSED_BLK = 64
+    yield
+    fd._FUSED_BLK = old
+
+
+# odd widths exercise the zero-padding to the butterfly power-of-two
+DIMS = [3, 8, 13, 41, 64]
+
+
+def _rand(n, d, seed=0):
+    return jnp.asarray(
+        np.random.RandomState(seed).randn(n, d), jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# operator invariants
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("d", DIMS)
+def test_identity_transform_is_exact(d):
+    """Sweep 0 has no permutation and zero angles give exact cos/sin, so
+    the identity transform is bit-exact, not just close."""
+    X = _rand(17, d)
+    Z = ftm.ft_apply(ftm.identity(d), X)
+    assert Z.shape == (17, ftm._pad_dim(d))
+    np.testing.assert_array_equal(np.asarray(Z[:, :d]), np.asarray(X))
+    np.testing.assert_array_equal(np.asarray(Z[:, d:]), 0.0)
+
+
+@pytest.mark.parametrize("d", DIMS)
+@pytest.mark.parametrize("n_sweeps", [1, 3])
+def test_orthogonality_and_roundtrip(d, n_sweeps):
+    """Random angles: the product must stay exactly orthogonal in
+    structure — norms preserved, transpose ladder inverts forward."""
+    dp = ftm._pad_dim(d)
+    L = dp.bit_length() - 1
+    rng = np.random.RandomState(2)
+    ft = ftm.FastTransform(
+        jnp.asarray(rng.uniform(-np.pi, np.pi, (n_sweeps * L, dp // 2)),
+                    jnp.float32), d, dp)
+    X = _rand(23, d, seed=3)
+    Z = ftm.ft_apply(ft, X)
+    np.testing.assert_allclose(
+        np.asarray(jnp.sum(Z * Z, axis=1)),
+        np.asarray(jnp.sum(X * X, axis=1)), rtol=1e-5)
+    back = ftm.ft_apply_t(ft, Z)[:, :d]
+    np.testing.assert_allclose(np.asarray(back), np.asarray(X),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_factor_two_sparsity():
+    """Each butterfly level mixes a lane with exactly ONE partner: a
+    basis vector through a single level has at most 2 nonzeros, at lane
+    distance equal to the level's stride."""
+    dp = 16
+    L = dp.bit_length() - 1
+    rng = np.random.RandomState(4)
+    for lvl in range(L):
+        stride = 1 << lvl
+        th = jnp.asarray(rng.uniform(-1, 1, (dp // 2,)), jnp.float32)
+        E = ftm._rotate_level(jnp.eye(dp, dtype=jnp.float32), th, stride)
+        nnz_per_row = np.sum(np.abs(np.asarray(E)) > 1e-7, axis=1)
+        assert nnz_per_row.max() <= 2
+        for i, row in enumerate(np.asarray(E)):
+            js = np.nonzero(np.abs(row) > 1e-7)[0]
+            assert all(abs(int(j) - i) in (0, stride) for j in js)
+
+
+def test_support_matrix_matches_ladder():
+    """The production staging slice (d, p) must agree with running the
+    full ladder and gathering the support columns."""
+    d, p = 13, 5
+    dp = ftm._pad_dim(d)
+    L = dp.bit_length() - 1
+    rng = np.random.RandomState(5)
+    ft = ftm.FastTransform(
+        jnp.asarray(rng.uniform(-2, 2, (2 * L, dp // 2)), jnp.float32),
+        d, dp)
+    support = jnp.asarray(sorted(
+        rng.choice(dp, p, replace=False)), jnp.int32)
+    X = _rand(31, d, seed=6)
+    via_slice = X @ ftm.support_matrix(ft, support)
+    via_ladder = jnp.take(ftm.ft_apply(ft, X), support, axis=1)
+    np.testing.assert_allclose(np.asarray(via_slice),
+                               np.asarray(via_ladder),
+                               rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# palm4MSA fit
+# ---------------------------------------------------------------------------
+
+
+def test_fit_identity_exact_when_support_covers():
+    """Centers supported on <= p columns: the identity start is already a
+    zero-loss fixed point and the monotone accept must return it
+    UNCHANGED — angles exactly zero, reconstruction bit-exact."""
+    d, p, k = 16, 6, 5
+    rng = np.random.RandomState(7)
+    C = np.zeros((k, d), np.float32)
+    cols = rng.choice(d, p, replace=False)
+    C[:, cols] = rng.randint(-8, 8, (k, p)).astype(np.float32)
+    ft, support, vals, loss = ftm.palm4msa_fit(jnp.asarray(C), p,
+                                               n_iter=4)
+    np.testing.assert_array_equal(np.asarray(ft.angles), 0.0)
+    assert float(loss) == 0.0
+    np.testing.assert_array_equal(
+        np.asarray(ftm.reconstruct(ft, vals, support)), C)
+
+
+@pytest.mark.parametrize("d,p", [(13, 4), (41, 12)])
+def test_fit_monotone_never_worse_than_identity(d, p):
+    """Dense random centers: the accepted transform's loss is never above
+    the identity sketch's off-top-p energy, and the reported loss equals
+    the actual off-support energy of the accepted transform."""
+    k = 7
+    C = _rand(k, d, seed=8) * jnp.exp(_rand(1, d, seed=9))
+    ft, support, vals, loss = ftm.palm4msa_fit(C, p, n_iter=8)
+    id_loss = float(ftm.sketch_loss(
+        ftm.identity(d), C, ftm.sketch_project(ftm.identity(d), C, p)[0]))
+    assert float(loss) <= id_loss + 1e-4
+    recomputed = float(ftm.sketch_loss(ft, C, support))
+    np.testing.assert_allclose(float(loss), recomputed,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_fit_concentrates_rotated_energy():
+    """A problem the identity CANNOT solve: energy spread by a dense
+    rotation across all columns. The learned transform must recover a
+    large fraction of what the identity sketch drops."""
+    d, p, k = 32, 8, 6
+    rng = np.random.RandomState(10)
+    Q, _ = np.linalg.qr(rng.randn(d, d))
+    sparse = np.zeros((k, d), np.float32)
+    sparse[:, rng.choice(d, p, replace=False)] = rng.randn(k, p)
+    C = jnp.asarray((sparse @ Q.T).astype(np.float32))
+    ft, support, vals, loss = ftm.palm4msa_fit(C, p, n_iter=16)
+    id_loss = float(ftm.sketch_loss(
+        ftm.identity(d), C, ftm.sketch_project(ftm.identity(d), C, p)[0]))
+    assert id_loss > 0.1  # the problem is actually hard for identity
+    assert float(loss) < 0.5 * id_loss
+
+
+def test_fit_bf16_wire_f32_floor():
+    """bf16 centers: the precision facade floors the fit and apply at
+    f32 (angles are solver state), and ft_apply returns the data dtype."""
+    from dask_ml_tpu.parallel.precision import fast_transform_dtype
+
+    assert fast_transform_dtype(jnp.bfloat16) == jnp.float32
+    C16 = _rand(5, 13, seed=11).astype(jnp.bfloat16)
+    ft, support, vals, loss = ftm.palm4msa_fit(C16, 4, n_iter=2)
+    assert ft.angles.dtype == jnp.float32
+    assert vals.dtype == jnp.float32
+    Z = ftm.ft_apply(ft, C16)
+    assert Z.dtype == jnp.bfloat16
+
+
+# ---------------------------------------------------------------------------
+# sketched assignment epilogue (ops/fused_distance.py)
+# ---------------------------------------------------------------------------
+
+
+def _sk_problem(n, k, p, seed=0, d_extra=7):
+    """Integer-valued restricted data + sketch vals (products exact ⇒
+    argmin parity is literal ==), plus a full-space x2 including
+    off-support energy the restricted block cannot see."""
+    rng = np.random.RandomState(seed)
+    Zp = jnp.asarray(rng.randint(-8, 8, (n, p)), jnp.float32)
+    vals = jnp.asarray(rng.randint(-8, 8, (k, p)), jnp.float32)
+    off = jnp.asarray(rng.randint(0, 9, (n,)), jnp.float32)
+    x2 = jnp.sum(Zp * Zp, axis=1) + off
+    mask = jnp.asarray(rng.rand(k) > 0.3)
+    return Zp, vals, x2, mask
+
+
+@pytest.mark.parametrize("n,k,p", [(533, 37, 13), (129, 7, 3),
+                                   (257, 64, 17)])
+def test_sketched_pallas_matches_xla(n, k, p):
+    Zp, vals, x2, mask = _sk_problem(n, k, p)
+    ra, rm = fd.fused_argmin_min_sketched(Zp, vals, x2=x2, mask=mask,
+                                          kernel="xla")
+    pa, pm = fd.fused_argmin_min_sketched(Zp, vals, x2=x2, mask=mask,
+                                          kernel="pallas")
+    np.testing.assert_array_equal(np.asarray(ra), np.asarray(pa))
+    np.testing.assert_array_equal(np.asarray(rm), np.asarray(pm))
+
+
+def test_sketched_value_is_full_space():
+    """The returned min is the TRUE full-space d², not the restricted
+    one: off-support row energy must appear in the value (and never go
+    negative under the clamp)."""
+    Zp, vals, x2, _ = _sk_problem(64, 5, 4, seed=1)
+    a, m = fd.fused_argmin_min_sketched(Zp, vals, x2=x2)
+    d2 = (x2[:, None] - 2.0 * Zp @ vals.T
+          + jnp.sum(vals * vals, axis=1)[None, :])
+    want = jnp.maximum(jnp.min(d2, axis=1), 0.0)
+    np.testing.assert_allclose(np.asarray(m), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(a),
+                                  np.asarray(jnp.argmin(d2, axis=1)))
+
+
+def test_sketched_tie_break_lowest_index():
+    Zp = jnp.zeros((9, 4), jnp.float32)
+    vals = jnp.ones((6, 4), jnp.float32)  # all targets equidistant
+    x2 = jnp.sum(Zp * Zp, axis=1)
+    for kern in ("xla", "pallas"):
+        a, _ = fd.fused_argmin_min_sketched(Zp, vals, x2=x2, kernel=kern)
+        np.testing.assert_array_equal(np.asarray(a), 0)
+
+
+def test_sketched_all_masked_contract():
+    Zp, vals, x2, _ = _sk_problem(33, 4, 3, seed=2)
+    mask = jnp.zeros((4,), bool)
+    for kern in ("xla", "pallas"):
+        a, m = fd.fused_argmin_min_sketched(Zp, vals, x2=x2, mask=mask,
+                                            kernel=kern)
+        np.testing.assert_array_equal(np.asarray(a), 0)
+        assert np.all(np.isinf(np.asarray(m)))
+
+
+def test_sketched_row_need_skips_blocks():
+    """row_need=False blocks return the skip identities (index 0, min 0)
+    — block-granular, via the same row_block_evaluated overlay as the
+    bounded-Lloyd path — and needed blocks are untouched."""
+    n, k, p = 200, 9, 5
+    Zp, vals, x2, mask = _sk_problem(n, k, p, seed=3)
+    need = jnp.asarray(np.arange(n) < 70)  # block 0 needed, block 2 not
+    for kern in ("xla", "pallas"):
+        a, m = fd.fused_argmin_min_sketched(Zp, vals, x2=x2, mask=mask,
+                                            row_need=need, kernel=kern)
+        ra, rm = fd.fused_argmin_min_sketched(Zp, vals, x2=x2, mask=mask,
+                                              kernel="xla")
+        ev = np.asarray(fd.row_block_evaluated(need))
+        np.testing.assert_array_equal(np.asarray(a)[ev],
+                                      np.asarray(ra)[ev])
+        np.testing.assert_array_equal(np.asarray(m)[ev],
+                                      np.asarray(rm)[ev])
+        np.testing.assert_array_equal(np.asarray(a)[~ev], 0)
+        np.testing.assert_array_equal(np.asarray(m)[~ev], 0.0)
+
+
+def test_sketched_support_mode_matches_prerestricted():
+    """The two input modes agree: passing full-width Z + support must
+    equal pre-gathering the support columns and passing x2 explicitly."""
+    n, k, d, p = 129, 8, 21, 6
+    rng = np.random.RandomState(4)
+    Z = jnp.asarray(rng.randint(-8, 8, (n, d)), jnp.float32)
+    vals = jnp.asarray(rng.randint(-8, 8, (k, p)), jnp.float32)
+    support = jnp.asarray(sorted(rng.choice(d, p, replace=False)),
+                          jnp.int32)
+    a1, m1 = fd.fused_argmin_min_sketched(Z, vals, support)
+    Zp = jnp.take(Z, support, axis=1)
+    a2, m2 = fd.fused_argmin_min_sketched(
+        Zp, vals, x2=jnp.sum(Z * Z, axis=1))
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+    np.testing.assert_array_equal(np.asarray(m1), np.asarray(m2))
+
+
+def test_sketched_restricted_mode_requires_x2():
+    Zp = jnp.zeros((8, 4), jnp.float32)
+    vals = jnp.zeros((3, 4), jnp.float32)
+    with pytest.raises(ValueError, match="x2"):
+        fd.fused_argmin_min_sketched(Zp, vals)
+
+
+def test_sketched_bf16_wire():
+    """bf16 restricted block: runs, returns int32/f32, and agrees with
+    the f32 reference on the argmin for integer-valued (exact) inputs."""
+    Zp, vals, x2, mask = _sk_problem(65, 6, 4, seed=5)
+    a16, m16 = fd.fused_argmin_min_sketched(
+        Zp.astype(jnp.bfloat16), vals, x2=x2, mask=mask)
+    a32, _ = fd.fused_argmin_min_sketched(Zp, vals, x2=x2, mask=mask)
+    assert np.asarray(a16).dtype == np.int32
+    assert np.asarray(m16).dtype == np.float32
+    np.testing.assert_array_equal(np.asarray(a16), np.asarray(a32))

@@ -1,0 +1,161 @@
+"""Nystrom kernel k-means (cluster/kernel_kmeans.py): landmark feature
+construction through the SAME ``_nystrom_map`` seam spectral clustering
+stages with (kernel k-means takes the UN-normalized full-l whitening;
+spectral takes row-normalized top-k), Euclidean Lloyd on those features
+== kernel k-means on the approximated Gram.
+
+Pins:
+
+* **It solves what dense Lloyd cannot**: the XOR problem (class =
+  sign(x1*x2)) has no convex-partition solution — dense KMeans sits at
+  ARI ~0, the degree-2 polynomial kernel separates it.
+* **predict(train) == labels_** exactly: predict runs the same staged
+  assignment program the fit finalized with.
+* **Ledger exactness**: the one collective the fit adds — the landmark
+  column-sum ``kernel.gram.colsum`` — meters exact bytes on a
+  hierarchical mesh, same analytic model as every other hpsum site.
+* **The spectral seam survived the refactor**: SpectralClustering still
+  reproduces its own training labels through ``_assign_staged``.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+from sklearn.metrics import adjusted_rand_score
+
+from dask_ml_tpu.cluster import KernelKMeans, KMeans, SpectralClustering
+from dask_ml_tpu.parallel import hierarchy as hier
+from dask_ml_tpu.parallel import mesh as mesh_lib
+
+
+def _xor(n=1024, seed=0):
+    """Four gaussian blobs at (+-2, +-2); class = sign(x1*x2)."""
+    rng = np.random.RandomState(seed)
+    signs = rng.randint(0, 2, (n, 2)) * 2 - 1
+    X = (signs * 2.0 + rng.randn(n, 2) * 0.6).astype(np.float32)
+    y = (signs[:, 0] * signs[:, 1] > 0).astype(np.int32)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def xor_fit():
+    X, y = _xor()
+    kk = KernelKMeans(n_clusters=2, n_components=128,
+                      affinity="polynomial", degree=2, coef0=1.0,
+                      gamma=0.5, random_state=5).fit(X)
+    return {"X": X, "y": y, "kk": kk}
+
+
+def test_beats_dense_lloyd_on_xor(xor_fit):
+    X, y = xor_fit["X"], xor_fit["y"]
+    ari_dense = adjusted_rand_score(
+        y, KMeans(n_clusters=2, random_state=3).fit(X).labels_)
+    ari_kernel = adjusted_rand_score(y, xor_fit["kk"].labels_)
+    assert ari_dense < 0.5  # the control: convex partitions can't
+    assert ari_kernel >= 0.9
+
+
+def test_predict_train_equals_labels(xor_fit):
+    np.testing.assert_array_equal(
+        xor_fit["kk"].predict(xor_fit["X"]), xor_fit["kk"].labels_)
+
+
+def test_fitted_surface(xor_fit):
+    kk = xor_fit["kk"]
+    assert kk._landmarks_.shape == (128, 2)
+    assert kk.cluster_centers_.shape[0] == 2  # feature-space centers
+    assert kk.labels_.shape == (xor_fit["X"].shape[0],)
+    assert kk.n_features_in_ == 2
+    assert float(kk.inertia_) >= 0.0
+
+
+def test_n_init_monotone():
+    """More restarts never worsen the kept inertia: with the same
+    random_state the first candidate of the n_init=4 fit IS the
+    n_init=1 fit (same rng draw sequence), and the loop keeps the
+    lowest-inertia run."""
+    X, _ = _xor(n=512, seed=1)
+    kw = dict(n_clusters=2, n_components=64, affinity="polynomial",
+              degree=2, coef0=1.0, gamma=0.5, random_state=7)
+    one = KernelKMeans(n_init=1, **kw).fit(X)
+    four = KernelKMeans(n_init=4, **kw).fit(X)
+    assert float(four.inertia_) <= float(one.inertia_) + 1e-6
+
+
+def test_rejects_callable_affinity():
+    X, _ = _xor(n=256)
+    with pytest.raises(ValueError, match="callable"):
+        KernelKMeans(n_clusters=2, n_components=32,
+                     affinity=lambda a, b: a @ b.T).fit(X)
+
+
+def test_rejects_unknown_affinity():
+    X, _ = _xor(n=256)
+    with pytest.raises(ValueError, match="affinity"):
+        KernelKMeans(n_clusters=2, n_components=32,
+                     affinity="nope").fit(X)
+
+
+def test_rejects_n_components_ge_n():
+    X, _ = _xor(n=64)
+    with pytest.raises(ValueError, match="n_components"):
+        KernelKMeans(n_clusters=2, n_components=64).fit(X)
+
+
+def test_ledger_exactness_gram_colsum():
+    """The landmark column-sum is the fit's ONE cross-shard collective:
+    on a (2, 4) hierarchical mesh its metered bytes equal the analytic
+    combining model for an (l,) f32 operand, one chip and one pod stage
+    call per trace (unique n/l => guaranteed fresh trace)."""
+    n, l = 1096, 97
+    rng = np.random.RandomState(2)
+    X = rng.randn(n, 3).astype(np.float32)
+    m = hier.make_hierarchical_mesh(2, 4)
+    hier.reset_ledger()
+    with mesh_lib.use_mesh(m):
+        KernelKMeans(n_clusters=3, n_components=l, gamma=0.5,
+                     random_state=0, n_init=1).fit(X)
+    snap = hier.ledger_snapshot()
+    want = hier.collective_bytes(m, l * 4)
+    assert snap["ops"]["kernel.gram.colsum"] == want
+    assert snap["calls"]["chip/kernel.gram.colsum"] == 1
+    assert snap["calls"]["pod/kernel.gram.colsum"] == 1
+
+
+def test_spectral_seam_unchanged():
+    """SpectralClustering routes through the same refactored
+    ``_nystrom_map`` seam (row-normalized top-k flavor) and must still
+    reproduce its own training labels via the staged assignment."""
+    rng = np.random.RandomState(4)
+    C = np.array([[0.0, 0.0], [8.0, 8.0], [-8.0, 8.0]], np.float32)
+    X = np.concatenate(
+        [C[i] + rng.randn(200, 2).astype(np.float32) for i in range(3)])
+    sc = SpectralClustering(n_clusters=3, n_components=60, gamma=0.5,
+                            random_state=0).fit(X)
+    np.testing.assert_array_equal(sc.predict(X), sc.labels_)
+
+
+RAGGED = (1, 31, 64, 100, 200)
+
+
+def test_serving_bit_equal(xor_fit):
+    """KernelKMeans is a serving-registry family: the landmark
+    assignment runner shares ``_assign_staged`` with predict, so served
+    labels are bit-equal at ragged request sizes."""
+    from dask_ml_tpu.parallel.serving import (
+        ModelRegistry,
+        ServingLoop,
+        _build_runners,
+    )
+
+    kk, X = xor_fit["kk"], xor_fit["X"]
+    runners = _build_runners(kk)
+    assert runners["predict"].kind == "device"
+    reg = ModelRegistry()
+    reg.register("kernel", kk)
+    with ServingLoop(reg, max_batch_rows=256) as lp:
+        for n in RAGGED:
+            got = lp.submit("kernel", X[:n]).result(120)
+            np.testing.assert_array_equal(
+                np.asarray(got), kk.predict(X[:n]))

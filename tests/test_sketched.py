@@ -1,0 +1,142 @@
+"""Sketched k-means estimator path (docs/kernels.md, "Sketched
+assignment"): ``KMeans(algorithm='sketched')`` fits against a learned
+fast-transform sketch of the centers, and every consumer of the fitted
+model — ``predict``, the serving runner, the exact-dispatch facade —
+must agree bit-for-bit with ``labels_``.
+
+The load-bearing pins:
+
+* the two dispatch branches of ``predict_labels_sketched`` (sketched
+  contraction vs exact contraction against ``sketch_centers_``) produce
+  IDENTICAL labels — the decisions-cache dispatch is a pure perf choice;
+* serving a sketched model returns the direct-predict labels bit-equal
+  at ragged request sizes (the runner shares ``_sketch_args``);
+* quality on separable data matches the exact fit (the approximation
+  budget is spent on truly hard problems, not easy ones).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dask_ml_tpu.cluster import KMeans, MiniBatchKMeans
+from dask_ml_tpu.models import kmeans as core
+from dask_ml_tpu.parallel.serving import (
+    ModelRegistry,
+    ServingLoop,
+    _build_runners,
+)
+
+K, D, N = 7, 41, 2800
+
+
+def _blobs(n=N, d=D, k=K, seed=0, sep=6.0):
+    rng = np.random.RandomState(seed)
+    C = rng.randn(k, d).astype(np.float32) * sep
+    X = np.concatenate(
+        [C[i] + rng.randn(n // k, d).astype(np.float32)
+         for i in range(k)])
+    rng.shuffle(X)
+    return X
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    X = _blobs()
+    sk = KMeans(n_clusters=K, algorithm="sketched", sketch_cols=16,
+                random_state=3, max_iter=60).fit(X)
+    exact = KMeans(n_clusters=K, random_state=3, max_iter=60).fit(X)
+    return {"X": X, "sk": sk, "exact": exact}
+
+
+def test_fitted_surface(fitted):
+    sk = fitted["sk"]
+    assert sk.fast_transform_ is not None
+    assert sk.sketch_staging_.shape == (D, 16)
+    assert sk.sketch_offset_.shape == (16,)
+    assert sk.sketch_vals_.shape == (K, 16)
+    assert sk.sketch_centers_.shape == (K, D)
+    assert sk.cluster_centers_.shape == (K, D)
+    # support is a sorted column index set into the transform domain
+    sup = fitted["sk"].sketch_support_
+    assert sup.shape == (16,)
+    assert np.all(np.diff(sup) > 0)
+    # the staging slice IS support_matrix(ft, support): offset consistent
+    np.testing.assert_allclose(sk.sketch_offset_,
+                               sk.sketch_mean_ @ sk.sketch_staging_,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_predict_equals_labels(fitted):
+    """predict(train) == labels_ bit-for-bit: finalization assigns with
+    the SAME staged program predict runs."""
+    np.testing.assert_array_equal(
+        fitted["sk"].predict(fitted["X"]), fitted["sk"].labels_)
+
+
+def test_quality_matches_exact_on_separable(fitted):
+    """Well-separated blobs: the sketch must not cost quality (inertia
+    within 1% of the exact fit, identical partition up to relabeling)."""
+    from sklearn.metrics import adjusted_rand_score
+
+    sk, exact = fitted["sk"], fitted["exact"]
+    assert float(sk.inertia_) <= float(exact.inertia_) * 1.01
+    assert adjusted_rand_score(exact.labels_, sk.labels_) >= 0.99
+
+
+def test_dispatch_branches_agree(fitted):
+    """The perf dispatch in predict_labels_sketched is label-invariant:
+    the sketched contraction and the exact contraction against
+    sketch_centers_ (the centers the sketch actually encodes) give the
+    SAME labels — orthogonal transform, restricted and full-space
+    distances differ by a per-row constant."""
+    X = jnp.asarray(fitted["X"])
+    Wp, off, vals, centers_sk = fitted["sk"]._sketch_args()
+    lab_sketch = np.asarray(core._predict_sketched_fast(X, Wp, off, vals))
+    lab_exact = np.asarray(core.predict_labels(X, centers_sk))
+    np.testing.assert_array_equal(lab_sketch, lab_exact)
+
+
+def test_sketched_assign_wins_fallback():
+    """Cold-start inequality (no decisions entry matches these tiny
+    shapes): narrow support + enough clusters -> sketched; wide support
+    or few clusters -> exact."""
+    assert core.sketched_assign_wins(1000, 16, 64, 16)
+    assert not core.sketched_assign_wins(1000, 4, 64, 16)   # k too small
+    assert not core.sketched_assign_wins(1000, 16, 64, 40)  # 2p > d
+
+
+RAGGED = (1, 31, 64, 100, 200)
+
+
+def test_serving_bit_equal_sketched(fitted):
+    """Served sketched labels == direct predict at ragged sizes; the
+    runner is a staged device program, not the host fallback."""
+    runners = _build_runners(fitted["sk"])
+    assert runners["predict"].kind == "device"
+    reg = ModelRegistry()
+    reg.register("sk", fitted["sk"])
+    X = fitted["X"]
+    with ServingLoop(reg, max_batch_rows=256) as lp:
+        for n in RAGGED:
+            got = lp.submit("sk", X[:n]).result(120)
+            np.testing.assert_array_equal(
+                np.asarray(got), fitted["sk"].predict(X[:n]))
+
+
+def test_serving_bit_equal_minibatch(fitted):
+    """MiniBatchKMeans is a registry family: served through the staged
+    KMeans runner (same fitted surface), bit-equal to direct predict."""
+    X = fitted["X"]
+    mb = MiniBatchKMeans(n_clusters=K, random_state=3,
+                         batch_size=512).fit(X)
+    runners = _build_runners(mb)
+    assert runners["predict"].kind == "device"
+    reg = ModelRegistry()
+    reg.register("mb", mb)
+    with ServingLoop(reg, max_batch_rows=256) as lp:
+        for n in RAGGED:
+            got = lp.submit("mb", X[:n]).result(120)
+            np.testing.assert_array_equal(
+                np.asarray(got), mb.predict(X[:n]))
