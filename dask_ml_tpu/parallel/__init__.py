@@ -91,6 +91,7 @@ from dask_ml_tpu.parallel.fleet import (  # noqa: F401
     FleetClient,
     FleetServer,
     FleetTimeoutError,
+    RetryBudget,
     ServingFleet,
 )
 from dask_ml_tpu.parallel.elastic import (  # noqa: F401
@@ -104,6 +105,27 @@ from dask_ml_tpu.parallel.elastic import (  # noqa: F401
 # router class is small and pure-host, so re-exporting it here is cheap
 from dask_ml_tpu.parallel.procfleet import (  # noqa: F401
     ProcessFleet,
+)
+
+# the cross-machine tier: remote-spawn launchers, content-addressed
+# snapshot distribution, and the SLO autoscaler (all pure-host)
+from dask_ml_tpu.parallel.launcher import (  # noqa: F401
+    ExecLauncher,
+    LocalLauncher,
+    MachineSpec,
+    plan_placement,
+)
+from dask_ml_tpu.parallel.snapshots import (  # noqa: F401
+    ChunkCache,
+    SnapshotCorruptError,
+    SnapshotServer,
+    SnapshotTransferError,
+    fetch_snapshot,
+    manifest_of,
+)
+from dask_ml_tpu.parallel.autoscaler import (  # noqa: F401
+    SLO,
+    Autoscaler,
 )
 
 # runtime (multi-host bootstrap) is imported lazily by users that need it:

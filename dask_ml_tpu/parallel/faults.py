@@ -497,12 +497,14 @@ class FaultInjector:
         self._kill_replica: dict = {}    # replica -> after_batches
         self._kill_process: dict = {}    # name -> after_requests
         self._straggle: dict = {}        # replica -> [count, every, s, left]
+        self._kill_machine: dict = {}    # machine -> after_results
+        self._slow_link: dict = {}       # machine -> [chunks_left, seconds]
         self._p_load = 0.0
         self._p_exc = InjectedLoaderError
         self.injected = {"load": 0, "transfer": 0, "delay": 0, "preempt": 0,
                          "die": 0, "dispatch_delay": 0, "slow_replica": 0,
                          "replica_kill": 0, "process_kill": 0,
-                         "straggle": 0}
+                         "straggle": 0, "machine_kill": 0, "slow_link": 0}
 
     # -- planning ----------------------------------------------------------
 
@@ -613,6 +615,61 @@ class FaultInjector:
         the point."""
         if self.should_kill_process(name, n_requests):
             os.kill(os.getpid(), signal.SIGKILL)
+
+    def kill_machine(self, machine: str, *,
+                     after_results: int = 0) -> "FaultInjector":
+        """Kill every replica process of roster machine ``machine`` at
+        once, once the fleet has resolved ``after_results`` requests —
+        the MACHINE-loss drill (power loss, kernel panic, network
+        partition): all of its heartbeats stop in the same instant and
+        all of its sockets go dark together, which is the signal the
+        multi-machine router's machine-death detection keys on. The
+        process-fleet ROUTER polls :meth:`should_kill_machine` from its
+        monitor and delivers SIGKILL to each of the machine's replica
+        pids (the router can reach them; a real machine loss would not
+        need delivering). One-shot per machine."""
+        self._kill_machine[str(machine)] = int(after_results)
+        return self
+
+    def should_kill_machine(self, machine: str, n_results: int) -> bool:
+        """True exactly once, when the fleet has resolved
+        ``after_results`` requests (see :meth:`kill_machine`)."""
+        with self._lock:
+            after = self._kill_machine.get(str(machine))
+            if after is None or int(n_results) < after:
+                return False
+            del self._kill_machine[str(machine)]
+            self.injected["machine_kill"] += 1
+        self._mirror("machine_kill")
+        return True
+
+    def slow_link(self, machine: str, seconds: float, *,
+                  chunks: Optional[int] = None) -> "FaultInjector":
+        """Degrade the snapshot-distribution link TO roster machine
+        ``machine``: the snapshot server sleeps ``seconds`` before each
+        chunk it sends that machine (``chunks`` bounds how many sends
+        are delayed; default unbounded). Real wall-clock delay — the
+        drill for resumable transfer under a slow or flaky link
+        (``parallel/snapshots.py``); the chunk requests carry the
+        machine label, so only the targeted link degrades."""
+        self._slow_link[str(machine)] = [
+            -1 if chunks is None else int(chunks), float(seconds)]
+        return self
+
+    def link_delay(self, machine: str) -> float:
+        """Seconds the snapshot server must stall before sending the
+        next chunk to ``machine`` (0.0 when no :meth:`slow_link` plan
+        fires). The CALLER sleeps — the injector only decides."""
+        with self._lock:
+            plan = self._slow_link.get(str(machine))
+            if not plan or plan[0] == 0:
+                return 0.0
+            if plan[0] > 0:
+                plan[0] -= 1
+            self.injected["slow_link"] += 1
+            seconds = plan[1]
+        self._mirror("slow_link")
+        return seconds
 
     def straggle_replica(self, replica: str, seconds: float, *,
                          every: int = 1,

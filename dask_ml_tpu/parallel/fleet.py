@@ -74,6 +74,7 @@ import socket
 import threading
 import time
 import uuid
+from collections import deque
 from concurrent.futures import Future
 from concurrent.futures import TimeoutError as _FutureTimeout
 from typing import Optional
@@ -98,6 +99,7 @@ __all__ = [
     "FleetServer",
     "FleetClient",
     "FleetTimeoutError",
+    "RetryBudget",
 ]
 
 
@@ -129,7 +131,7 @@ def _set_future(fut: Future, result) -> bool:
         return False  # already resolved — duplicate compute, not an error
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(eq=False)
 class _Replica:
     name: str
     loop: ServingLoop
@@ -137,6 +139,10 @@ class _Replica:
     consecutive_failures: int = 0
     breaker_open_until: float = 0.0  # monotonic instant
     dead: bool = False
+    #: rolling window of fleet-observed request latencies — the hedge
+    #: threshold's quantile source (same shape as the process fleet's)
+    lat: deque = dataclasses.field(
+        default_factory=lambda: deque(maxlen=128))
 
     def breaker_open(self) -> bool:
         return time.monotonic() < self.breaker_open_until
@@ -156,6 +162,11 @@ class _FleetRequest:
     future: Future
     attempts: int = 0
     replica: Optional[str] = None
+    hedges: int = 0
+    #: replica name -> dispatch perf_counter instant for every attempt
+    #: still awaiting completion (the hedge scan reads waits off this;
+    #: each completion callback pops its own entry)
+    outstanding: dict = dataclasses.field(default_factory=dict)
 
     def remaining(self) -> Optional[float]:
         if self.deadline_abs is None:
@@ -190,6 +201,19 @@ class ServingFleet:
     max_replays : int, optional
         Re-route budget per request (default: replica count) — a request
         is failed with its last cause rather than bouncing forever.
+    hedge : bool
+        Adaptive request hedging (default OFF for the in-process tier):
+        a request waiting past ``hedge_factor`` × the
+        ``hedge_quantile``-th quantile of its replica's recent observed
+        latencies (loop EWMA while the window fills, ``hedge_cold_s``
+        before any sample, floored at ``hedge_min_s``) is speculatively
+        re-submitted to the next-best replica — first resolution wins
+        under the same idempotent completion the replay path already
+        uses, so the duplicate work is deliberate and counted
+        (``serving.hedged`` / ``serving.hedge_wins``).
+    hedge_quantile, hedge_factor, hedge_min_s, hedge_cold_s
+        The hedge threshold's shape (same contract as the process
+        fleet's).
     drain : GracefulDrain, optional
         Shared drain scope: on SIGTERM (or ``drain.request()``) every
         replica stops accepting, flushes its queue, and resolves every
@@ -213,6 +237,11 @@ class ServingFleet:
                  max_consecutive_failures: int = 3,
                  breaker_cooldown_s: float = 1.0,
                  max_replays: Optional[int] = None,
+                 hedge: bool = False,
+                 hedge_quantile: float = 0.5,
+                 hedge_factor: float = 3.0,
+                 hedge_min_s: float = 0.05,
+                 hedge_cold_s: float = 0.5,
                  drain=None,
                  retry_policy=None,
                  fault_injector=None,
@@ -229,6 +258,11 @@ class ServingFleet:
         self.max_consecutive_failures = int(max_consecutive_failures)
         self.breaker_cooldown_s = float(breaker_cooldown_s)
         self.max_replays = max_replays
+        self.hedge = bool(hedge)
+        self.hedge_quantile = float(hedge_quantile)
+        self.hedge_factor = float(hedge_factor)
+        self.hedge_min_s = float(hedge_min_s)
+        self.hedge_cold_s = float(hedge_cold_s)
         self.name = str(name)
         self._drain = drain
         self._retry_policy = retry_policy
@@ -248,6 +282,8 @@ class ServingFleet:
         self.n_shed = 0
         self.n_swaps = 0
         self.n_replica_deaths = 0
+        self.n_hedged = 0
+        self.n_hedge_wins = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -532,6 +568,7 @@ class ServingFleet:
                     f"request {freq.rid} deadline passed during routing"),
                     sync)
                 return
+            t0 = time.perf_counter()
             try:
                 rfut = rep.loop.submit(
                     freq.model, freq.X, method=freq.method,
@@ -560,9 +597,11 @@ class ServingFleet:
             freq.attempts += 1
             freq.replica = rep.name
             with self._lock:
+                freq.outstanding[rep.name] = t0
                 self._inflight[freq.rid] = freq
             rfut.add_done_callback(
-                lambda f, freq=freq, rep=rep: self._on_done(freq, rep, f))
+                lambda f, freq=freq, rep=rep, t0=t0:
+                self._on_done(freq, rep, t0, False, f))
             return
 
     def _terminal(self, freq: _FleetRequest, exc: BaseException,
@@ -577,19 +616,39 @@ class ServingFleet:
         return (self.max_replays if self.max_replays is not None
                 else max(len(self._replicas), 1))
 
-    def _on_done(self, freq: _FleetRequest, rep: _Replica, rfut) -> None:
+    def _on_done(self, freq: _FleetRequest, rep: _Replica, t0: float,
+                 hedge: bool, rfut) -> None:
         """Replica-future completion, on the replica's dispatch thread
         (or the failing path's). Success and model errors resolve the
-        fleet future; replica-death errors re-route + replay."""
+        fleet future; replica-death errors re-route + replay.
+
+        With hedging, a request may have SEVERAL attempts outstanding:
+        each completion pops only its own ``outstanding`` entry, the
+        first successful resolution wins (``_set_future`` is
+        idempotent), and a losing attempt's failure never terminates a
+        request a sibling attempt can still answer."""
         from dask_ml_tpu.parallel import telemetry
         from dask_ml_tpu.parallel.faults import SimulatedReplicaDeath
 
+        with self._lock:
+            owned = freq.outstanding.get(rep.name) == t0
+            if owned:
+                freq.outstanding.pop(rep.name, None)
         try:
             result = rfut.result()
         except (ServingStopped, ServingClosed, SimulatedReplicaDeath) as e:
             # the REPLICA went away, not the request: re-route + replay
             self._note_failure(rep)
+            if freq.future.done() or not owned:
+                return  # a sibling attempt already resolved (or will)
+            with self._lock:
+                still_out = bool(freq.outstanding)
             if freq.attempts > self._replay_budget():
+                if still_out:
+                    # another attempt (a hedge on a live replica) may
+                    # still resolve this request; if it fails too, ITS
+                    # callback lands here with nothing outstanding
+                    return
                 self._terminal(freq, e, sync=False)
                 return
             with self._lock:
@@ -599,16 +658,98 @@ class ServingFleet:
                     "fleet.reroutes", replica=rep.name).inc()
             self._route(freq, sync=False, exclude={rep.name})
         except DeadlineExceeded as e:
+            if freq.future.done():
+                return
             self._count_shed(freq.model)
             self._terminal(freq, e, sync=False)
         except BaseException as e:  # noqa: BLE001 — the request's own error
             self._note_failure(rep)
+            if freq.future.done():
+                return
             self._terminal(freq, e, sync=False)
         else:
             self._note_success(rep)
+            dt = time.perf_counter() - t0
             with self._lock:
+                rep.lat.append(dt)
                 self._inflight.pop(freq.rid, None)
-            _set_future(freq.future, result)
+            if _set_future(freq.future, result) and hedge:
+                with self._lock:
+                    self.n_hedge_wins += 1
+                if telemetry.enabled():
+                    telemetry.metrics().counter(
+                        "serving.hedge_wins", replica=rep.name).inc()
+
+    # -- hedging -----------------------------------------------------------
+
+    def _hedge_threshold(self, rep: _Replica) -> float:
+        """``hedge_factor`` × the ``hedge_quantile`` of ``rep``'s recent
+        fleet-observed latencies (loop EWMA while the window is short,
+        ``hedge_cold_s`` before any), floored at ``hedge_min_s`` — the
+        same adaptive shape as the process fleet: a uniformly-slow
+        replica raises its own bar, hedging targets the TAIL."""
+        with self._lock:
+            samples = list(rep.lat)
+        if len(samples) >= 8:
+            base = float(np.quantile(samples, self.hedge_quantile))
+        else:
+            base = float(rep.loop.latency_s())
+            if base <= 0.0:
+                return self.hedge_cold_s
+        return max(self.hedge_min_s, self.hedge_factor * base)
+
+    def _hedge_scan(self) -> None:
+        """One monitor-tick pass over in-flight requests: any attempt
+        waiting past its replica's adaptive threshold gets ONE
+        speculative re-submission on the next-best replica."""
+        from dask_ml_tpu.parallel import telemetry
+
+        now = time.perf_counter()
+        with self._lock:
+            candidates = [freq for freq in self._inflight.values()
+                          if not freq.future.done() and freq.hedges < 1
+                          and freq.outstanding]
+        by_name = {rep.name: rep for rep in self._replicas}
+        thresholds: dict = {}
+        for freq in candidates:
+            with self._lock:
+                waits = list(freq.outstanding.items())
+            for rep_name, t0 in waits:
+                rep = by_name.get(rep_name)
+                if rep is None:
+                    continue
+                thr = thresholds.get(rep_name)
+                if thr is None:
+                    thr = thresholds[rep_name] = \
+                        self._hedge_threshold(rep)
+                if now - t0 <= thr:
+                    continue
+                target = self._pick(
+                    exclude={n for n, _ in waits} | {rep_name})
+                if target is None:
+                    break
+                remaining = freq.remaining()
+                if remaining is not None and remaining <= 0.0:
+                    break
+                ht0 = time.perf_counter()
+                try:
+                    rfut = target.loop.submit(
+                        freq.model, freq.X, method=freq.method,
+                        priority=freq.priority, deadline=remaining)
+                except Exception:  # noqa: BLE001 — target refused; later
+                    break  # scan may retry with the budget unconsumed
+                freq.hedges += 1
+                with self._lock:
+                    freq.attempts += 1
+                    freq.outstanding[target.name] = ht0
+                    self.n_hedged += 1
+                if telemetry.enabled():
+                    telemetry.metrics().counter(
+                        "serving.hedged", replica=target.name).inc()
+                rfut.add_done_callback(
+                    lambda f, freq=freq, rep=target, t0=ht0:
+                    self._on_done(freq, rep, t0, True, f))
+                break
 
     # -- health monitoring -------------------------------------------------
 
@@ -626,6 +767,15 @@ class ServingFleet:
                 if self._drain is not None and self._drain.requested:
                     with self._lock:
                         self._closing = True
+                if self.hedge and not self._closing:
+                    try:
+                        self._hedge_scan()
+                    except Exception:  # noqa: BLE001 — monitor survives
+                        import logging
+
+                        logging.getLogger(__name__).exception(
+                            "fleet %r: hedge scan failed (continuing)",
+                            self.name)
                 for rep in self._replicas:
                     loop = rep.loop
                     if rep.dead:
@@ -668,7 +818,8 @@ class ServingFleet:
         with self._lock:
             self.n_replica_deaths += 1
             victims = [freq for freq in self._inflight.values()
-                       if freq.replica == rep.name]
+                       if freq.replica == rep.name
+                       or rep.name in freq.outstanding]
         if telemetry.enabled():
             telemetry.metrics().counter(
                 "fleet.replica_deaths", replica=rep.name).inc()
@@ -700,6 +851,8 @@ class ServingFleet:
                 "shed": self.n_shed,
                 "swaps": self.n_swaps,
                 "replica_deaths": self.n_replica_deaths,
+                "hedged": self.n_hedged,
+                "hedge_wins": self.n_hedge_wins,
                 "inflight": len(self._inflight),
             }
         return {
@@ -991,6 +1144,48 @@ class FleetServer:
         fut.add_done_callback(deliver)
 
 
+class RetryBudget:
+    """Client-side load-aware retry budget: a token bucket that couples
+    the RIGHT to retry to observed success. Every success deposits
+    ``ratio`` tokens (capped at ``cap``); every retry spends one whole
+    token. Healthy service → budget stays full and transient blips
+    retry freely; degraded service → successes dry up, the bucket
+    drains, and retries STOP instead of multiplying the load that is
+    causing the failures (the retry-storm amplification a fixed
+    retry count cannot prevent). Share one instance across the clients
+    of a service so the bound is per-service, not per-caller."""
+
+    def __init__(self, ratio: float = 0.1, *, initial: float = 10.0,
+                 cap: float = 100.0):
+        if float(ratio) < 0.0:
+            raise ValueError("ratio must be >= 0")
+        self.ratio = float(ratio)
+        self.cap = float(cap)
+        self._lock = threading.Lock()
+        self._tokens = min(float(initial), self.cap)
+        self.n_spent = 0
+        self.n_denied = 0
+
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+    def on_success(self) -> None:
+        with self._lock:
+            self._tokens = min(self._tokens + self.ratio, self.cap)
+
+    def try_spend(self) -> bool:
+        """Claim one retry token; False (and counted) when the budget
+        is exhausted — the caller must surface the original failure."""
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                self.n_spent += 1
+                return True
+            self.n_denied += 1
+            return False
+
+
 class FleetClient:
     """Out-of-process client of a :class:`FleetServer`: frames typed
     requests over one socket, demultiplexes out-of-order responses by id
@@ -998,6 +1193,14 @@ class FleetClient:
     Error responses re-raise as the same exception classes a local
     caller would see (:data:`_WIRE_ERRORS`; anything unmapped surfaces
     as ``RuntimeError`` naming the remote class).
+
+    Retries: ``call`` re-attempts :class:`FleetTimeoutError` /
+    :class:`ServingStopped` failures up to ``retries`` times, but only
+    while the :class:`RetryBudget` has tokens — retries are earned by
+    successes (deposit ``ratio``) and spent one token each, so a
+    degraded server sees the retry load FALL with its success rate
+    instead of multiplying (mirrored as ``fleet.retries`` and
+    ``fleet.retry_budget_exhausted`` at the increment sites).
 
     Deadlines: ``request_timeout`` (and the per-call ``timeout=`` on
     ``submit``) arms a reaper that fails the future with the typed
@@ -1016,11 +1219,19 @@ class FleetClient:
 
     def __init__(self, address, *, timeout: Optional[float] = None,
                  request_timeout: Optional[float] = None,
-                 send_timeout: Optional[float] = 30.0):
+                 send_timeout: Optional[float] = 30.0,
+                 retries: int = 0,
+                 retry_budget: Optional[RetryBudget] = None):
         self.address = (address[0], int(address[1]))
         self._connect_timeout = timeout
         self.request_timeout = request_timeout
         self.send_timeout = send_timeout
+        self.retries = int(retries)
+        # retries without a budget would be exactly the retry-storm
+        # amplifier the budget exists to prevent: default one in
+        self.retry_budget = (retry_budget if retry_budget is not None
+                             else (RetryBudget() if self.retries > 0
+                                   else None))
         self._wlock = threading.Lock()
         self._lock = threading.Lock()
         self._pending: dict = {}  # id -> Future
@@ -1036,6 +1247,8 @@ class FleetClient:
         self._reaper: Optional[threading.Thread] = None
         self.n_timeouts = 0
         self.n_reconnects = 0
+        self.n_retries = 0
+        self.n_budget_exhausted = 0
         from dask_ml_tpu.parallel import telemetry
 
         self._telemetry_inherit = telemetry.enabled()
@@ -1249,9 +1462,9 @@ class FleetClient:
             rid, timeout if timeout is not None else self.request_timeout)
         return fut
 
-    def call(self, model: str, X, method: str = "predict", *,
-             priority: int = 0, deadline: Optional[float] = None,
-             timeout: Optional[float] = None) -> np.ndarray:
+    def _call_once(self, model: str, X, method: str = "predict", *,
+                   priority: int = 0, deadline: Optional[float] = None,
+                   timeout: Optional[float] = None) -> np.ndarray:
         fut = self.submit(model, X, method=method, priority=priority,
                           deadline=deadline, timeout=timeout)
         try:
@@ -1265,6 +1478,42 @@ class FleetClient:
                 f"no wire response for {model!r}.{method} within "
                 f"{timeout if timeout is not None else self.request_timeout}"
                 "s")
+
+    def call(self, model: str, X, method: str = "predict", *,
+             priority: int = 0, deadline: Optional[float] = None,
+             timeout: Optional[float] = None) -> np.ndarray:
+        """One blocking request, retried (transient failures only: wire
+        timeout, server gone) up to ``retries`` times UNDER the retry
+        budget — when the budget is dry, the original failure surfaces
+        immediately (class docstring has the policy)."""
+        from dask_ml_tpu.parallel import telemetry
+
+        attempts = 0
+        while True:
+            try:
+                out = self._call_once(
+                    model, X, method=method, priority=priority,
+                    deadline=deadline, timeout=timeout)
+            except (FleetTimeoutError, ServingStopped):
+                if attempts >= self.retries or self._closed:
+                    raise
+                if self.retry_budget is not None \
+                        and not self.retry_budget.try_spend():
+                    with self._lock:
+                        self.n_budget_exhausted += 1
+                    if telemetry.enabled():
+                        telemetry.metrics().counter(
+                            "fleet.retry_budget_exhausted").inc()
+                    raise
+                attempts += 1
+                with self._lock:
+                    self.n_retries += 1
+                if telemetry.enabled():
+                    telemetry.metrics().counter("fleet.retries").inc()
+                continue
+            if self.retry_budget is not None:
+                self.retry_budget.on_success()
+            return out
 
     def stats(self, timeout: float = 10.0) -> dict:
         """The server's ``op="stats"`` snapshot (queue depth, latency

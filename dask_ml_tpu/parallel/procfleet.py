@@ -65,6 +65,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import signal as signal_mod
 import subprocess
 import sys
 import tempfile
@@ -82,6 +83,11 @@ from dask_ml_tpu.parallel.fleet import (
     FleetClient,
     FleetTimeoutError,
     _set_future,
+)
+from dask_ml_tpu.parallel.launcher import (
+    LocalLauncher,
+    MachineSpec,
+    plan_placement,
 )
 from dask_ml_tpu.parallel.replica import save_registry_snapshot
 from dask_ml_tpu.parallel.serving import (
@@ -102,13 +108,23 @@ class _ProcReplica:
 
     slot: int
     name: str
+    machine: Optional[MachineSpec] = None
     proc: Optional[subprocess.Popen] = None
     pid: Optional[int] = None
     address: Optional[tuple] = None
     client: Optional[FleetClient] = None
     warmup: Optional[dict] = None
+    #: snapshot-transfer accounting from the incarnation's announce
+    #: (bytes_fetched / chunks_cached — the delta-reship gate's source)
+    fetch: Optional[dict] = None
     gen: int = 0
     dead: bool = False
+    #: autoscaler retirement in progress: out of rotation, SIGTERM sent,
+    #: waiting for the graceful drain's tombstone
+    draining: bool = False
+    #: drained/failed slot that will never respawn (its corpse stays in
+    #: the roster for exit-code accounting)
+    retired: bool = False
     inflight: int = 0
     ewma_s: float = 0.0
     lat: deque = dataclasses.field(
@@ -188,6 +204,26 @@ class ProcessFleet:
         wire requests (:meth:`~dask_ml_tpu.parallel.faults.FaultInjector.
         kill_process`). One-shot: only the slot's FIRST incarnation
         carries the plan; the respawn comes back clean.
+    machines : list of MachineSpec, optional
+        The multi-machine roster (``parallel/launcher.py``): replica
+        slots are placed across machines capacity-weighted by device
+        inventory, each machine's workdir carries its own heartbeat
+        fabric, the registry snapshot ships chunk-addressed over the
+        snapshot wire (``parallel/snapshots.py``) through a per-machine
+        chunk cache, and a machine ALL of whose replicas die at once is
+        marked down — its in-flight requests replay on survivors and its
+        slots respawn on surviving machines. Default: one implicit local
+        machine (single-box behavior, snapshot loads straight from
+        disk).
+    launcher : Launcher, optional
+        The remote-spawn hook (default :class:`~dask_ml_tpu.parallel.
+        launcher.LocalLauncher`; an SSH-shaped deployment passes an
+        :class:`~dask_ml_tpu.parallel.launcher.ExecLauncher`).
+    fault_injector : FaultInjector, optional
+        Router-side chaos: ``kill_machine`` plans are polled from the
+        monitor (SIGKILL to every replica of the machine at a request
+        count) and ``slow_link`` plans degrade the snapshot wire
+        per machine.
     """
 
     #: same routing quantum as the in-process fleet: EWMA differences
@@ -213,7 +249,11 @@ class ProcessFleet:
                  max_replays: Optional[int] = None,
                  devices_per_replica: Optional[int] = None,
                  straggle: Optional[dict] = None,
-                 kill_after_requests: Optional[dict] = None):
+                 kill_after_requests: Optional[dict] = None,
+                 machines: Optional[list] = None,
+                 launcher=None,
+                 fault_injector=None,
+                 snapshot_chunk_bytes: Optional[int] = None):
         if int(n_replicas) < 1:
             raise ValueError("n_replicas must be >= 1")
         self.n_replicas = int(n_replicas)
@@ -236,12 +276,21 @@ class ProcessFleet:
         self.devices_per_replica = devices_per_replica
         self._straggle = dict(straggle or {})
         self._kill_after = dict(kill_after_requests or {})
+        self._machines_spec = list(machines) if machines else None
+        self._launcher = launcher if launcher is not None else LocalLauncher()
+        self._fault_injector = fault_injector
+        self.snapshot_chunk_bytes = snapshot_chunk_bytes
 
         self._models: list = []  # (name, estimator, methods)
         self._lock = threading.Lock()
         self._procs: list = []
         self._inflight: dict = {}  # rid -> _PRequest
         self._live = None  # FileHeartbeat, set at start
+        self._machines: list = []  # MachineSpec roster, set at start
+        self._live_by_machine: dict = {}  # machine name -> FileHeartbeat
+        self._machine_down: dict = {}  # machine name -> monotonic instant
+        self._snap_server = None  # SnapshotServer, machines mode only
+        self._next_slot = self.n_replicas  # scale_up slot numbering
         self._closing = False
         self._started = False
         self._monitor_stop = threading.Event()
@@ -255,6 +304,9 @@ class ProcessFleet:
         self.n_shed = 0
         self.n_replica_deaths = 0
         self.n_respawns = 0
+        self.n_machine_deaths = 0
+        self.n_drains = 0
+        self.n_scale_ups = 0
         self.n_hedged = 0
         self.n_hedge_wins = 0
         self.n_results = 0  # futures THIS router resolved (exactly once
@@ -273,12 +325,15 @@ class ProcessFleet:
                 "registry snapshot at spawn")
         self._models.append((str(name), estimator, methods))
 
-    def _child_env(self, slot: int) -> dict:
-        """The device-pinning env for replica ``slot``: each process owns
-        a DISJOINT device subset, decided before its jax ever
-        initializes."""
+    def _child_env(self, rep: _ProcReplica) -> dict:
+        """The device-pinning env for ``rep``: each process owns a
+        DISJOINT device subset, decided before its jax ever initializes.
+        On a rostered machine with a declared device inventory, the
+        machine's devices are split among ITS slots — placement already
+        weighted slot counts by inventory (``plan_placement``)."""
         import sys as sys_mod
 
+        slot = rep.slot
         env = dict(os.environ)
         # the child imports dask_ml_tpu by module path (-m): make sure
         # the package root wins whatever the parent's cwd was
@@ -300,9 +355,15 @@ class ProcessFleet:
             # from configuration instead.
             backend = env.get("JAX_PLATFORMS", "cpu").split(",")[0] or "cpu"
             devs = []
-        per = (int(self.devices_per_replica)
-               if self.devices_per_replica is not None
-               else max(len(devs) // self.n_replicas, 1))
+        m = rep.machine
+        if self.devices_per_replica is not None:
+            per = int(self.devices_per_replica)
+        elif m is not None and m.devices > 0:
+            mates = sum(1 for r in self._procs
+                        if not r.retired and r.machine is m) or 1
+            per = max(m.devices // mates, 1)
+        else:
+            per = max(len(devs) // self.n_replicas, 1)
         if backend == "cpu":
             env["JAX_PLATFORMS"] = "cpu"
             flags = [f for f in env.get("XLA_FLAGS", "").split()
@@ -322,21 +383,48 @@ class ProcessFleet:
         # the env as-is (the operator pins visible devices per replica)
         return env
 
+    def _hb(self, rep: _ProcReplica):
+        """The heartbeat fabric ``rep`` beats on: its MACHINE's workdir
+        (the single-box fleet's one fabric is just the implicit local
+        machine's)."""
+        if rep.machine is not None:
+            hb = self._live_by_machine.get(rep.machine.name)
+            if hb is not None:
+                return hb
+        return self._live
+
+    def _rep_workdir(self, rep: _ProcReplica) -> str:
+        return rep.machine.workdir if rep.machine is not None \
+            else self.workdir
+
     def _spawn(self, rep: _ProcReplica) -> None:
-        """Launch ``rep``'s process (does not wait for readiness)."""
-        self._live.clear(rep.name)  # respawn hygiene: no inherited death
-        addr_path = os.path.join(self.workdir, f"{rep.name}.addr.json")
+        """Launch ``rep``'s process on its machine via the launcher
+        (does not wait for readiness)."""
+        m = rep.machine
+        wd = self._rep_workdir(rep)
+        self._hb(rep).clear(rep.name)  # respawn hygiene: no inherited death
+        addr_path = os.path.join(wd, f"{rep.name}.addr.json")
         try:
             os.unlink(addr_path)
         except OSError:
             pass
         cmd = [sys.executable, "-m", "dask_ml_tpu.parallel.replica",
                "--name", rep.name,
-               "--snapshot", self._snapshot_path,
-               "--workdir", self.workdir,
+               "--workdir", wd,
                "--max-batch-rows", str(self.max_batch_rows),
                "--max-queue", str(self.max_queue),
                "--heartbeat-interval-s", str(self.heartbeat_interval_s)]
+        if self._snap_server is not None:
+            # machines mode: the replica FETCHES the snapshot over the
+            # chunk wire through its machine's cache, then loads the
+            # assembled local copy
+            host, port = self._snap_server.address
+            cmd += ["--snapshot", os.path.join(wd, f"{rep.name}.reg"),
+                    "--snapshot-server", f"{host}:{port}",
+                    "--snapshot-cache", os.path.join(wd, "chunk-cache"),
+                    "--machine", m.name if m is not None else ""]
+        else:
+            cmd += ["--snapshot", self._snapshot_path]
         if rep.slot in self._straggle:
             seconds, every = self._straggle[rep.slot]
             cmd += ["--straggle-s", str(float(seconds)),
@@ -347,13 +435,11 @@ class ProcessFleet:
             # would make the chaos slot a permanent death loop
             cmd += ["--kill-after-requests",
                     str(int(self._kill_after.pop(rep.slot)))]
-        log = open(os.path.join(self.workdir, f"{rep.name}.log"), "ab")
-        try:
-            rep.proc = subprocess.Popen(
-                cmd, stdout=log, stderr=subprocess.STDOUT,
-                env=self._child_env(rep.slot))
-        finally:
-            log.close()
+        target = m if m is not None else MachineSpec(
+            name="local", workdir=self.workdir)
+        rep.proc = self._launcher.spawn(
+            target, cmd, env=self._child_env(rep),
+            log_path=os.path.join(wd, f"{rep.name}.log"))
         rep.pid = rep.proc.pid
         rep.gen += 1
 
@@ -362,7 +448,8 @@ class ProcessFleet:
         """Block until ``rep``'s process announced its warmed server
         (address file), then connect. Raises on exit or timeout."""
         timeout = self.spawn_timeout_s if timeout is None else timeout
-        addr_path = os.path.join(self.workdir, f"{rep.name}.addr.json")
+        addr_path = os.path.join(self._rep_workdir(rep),
+                                 f"{rep.name}.addr.json")
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             if self._closing:
@@ -380,6 +467,7 @@ class ProcessFleet:
                 if info.get("pid") == rep.pid:
                     rep.address = (info["host"], int(info["port"]))
                     rep.warmup = info.get("warmup")
+                    rep.fetch = info.get("snapshot_fetch")
                     if rep.client is not None:
                         # the dead incarnation's timeout count must not
                         # vanish from stats() when its client is replaced
@@ -411,12 +499,43 @@ class ProcessFleet:
             self.workdir = tempfile.mkdtemp(
                 prefix=f"dask_ml_tpu_{self.name}_")
         os.makedirs(self.workdir, exist_ok=True)
-        self._live = FileHeartbeat(self.workdir)
+        # the roster: explicit machines, or ONE implicit local machine
+        # (single-box fleets behave exactly as before — same workdir,
+        # same heartbeat fabric, snapshot loads straight from disk)
+        self._machines = (list(self._machines_spec)
+                          if self._machines_spec
+                          else [MachineSpec(name="local",
+                                            workdir=self.workdir)])
+        names = [m.name for m in self._machines]
+        if len(set(names)) != len(names):
+            raise ValueError(f"machine names must be unique: {names}")
+        self._live_by_machine = {}
+        for m in self._machines:
+            os.makedirs(m.workdir, exist_ok=True)
+            self._live_by_machine[m.name] = FileHeartbeat(m.workdir)
+        self._live = self._live_by_machine[self._machines[0].name]
+        self._machine_down = {}
         self._snapshot_path = os.path.join(self.workdir, "registry.reg")
         save_registry_snapshot(self._snapshot_path, self._models)
+        if self._machines_spec:
+            # machines mode: the registry ships chunk-addressed over the
+            # snapshot wire, not by path (parallel/snapshots.py)
+            from dask_ml_tpu.parallel.snapshots import (
+                DEFAULT_CHUNK_BYTES,
+                SnapshotServer,
+            )
+
+            self._snap_server = SnapshotServer(
+                self._snapshot_path,
+                chunk_bytes=(self.snapshot_chunk_bytes
+                             or DEFAULT_CHUNK_BYTES),
+                fault_injector=self._fault_injector).start()
+        placement = plan_placement(self.n_replicas, self._machines)
         self._procs = [
-            _ProcReplica(slot=i, name=f"{self.name}-p{i}")
+            _ProcReplica(slot=i, name=f"{self.name}-p{i}",
+                         machine=placement[i])
             for i in range(self.n_replicas)]
+        self._next_slot = self.n_replicas
         try:
             for rep in self._procs:
                 self._spawn(rep)
@@ -428,6 +547,8 @@ class ProcessFleet:
             # DID come up serving forever
             for rep in self._procs:
                 self._reap_slot(rep)
+            if self._snap_server is not None:
+                self._snap_server.stop()
             raise
         self._closing = False
         self._started = True
@@ -494,6 +615,8 @@ class ProcessFleet:
         for rep in self._procs:
             if rep.client is not None:
                 rep.client.close()
+        if self._snap_server is not None:
+            self._snap_server.stop()
         with self._lock:
             leftovers = list(self._inflight.values())
             self._inflight.clear()
@@ -540,12 +663,13 @@ class ProcessFleet:
 
     def replicas_up(self) -> int:
         return sum(1 for rep in self._procs
-                   if not rep.dead and rep.client is not None)
+                   if not rep.dead and not rep.draining
+                   and rep.client is not None)
 
     def _eligible(self, exclude) -> list:
         return [rep for rep in self._procs
                 if rep.name not in exclude and not rep.dead
-                and rep.client is not None]
+                and not rep.draining and rep.client is not None]
 
     def _pick(self, exclude) -> Optional[_ProcReplica]:
         """Least-loaded routing on (in-flight attempts, quantized
@@ -865,33 +989,133 @@ class ProcessFleet:
                         "process fleet %r: monitor tick failed "
                         "(continuing)", self.name)
 
+    def _maybe_kill_machines(self) -> None:
+        """Deliver armed ``kill_machine`` plans: SIGKILL every live
+        replica pid on the plan's machine once the fleet has resolved
+        the plan's request count — all the machine's heartbeats stop AT
+        ONCE, which is exactly the signature machine-death detection
+        keys on."""
+        inj = self._fault_injector
+        if inj is None:
+            return
+        with self._lock:
+            n = self.n_results
+        for m in self._machines:
+            if not inj.should_kill_machine(m.name, n):
+                continue
+            for rep in self._procs:
+                if rep.machine is m and not rep.dead and not rep.retired \
+                        and rep.pid is not None:
+                    try:
+                        os.kill(rep.pid, signal_mod.SIGKILL)
+                    except (OSError, ProcessLookupError):
+                        pass
+
     def _monitor_tick(self) -> None:
         if self.hedge:
             self._hedge_scan()
+        self._maybe_kill_machines()
+        # PASS 1 — observe: compute each live replica's death verdict
+        # (with its CURRENT generation — the gen guard in _declare_dead
+        # makes a stale verdict, read from a proc a racing respawn
+        # already replaced, a no-op instead of a false kill)
+        pending = []
         for rep in self._procs:
             if rep.dead or rep.client is None:
                 continue
+            gen = rep.gen
             reason = None
             rc = rep.proc.poll() if rep.proc is not None else None
+            hb = self._hb(rep)
             if rc is not None:
                 reason = f"process exited with {rc}"
-            elif self._live.has_tombstone(rep.name):
+            elif hb.has_tombstone(rep.name):
                 reason = "tombstone (graceful leave)"
             else:
-                age = self._live.age(rep.name)
+                age = hb.age(rep.name)
                 if age is not None \
                         and age > self.heartbeat_timeout_s:
                     reason = f"heartbeat stale {age:.2f}s"
             if reason is not None and not self._closing:
-                self._declare_dead(rep, reason)
+                pending.append((rep, reason, gen))
+        if not pending:
+            return
+        # PASS 2 — mark machine deaths BEFORE any slot is declared (so
+        # the respawns this tick triggers already see the machine as
+        # down and place elsewhere): a machine is dead when every
+        # non-retired slot on it is dying/dead at once, none gracefully
+        self._mark_machine_deaths(pending)
+        for rep, reason, gen in pending:
+            self._declare_dead(rep, reason, gen=gen)
 
-    def _declare_dead(self, rep: _ProcReplica, reason: str) -> None:
+    def _mark_machine_deaths(self, pending: list) -> None:
+        if len(self._machines) < 2:
+            return
+        dying = {rep.name: reason for rep, reason, _gen in pending
+                 if "tombstone" not in reason}
+        for m in self._machines:
+            if m.name in self._machine_down:
+                continue
+            slots = [rep for rep in self._procs
+                     if rep.machine is m and not rep.retired]
+            if not slots:
+                continue
+            now_dying = [rep for rep in slots if rep.name in dying]
+            if not now_dying:
+                continue
+            if all(rep.dead or rep.name in dying for rep in slots):
+                self._machine_down[m.name] = time.monotonic()
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "process fleet %r: MACHINE %s declared dead "
+                    "(%d replicas down at once)",
+                    self.name, m.name, len(now_dying))
+                self._count("n_machine_deaths", "fleet.machine_deaths",
+                            machine=m.name)
+
+    def _declare_dead(self, rep: _ProcReplica, reason: str, *,
+                      gen: Optional[int] = None) -> None:
         """Terminal for this incarnation of the replica: out of
         rotation, in-flight attempts replayed on survivors, then (if
-        enabled) the slot respawns — warm first, rotation after."""
+        enabled) the slot respawns — warm first, rotation after.
+
+        ``gen`` is the incarnation the caller OBSERVED dying; if the
+        slot respawned in between (gen moved on), the verdict is stale
+        and this is a no-op — without the guard, a monitor thread
+        descheduled between poll() and here could declare a freshly
+        respawned healthy process dead (double-respawn race)."""
         import logging
 
         if rep.dead:
+            return
+        if gen is not None and gen != rep.gen:
+            return
+        if rep.draining:
+            # autoscaler retirement completing (tombstone after graceful
+            # drain): retire the slot — never respawn, never count a
+            # death; the drain finished every queued request first
+            rep.dead = True
+            rep.retired = True
+            self._set_replica_up()
+            if rep.client is not None:
+                rep.client.close()
+            with self._lock:
+                victims = [freq for freq in self._inflight.values()
+                           if rep.name in freq.outstanding
+                           and not freq.future.done()]
+            cause = ServingStopped(
+                f"replica process {rep.name!r} drained ({reason})")
+            for freq in victims:
+                with self._lock:
+                    owned = freq.outstanding.pop(rep.name, None) \
+                        is not None
+                if owned:
+                    self._reroute_or_fail(freq, rep, cause)
+            self._count("n_drains", "fleet.drains", replica=rep.name)
+            logging.getLogger(__name__).info(
+                "process fleet %r: replica %s (pid %s) drained and "
+                "retired: %s", self.name, rep.name, rep.pid, reason)
             return
         rep.dead = True
         self._set_replica_up()
@@ -931,12 +1155,32 @@ class ProcessFleet:
             self._respawners.append(t)
             t.start()
 
+    def _pick_spawn_machine(self, exclude_rep=None) -> MachineSpec:
+        """The roster row a (re)spawn lands on: surviving machines only
+        (down-marked ones excluded, with a fallback to the full roster
+        so a single-machine fleet still respawns locally), least loaded
+        by live slot count weighted by device inventory."""
+        candidates = [m for m in self._machines
+                      if m.name not in self._machine_down]
+        if not candidates:
+            candidates = list(self._machines)
+        loads: dict = {m.name: 0 for m in candidates}
+        for r in self._procs:
+            if r is exclude_rep or r.retired or r.machine is None:
+                continue
+            if r.machine.name in loads:
+                loads[r.machine.name] += 1
+        return plan_placement(1, candidates, loads=loads)[0]
+
     def _respawn(self, rep: _ProcReplica) -> None:
-        """Bring the dead slot back: fresh process, snapshot load,
+        """Bring the dead slot back: fresh process, snapshot load
+        (delta-only through the machine's chunk cache in machines mode),
         warmup through the exact serving staging path, THEN rejoin
-        rotation (the address file only appears after warmup). A stop()
-        racing this re-checks ``_closing`` on both sides of the spawn —
-        an incarnation born after the terminate loop ran is reaped HERE,
+        rotation (the address file only appears after warmup). The slot
+        is PLACED before spawn: a down-marked machine is skipped, so a
+        machine loss respawns its slots on survivors. A stop() racing
+        this re-checks ``_closing`` on both sides of the spawn — an
+        incarnation born after the terminate loop ran is reaped HERE,
         never orphaned."""
         import logging
 
@@ -949,6 +1193,13 @@ class ProcessFleet:
                     rep.proc.kill()
             if self._closing:
                 return
+            target = self._pick_spawn_machine(exclude_rep=rep)
+            if rep.machine is not None and target is not rep.machine:
+                logging.getLogger(__name__).warning(
+                    "process fleet %r: respawning %s on machine %s "
+                    "(was %s)", self.name, rep.name, target.name,
+                    rep.machine.name)
+            rep.machine = target
             self._spawn(rep)
             self._wait_ready(rep)
         except Exception as e:  # noqa: BLE001 — slot stays dead, visibly
@@ -966,9 +1217,94 @@ class ProcessFleet:
             self._reap_slot(rep)
             return
         rep.dead = False
+        # the machine a slot successfully came up on is alive by
+        # construction: clear a stale down-mark so later placements may
+        # use it again
+        if rep.machine is not None:
+            self._machine_down.pop(rep.machine.name, None)
         self._count("n_respawns", "fleet.respawns",
                     replica=rep.name, pid=rep.pid)
         self._set_replica_up()
+
+    # -- scale (the autoscaler's levers) -----------------------------------
+
+    def scale_up(self, k: int = 1) -> list:
+        """Add ``k`` fresh replica slots (placed on the least-loaded
+        surviving machines), each warmed through the full staging path
+        before joining rotation — the autoscaler's breach response.
+        Returns the new replica names. Blocks until ready: the caller's
+        control loop not ticking while capacity comes up is itself a
+        cooldown."""
+        if not self._started or self._closing:
+            raise ServingStopped(
+                f"process fleet {self.name!r} is not running")
+        names = []
+        for _ in range(int(k)):
+            with self._lock:
+                slot = self._next_slot
+                self._next_slot += 1
+            rep = _ProcReplica(
+                slot=slot, name=f"{self.name}-p{slot}",
+                machine=self._pick_spawn_machine(), dead=True)
+            # visible to the roster while warming, but dead=True keeps
+            # it out of rotation until _wait_ready connects it
+            self._procs.append(rep)
+            try:
+                self._spawn(rep)
+                self._wait_ready(rep)
+            except BaseException:
+                rep.retired = True
+                self._reap_slot(rep)
+                raise
+            rep.dead = False
+            names.append(rep.name)
+            self._count("n_scale_ups", "fleet.scale_ups",
+                        replica=rep.name)
+            self._set_replica_up()
+        return names
+
+    def drain_slot(self, name: Optional[str] = None) -> Optional[str]:
+        """Retire one replica gracefully — the autoscaler's quiet
+        response: TOMBSTONE, not kill. The slot leaves rotation
+        immediately, gets SIGTERM (graceful drain: it finishes its
+        queue, resolves every future, tombstones, exits 0), and the
+        monitor retires it when the tombstone lands — no respawn, no
+        death counter. Returns the draining replica's name, or None when
+        draining would leave the fleet empty."""
+        live = self._eligible(set())
+        if len(live) <= 1:
+            return None
+        if name is not None:
+            picked = [rep for rep in live if rep.name == name]
+            if not picked:
+                return None
+            rep = picked[0]
+        else:
+            # least-loaded, newest slot first: scale-down unwinds
+            # scale-up
+            rep = min(live, key=lambda r: (r.inflight, -r.slot))
+        rep.draining = True
+        self._set_replica_up()
+        if rep.proc is not None and rep.proc.poll() is None:
+            rep.proc.terminate()
+        return rep.name
+
+    def signals(self) -> dict:
+        """The autoscaler's input (:class:`~dask_ml_tpu.parallel.
+        autoscaler.Autoscaler`): pooled p99 of router-observed request
+        latencies, total in-flight depth, cumulative shed count, live
+        replica count — all signals the fleet already exports, read
+        without touching a replica."""
+        with self._lock:
+            lats = [dt for rep in self._procs if not rep.retired
+                    for dt in rep.lat]
+            queue = sum(rep.inflight for rep in self._procs
+                        if not rep.dead)
+            shed = self.n_shed
+        p99 = float(np.quantile(lats, 0.99)) if lats else 0.0
+        return {"p99_s": p99, "queue_depth": float(queue),
+                "shed_total": float(shed),
+                "replicas_up": self.replicas_up()}
 
     # -- observability -----------------------------------------------------
 
@@ -994,6 +1330,9 @@ class ProcessFleet:
                 "shed": self.n_shed,
                 "replica_deaths": self.n_replica_deaths,
                 "respawns": self.n_respawns,
+                "machine_deaths": self.n_machine_deaths,
+                "drains": self.n_drains,
+                "scale_ups": self.n_scale_ups,
                 "hedged": self.n_hedged,
                 "hedge_wins": self.n_hedge_wins,
                 "results": self.n_results,
@@ -1002,16 +1341,35 @@ class ProcessFleet:
         counters["timeouts"] = self._timeouts_base + sum(
             rep.client.n_timeouts for rep in self._procs
             if rep.client is not None)
+        snap = self._snap_server
         return {
             "name": self.name,
             "replicas_up": self.replicas_up(),
+            "machines": {m.name: {
+                "workdir": m.workdir,
+                "devices": m.devices,
+                "down": m.name in self._machine_down,
+                "replicas": [rep.name for rep in self._procs
+                             if rep.machine is m and not rep.retired],
+            } for m in self._machines},
+            "snapshot_server": None if snap is None else {
+                "address": list(snap.address),
+                "manifests": snap.n_manifests,
+                "chunks": snap.n_chunks,
+                "bytes_sent": snap.n_bytes_sent,
+            },
             "replicas": {rep.name: {
                 "pid": rep.pid,
                 "gen": rep.gen,
                 "dead": rep.dead,
+                "draining": rep.draining,
+                "retired": rep.retired,
+                "machine": None if rep.machine is None
+                else rep.machine.name,
                 "inflight": rep.inflight,
                 "latency_ewma_s": round(rep.ewma_s, 6),
                 "warmup": rep.warmup,
+                "snapshot_fetch": rep.fetch,
             } for rep in self._procs},
             **counters,
         }

@@ -140,6 +140,19 @@ class ReplicaHost:
         ``straggle_s`` wall-clock seconds
         (:meth:`~dask_ml_tpu.parallel.faults.FaultInjector.
         straggle_replica`) — the hedging drill's tail-latency source.
+    snapshot_server : str, optional
+        ``host:port`` of the router's
+        :class:`~dask_ml_tpu.parallel.snapshots.SnapshotServer`. When
+        set, ``snapshot_path`` is the DESTINATION: the registry is
+        FETCHED chunk-addressed through the machine's cache
+        (:func:`~dask_ml_tpu.parallel.snapshots.fetch_snapshot`) before
+        loading — a respawn on a warm machine ships only missing chunks.
+    snapshot_cache : str, optional
+        The machine-local chunk-cache directory (default:
+        ``workdir/chunk-cache``).
+    machine : str
+        This replica's machine name — labels its snapshot-wire requests
+        (``slow_link`` plans and ``snapshot.bytes_fetched{machine=}``).
     """
 
     def __init__(self, name: str, snapshot_path: str, workdir: str, *,
@@ -149,10 +162,17 @@ class ReplicaHost:
                  wedge_timeout_s: float = 10.0,
                  kill_after_requests: Optional[int] = None,
                  straggle_s: float = 0.0,
-                 straggle_every: int = 1):
+                 straggle_every: int = 1,
+                 snapshot_server: Optional[str] = None,
+                 snapshot_cache: Optional[str] = None,
+                 machine: str = ""):
         self.name = str(name)
         self.snapshot_path = str(snapshot_path)
         self.workdir = str(workdir)
+        self.snapshot_server = snapshot_server
+        self.snapshot_cache = snapshot_cache
+        self.machine = str(machine)
+        self._fetch_stats: Optional[dict] = None
         self.max_batch_rows = int(max_batch_rows)
         self.max_queue = int(max_queue)
         self.heartbeat_interval_s = float(heartbeat_interval_s)
@@ -188,7 +208,8 @@ class ReplicaHost:
         replica in rotation never compiles on the request path)."""
         info = {"name": self.name, "host": self._server.address[0],
                 "port": int(self._server.address[1]),
-                "pid": os.getpid(), "warmup": warm}
+                "pid": os.getpid(), "warmup": warm,
+                "snapshot_fetch": self._fetch_stats}
         path = self._addr_path()
         tmp = f"{path}.{os.getpid()}.tmp"
         with open(tmp, "w") as f:
@@ -220,6 +241,21 @@ class ReplicaHost:
             injector.kill_process(self.name,
                                   after_requests=int(
                                       self.kill_after_requests))
+
+        if self.snapshot_server is not None:
+            # machines mode: the registry arrives over the snapshot
+            # wire, chunk-addressed through the machine's shared cache —
+            # a respawn on a warm machine ships only the missing delta
+            from dask_ml_tpu.parallel.snapshots import (
+                fetch_snapshot,
+                parse_address,
+            )
+
+            cache = self.snapshot_cache or os.path.join(
+                self.workdir, "chunk-cache")
+            self._fetch_stats = fetch_snapshot(
+                parse_address(self.snapshot_server), self.snapshot_path,
+                cache_dir=cache, machine=self.machine)
 
         registry = ModelRegistry()
         for mname, est, methods in load_registry_snapshot(
@@ -285,6 +321,13 @@ def main(argv=None) -> int:
     parser.add_argument("--kill-after-requests", type=int, default=None)
     parser.add_argument("--straggle-s", type=float, default=0.0)
     parser.add_argument("--straggle-every", type=int, default=1)
+    parser.add_argument("--snapshot-server", default=None,
+                        help="host:port — fetch the snapshot "
+                             "chunk-addressed instead of reading it "
+                             "from disk (--snapshot becomes the "
+                             "destination path)")
+    parser.add_argument("--snapshot-cache", default=None)
+    parser.add_argument("--machine", default="")
     args = parser.parse_args(argv)
     host = ReplicaHost(
         args.name, args.snapshot, args.workdir,
@@ -294,7 +337,10 @@ def main(argv=None) -> int:
         wedge_timeout_s=args.wedge_timeout_s,
         kill_after_requests=args.kill_after_requests,
         straggle_s=args.straggle_s,
-        straggle_every=args.straggle_every)
+        straggle_every=args.straggle_every,
+        snapshot_server=args.snapshot_server,
+        snapshot_cache=args.snapshot_cache,
+        machine=args.machine)
     return host.run()
 
 

@@ -131,6 +131,34 @@ SECTIONS = [
      "next-best replica past an adaptive quantile threshold — see "
      "docs/serving.md, \"The process-isolated fleet\", and the "
      "committed FLEET_r02.json kill -9 drill."),
+    ("dask_ml_tpu.parallel.launcher", "Machine roster + remote spawn",
+     "The cross-machine seam under ProcessFleet: MachineSpec rosters "
+     "(name, coordination workdir, device inventory, per-machine env), "
+     "the pluggable Launcher spawn contract with LocalLauncher (direct "
+     "exec; tests build machines as isolated workdirs) and ExecLauncher "
+     "(command-template wrapper — the SSH shape without the ssh "
+     "dependency — with env forwarding for device-pinning vars), and "
+     "capacity-weighted least-loaded plan_placement of replica slots "
+     "onto machines — see docs/serving.md, \"The multi-machine "
+     "fleet\"."),
+    ("dask_ml_tpu.parallel.snapshots", "Snapshot distribution",
+     "Content-addressed, chunk-level registry snapshot distribution "
+     "over the framed wire: manifest_of splits a snapshot into "
+     "sha256-addressed chunks, SnapshotServer serves manifest + range "
+     "reads (re-verified, auto-refreshed on file change), ChunkCache "
+     "keeps a per-machine content-addressed store so version swaps and "
+     "respawns re-ship only changed chunks, and fetch_snapshot resumes "
+     "at any chunk boundary, retrying SnapshotTransferError under "
+     "RetryPolicy while failing loudly (never retrying) on "
+     "SnapshotCorruptError."),
+    ("dask_ml_tpu.parallel.autoscaler", "SLO autoscaler",
+     "The control loop over fleet telemetry: Autoscaler ticks "
+     "fleet.signals() (pooled p99, queue depth, shed rate) against an "
+     "SLO, scales up on consecutive-tick breach and drains (tombstone, "
+     "never kill) when consecutively quiet below a clear fraction, with "
+     "hysteresis between the bands, asymmetric up/down cooldowns, and "
+     "min/max replica bounds; every decision is recorded with the "
+     "signals that drove it and mirrored to autoscaler.* counters."),
     ("dask_ml_tpu.parallel.replica", "Replica worker process",
      "The worker half of the process-isolated fleet: the ReplicaHost "
      "entrypoint (python -m dask_ml_tpu.parallel.replica) loads a "

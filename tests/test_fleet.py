@@ -24,6 +24,7 @@ from dask_ml_tpu.parallel.fleet import (
     FleetClient,
     FleetServer,
     FleetTimeoutError,
+    RetryBudget,
     ServingFleet,
 )
 from dask_ml_tpu.parallel.serving import (
@@ -986,3 +987,160 @@ def test_clean_drain_records_no_replica_deaths(fitted):
     counters = telemetry.telemetry_report()["metrics"]["counters"]
     assert not any(k.startswith("fleet.replica_deaths")
                    for k in counters), counters
+
+
+# ---------------------------------------------------------------------------
+# adaptive hedging (in-process fleet) + client retry budgets
+# ---------------------------------------------------------------------------
+
+
+class _FirstCallStraggler:
+    """Host-fallback model whose FIRST dispatch stalls; every later
+    dispatch returns immediately — a one-request latency tail for the
+    hedge to rescue."""
+
+    def __init__(self, sleep_s=1.5):
+        self.sleep_s = sleep_s
+        self._lock = threading.Lock()
+        self.calls = 0
+
+    def predict(self, X):
+        with self._lock:
+            self.calls += 1
+            first = self.calls == 1
+        if first:
+            time.sleep(self.sleep_s)
+        return np.full(len(X), 7.0, np.float32)
+
+
+def test_hedge_rescues_tail_and_mirrors_exactly():
+    """One request lands on a replica that stalls: the hedge scan
+    re-submits it on the idle sibling past the adaptive threshold, the
+    sibling's answer resolves the future FAST, and the straggler's late
+    result is discarded (exactly-once by future semantics). Counters
+    mirror at the increment sites."""
+    telemetry.reset_telemetry()
+    with config.config_context(telemetry=True):
+        model = _FirstCallStraggler(sleep_s=1.5)
+        fleet = ServingFleet(n_replicas=2, max_batch_rows=256,
+                             hedge=True, hedge_factor=1.0,
+                             hedge_min_s=0.02, hedge_cold_s=0.05,
+                             heartbeat_timeout_s=30.0, name="hg")
+        fleet.start()
+        fleet.register("straggler", model)
+        try:
+            t0 = time.perf_counter()
+            out = fleet.call("straggler", np.zeros((8, 3), np.float32),
+                             timeout=60)
+            dt = time.perf_counter() - t0
+            assert np.array_equal(out, np.full(8, 7.0, np.float32))
+            assert dt < 1.0, "the hedge must answer before the straggler"
+            assert fleet.n_hedged == 1 and fleet.n_hedge_wins == 1
+            st = fleet.stats()
+            assert st["hedged"] == 1 and st["hedge_wins"] == 1
+        finally:
+            fleet.stop()
+        rep = telemetry.telemetry_report()
+    counters = rep["metrics"]["counters"]
+    assert sum(v for k, v in counters.items()
+               if k.startswith("serving.hedged")) == 1
+    assert sum(v for k, v in counters.items()
+               if k.startswith("serving.hedge_wins")) == 1
+
+
+def test_hedge_default_off(fitted):
+    """Hedging doubles worst-case compute per request — strictly opt-in
+    for the in-process fleet."""
+    fleet = _make_fleet(fitted, n_replicas=2)
+    try:
+        assert fleet.hedge is False
+        for i in range(5):
+            fleet.call("kmeans", fitted["X"][:8], timeout=60)
+        assert fleet.n_hedged == 0 and fleet.n_hedge_wins == 0
+    finally:
+        fleet.stop()
+
+
+def test_retry_budget_token_accounting():
+    rb = RetryBudget(ratio=0.5, initial=2.0, cap=3.0)
+    assert rb.try_spend() and rb.try_spend()
+    assert not rb.try_spend()  # dry: denied, never negative
+    assert rb.n_spent == 2 and rb.n_denied == 1
+    for _ in range(20):
+        rb.on_success()
+    assert rb.tokens() == 3.0  # deposits cap out
+    assert rb.try_spend()
+    with pytest.raises(ValueError):
+        RetryBudget(ratio=-0.1)
+
+
+def test_client_default_budget_only_with_retries(wired):
+    _fleet, server = wired
+    with FleetClient(server.address) as cli:
+        assert cli.retry_budget is None  # no retries, no bucket
+    with FleetClient(server.address, retries=2) as cli:
+        assert isinstance(cli.retry_budget, RetryBudget)
+
+
+def test_client_retry_recovers_after_timeout():
+    """Attempt 1 times out against a gated model; the gate opens before
+    attempt 2's deadline — the retry succeeds, spends one token, and the
+    success deposits back into the budget."""
+    gate = _GateModel()
+    fleet = ServingFleet(n_replicas=1, max_batch_rows=8,
+                         heartbeat_timeout_s=60.0)
+    fleet.start()
+    fleet.registry.register("gate", gate)
+    server = FleetServer(fleet).start()
+    budget = RetryBudget(ratio=0.5, initial=2.0)
+    opener = threading.Timer(1.5, gate.release.set)
+    try:
+        with FleetClient(server.address, retries=2,
+                         retry_budget=budget) as cli:
+            opener.start()
+            out = cli.call("gate", np.zeros((4, 3), np.float32),
+                           timeout=1.0)
+            assert out.shape == (4,)
+            assert cli.n_retries == 1
+            assert budget.n_spent == 1
+            assert budget.tokens() == pytest.approx(1.5)  # -1.0 + 0.5
+    finally:
+        opener.cancel()
+        gate.release.set()
+        server.stop()
+        fleet.stop()
+
+
+def test_retry_budget_exhausted_stops_the_storm():
+    """A degraded server dries the bucket: with 1 initial token and no
+    successes, a 5-retry client performs exactly ONE retry, then the
+    denial surfaces the original timeout — the retry load FALLS with the
+    success rate instead of multiplying it. Exhaustion mirrors as
+    ``fleet.retry_budget_exhausted``."""
+    gate = _GateModel()  # never released while the client is trying
+    fleet = ServingFleet(n_replicas=1, max_batch_rows=8,
+                         heartbeat_timeout_s=60.0)
+    fleet.start()
+    fleet.registry.register("gate", gate)
+    server = FleetServer(fleet).start()
+    telemetry.reset_telemetry()
+    try:
+        with config.config_context(telemetry=True):
+            budget = RetryBudget(ratio=0.0, initial=1.0)
+            with FleetClient(server.address, retries=5,
+                             retry_budget=budget) as cli:
+                t0 = time.perf_counter()
+                with pytest.raises(FleetTimeoutError):
+                    cli.call("gate", np.zeros((4, 3), np.float32),
+                             timeout=0.3)
+                assert time.perf_counter() - t0 < 3.0  # not 5 x 0.3s
+                assert cli.n_retries == 1
+                assert cli.n_budget_exhausted == 1
+                assert budget.n_denied == 1
+        counters = telemetry.telemetry_report()["metrics"]["counters"]
+        assert counters["fleet.retries"] == 1
+        assert counters["fleet.retry_budget_exhausted"] == 1
+    finally:
+        gate.release.set()
+        server.stop()
+        fleet.stop()

@@ -1,0 +1,361 @@
+"""The cross-machine fleet tier: remote-spawn launchers and
+capacity-weighted placement (``parallel/launcher.py``), the SLO
+autoscaler's control law (``parallel/autoscaler.py``), and the
+multi-machine :class:`~dask_ml_tpu.parallel.procfleet.ProcessFleet`
+end-to-end — "machines" are isolated workdirs + their own OS processes
+on loopback, which exercises every seam (placement, snapshot
+distribution, machine-death detection, replay, respawn-elsewhere)
+without needing a second box.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu.parallel.autoscaler import SLO, Autoscaler
+from dask_ml_tpu.parallel.faults import FaultInjector
+from dask_ml_tpu.parallel.launcher import (
+    ExecLauncher,
+    LocalLauncher,
+    MachineSpec,
+    plan_placement,
+)
+
+
+# ---------------------------------------------------------------------------
+# placement
+# ---------------------------------------------------------------------------
+
+
+def _roster(tmp_path, *rows):
+    return [MachineSpec(name=n, workdir=str(tmp_path / n), devices=d)
+            for n, d in rows]
+
+
+def test_placement_round_robins_equal_machines(tmp_path):
+    machines = _roster(tmp_path, ("m0", 0), ("m1", 0))
+    plan = plan_placement(4, machines)
+    counts = {m.name: sum(1 for p in plan if p is m) for m in machines}
+    assert counts == {"m0": 2, "m1": 2}
+    # and slots alternate rather than clumping
+    assert [p.name for p in plan[:2]] in (["m0", "m1"], ["m1", "m0"])
+
+
+def test_placement_weights_by_device_inventory(tmp_path):
+    machines = _roster(tmp_path, ("big", 4), ("small", 2))
+    plan = plan_placement(6, machines)
+    counts = {m.name: sum(1 for p in plan if p is m) for m in machines}
+    # a 4-chip machine takes twice the slots of a 2-chip one
+    assert counts == {"big": 4, "small": 2}
+
+
+def test_placement_seeds_existing_loads(tmp_path):
+    machines = _roster(tmp_path, ("m0", 0), ("m1", 0))
+    plan = plan_placement(2, machines, loads={"m0": 5})
+    assert [p.name for p in plan] == ["m1", "m1"]
+
+
+def test_placement_rejects_empty_roster():
+    with pytest.raises(ValueError):
+        plan_placement(2, [])
+
+
+# ---------------------------------------------------------------------------
+# launchers
+# ---------------------------------------------------------------------------
+
+
+def test_local_launcher_runs_in_machine_workdir(tmp_path):
+    m = MachineSpec(name="loc", workdir=str(tmp_path / "wd"))
+    proc = LocalLauncher().spawn(
+        m, [sys.executable, "-c", "open('here.txt', 'w').write('y')"],
+        env=dict(os.environ))
+    assert proc.wait(30) == 0
+    assert (tmp_path / "wd" / "here.txt").read_text() == "y"
+
+
+def test_exec_launcher_formats_template_and_forwards_env(tmp_path):
+    m = MachineSpec(name="mx", workdir=str(tmp_path / "wd"),
+                    host="127.0.0.9")
+    launcher = ExecLauncher(
+        ["sh", "-c", "echo {machine} {host} > seen.txt; exec {cmd}"],
+        env_forward=("DMLT_LAUNCH_TEST",))
+    env = dict(os.environ)
+    env["DMLT_LAUNCH_TEST"] = "forwarded through the template"
+    proc = launcher.spawn(
+        m, [sys.executable, "-c",
+            "import os; open('out.txt', 'w')"
+            ".write(os.environ['DMLT_LAUNCH_TEST'])"],
+        env=env, log_path=str(tmp_path / "wd.log"))
+    assert proc.wait(30) == 0
+    # {machine}/{host} substituted from the roster row; cwd = workdir
+    assert (tmp_path / "wd" / "seen.txt").read_text().split() \
+        == ["mx", "127.0.0.9"]
+    # the env prefix carried the var THROUGH the exec template (an ssh
+    # hop would not inherit the kernel-injected env)
+    assert (tmp_path / "wd" / "out.txt").read_text() \
+        == "forwarded through the template"
+
+
+def test_exec_launcher_machine_env_overrides(tmp_path):
+    m = MachineSpec(name="me", workdir=str(tmp_path / "wd"),
+                    env={"DMLT_LAUNCH_TEST": "machine wins"})
+    launcher = ExecLauncher(["sh", "-c", "exec {cmd}"],
+                            env_forward=("DMLT_LAUNCH_TEST",))
+    env = dict(os.environ)
+    env["DMLT_LAUNCH_TEST"] = "router value"
+    proc = launcher.spawn(
+        m, [sys.executable, "-c",
+            "import os; open('out.txt', 'w')"
+            ".write(os.environ['DMLT_LAUNCH_TEST'])"], env=env)
+    assert proc.wait(30) == 0
+    assert (tmp_path / "wd" / "out.txt").read_text() == "machine wins"
+
+
+def test_exec_launcher_rejects_empty_template():
+    with pytest.raises(ValueError):
+        ExecLauncher([])
+
+
+# ---------------------------------------------------------------------------
+# the autoscaler control law (driven tick-by-tick on a synthetic clock)
+# ---------------------------------------------------------------------------
+
+
+class FakeFleet:
+    """signals()/scale_up()/drain_slot() contract double."""
+
+    def __init__(self, replicas=2):
+        self.replicas = replicas
+        self.sig = {"p99_s": 0.0, "queue_depth": 0.0, "shed_total": 0.0}
+        self.n_up_calls = 0
+        self.n_down_calls = 0
+
+    def signals(self):
+        return {**self.sig, "replicas_up": self.replicas}
+
+    def scale_up(self, k):
+        self.replicas += int(k)
+        self.n_up_calls += 1
+        return [f"fake-p{self.replicas}"]
+
+    def drain_slot(self):
+        if self.replicas <= 1:
+            return None
+        self.replicas -= 1
+        self.n_down_calls += 1
+        return f"fake-p{self.replicas}"
+
+
+def _scaler(fleet, **kw):
+    kw.setdefault("slo", SLO(target_p99_s=0.1, max_queue_depth=4.0,
+                             max_shed_per_s=0.0))
+    kw.setdefault("breach_ticks", 2)
+    kw.setdefault("quiet_ticks", 3)
+    kw.setdefault("scale_up_cooldown_s", 1.0)
+    kw.setdefault("scale_down_cooldown_s", 5.0)
+    kw.setdefault("max_replicas", 4)
+    slo = kw.pop("slo")
+    return Autoscaler(fleet, slo, **kw)
+
+
+def test_breach_needs_consecutive_ticks_then_scales_up():
+    fleet = FakeFleet(replicas=2)
+    sc = _scaler(fleet)
+    fleet.sig["p99_s"] = 0.5  # 5x the SLO
+    assert sc.tick(now=0.00) is None  # streak 1: one slow tick is noise
+    assert sc.tick(now=0.25) == "scale_up"
+    assert fleet.replicas == 3
+    assert sc.n_scale_ups == 1 and sc.n_breaches == 2
+    d = sc.decisions[-1]
+    assert d["action"] == "scale_up" and "p99" in d["reason"]
+
+
+def test_spike_resets_breach_streak():
+    fleet = FakeFleet(replicas=2)
+    sc = _scaler(fleet)
+    fleet.sig["queue_depth"] = 100.0
+    assert sc.tick(now=0.0) is None
+    fleet.sig["queue_depth"] = 0.0  # spike over: streak resets
+    assert sc.tick(now=0.25) is None
+    fleet.sig["queue_depth"] = 100.0
+    assert sc.tick(now=0.50) is None  # streak back to 1, not 2
+    assert sc.tick(now=0.75) == "scale_up"
+
+
+def test_scale_up_cooldown_spaces_actions():
+    fleet = FakeFleet(replicas=1)
+    sc = _scaler(fleet)
+    fleet.sig["p99_s"] = 0.5  # sustained breach
+    acted = [sc.tick(now=t / 4) for t in range(16)]  # ticks every 0.25s
+    ups = [t / 4 for t, a in zip(range(16), acted) if a == "scale_up"]
+    assert len(ups) >= 2
+    assert all(b - a >= sc.scale_up_cooldown_s
+               for a, b in zip(ups, ups[1:]))
+
+
+def test_max_replicas_bounds_scale_up():
+    fleet = FakeFleet(replicas=2)
+    sc = _scaler(fleet, max_replicas=2)
+    fleet.sig["p99_s"] = 0.5
+    for t in range(8):
+        assert sc.tick(now=t * 0.25) is None
+    assert fleet.n_up_calls == 0  # a storm can never fork-bomb the box
+
+
+def test_quiet_drains_down_to_min_replicas():
+    fleet = FakeFleet(replicas=3)
+    sc = _scaler(fleet, min_replicas=2)
+    # all-zero signals: quiet (below clear_fraction of every bound)
+    acts = [sc.tick(now=float(t)) for t in range(12)]
+    assert acts.count("scale_down") == 1  # drained 3 -> 2, then floor
+    assert fleet.replicas == 2 and fleet.n_down_calls == 1
+    assert sc.decisions[-1]["action"] == "scale_down"
+
+
+def test_hysteresis_band_takes_no_action():
+    fleet = FakeFleet(replicas=2)
+    sc = _scaler(fleet)
+    # above clear_fraction (0.5 x 0.1 = 0.05) but below the bound (0.1):
+    # neither breaching nor quiet -- the band exists so the scaler never
+    # flaps around the threshold
+    fleet.sig["p99_s"] = 0.08
+    for t in range(20):
+        assert sc.tick(now=t * 0.25) is None
+    assert fleet.n_up_calls == 0 and fleet.n_down_calls == 0
+    st = sc.stats()
+    assert st["breach_streak"] == 0 and st["quiet_streak"] == 0
+
+
+def test_shed_rate_is_a_breach_signal():
+    fleet = FakeFleet(replicas=1)
+    slo = SLO(target_p99_s=float("inf"),
+              max_queue_depth=float("inf"), max_shed_per_s=1.0)
+    sc = _scaler(fleet, slo=slo)
+    assert sc.tick(now=0.0) is None  # no rate on the first observation
+    fleet.sig["shed_total"] = 10.0  # 10 sheds over the next second
+    assert sc.tick(now=1.0) is None  # rate 10/s > 1/s: streak 1
+    fleet.sig["shed_total"] = 20.0
+    assert sc.tick(now=2.0) == "scale_up"
+    assert "shed" in sc.decisions[-1]["reason"]
+
+
+def test_autoscaler_validates_bounds():
+    with pytest.raises(ValueError):
+        Autoscaler(FakeFleet(), min_replicas=0)
+    with pytest.raises(ValueError):
+        Autoscaler(FakeFleet(), min_replicas=3, max_replicas=2)
+
+
+# ---------------------------------------------------------------------------
+# the two-machine fleet, end to end
+# ---------------------------------------------------------------------------
+
+
+def _fetch_stats(fleet):
+    return {name: st["snapshot_fetch"]
+            for name, st in fleet.stats()["replicas"].items()
+            if st["snapshot_fetch"] is not None}
+
+
+def test_two_machine_fleet_lifecycle(tmp_path):
+    """The full cross-machine story in one deterministic sequence:
+    capacity-weighted placement across two isolated machines, snapshot
+    distribution with per-machine chunk caches (scale-up on a warm
+    machine ships ZERO bytes), graceful drain, then machine loss under
+    traffic — zero dropped futures, survivors absorb the replay, the
+    dead machine's slots respawn on the survivor from its cache."""
+    from dask_ml_tpu.cluster import KMeans
+    from dask_ml_tpu.parallel.procfleet import ProcessFleet
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(256, 8).astype(np.float32)
+    km = KMeans(n_clusters=4, random_state=0, max_iter=5).fit(X)
+    direct = km.predict(X)
+
+    inj = FaultInjector()
+    machines = [
+        MachineSpec(name="m0", workdir=str(tmp_path / "m0")),
+        MachineSpec(name="m1", workdir=str(tmp_path / "m1")),
+    ]
+    # spawn THROUGH the exec template: the ssh shape, pointed at sh
+    fleet = ProcessFleet(
+        n_replicas=2, max_batch_rows=256, request_timeout_s=120.0,
+        name="tmf", machines=machines, fault_injector=inj,
+        launcher=ExecLauncher(["sh", "-c", "exec {cmd}"]),
+        snapshot_chunk_bytes=256)
+    fleet.register("kmeans", km)
+    fleet.start()
+    try:
+        # -- placement + initial distribution ---------------------------
+        st = fleet.stats()
+        assert {m: len(row["replicas"])
+                for m, row in st["machines"].items()} == {"m0": 1, "m1": 1}
+        assert st["snapshot_server"] is not None
+        assert st["snapshot_server"]["chunks"] > 0
+        fetches = _fetch_stats(fleet)
+        assert len(fetches) == 2
+        full_bytes = next(iter(fetches.values()))["bytes_total"]
+        for fs in fetches.values():  # first replica per machine: cold
+            assert fs["chunks_total"] >= 2  # several chunks: deltas exist
+            assert fs["bytes_fetched"] == fs["bytes_total"] == full_bytes
+
+        # -- bit identity across machines --------------------------------
+        out = fleet.submit("kmeans", X).result(120)
+        assert np.array_equal(out, direct)
+
+        # -- scale-up reuses the machine's chunk cache --------------------
+        (new_name,) = fleet.scale_up(1)
+        st = fleet.stats()
+        assert st["replicas_up"] == 3 and st["scale_ups"] == 1
+        new_fetch = st["replicas"][new_name]["snapshot_fetch"]
+        assert new_fetch["bytes_fetched"] == 0  # delta-only re-ship
+        assert new_fetch["chunks_cached"] == new_fetch["chunks_total"]
+
+        # -- graceful drain: tombstone, not a death -----------------------
+        drained = fleet.drain_slot()
+        assert drained == new_name  # newest slot unwinds first
+        deadline = time.monotonic() + 30.0
+        while fleet.stats()["drains"] < 1:
+            assert time.monotonic() < deadline, "drain never retired"
+            time.sleep(0.05)
+        st = fleet.stats()
+        assert st["replicas_up"] == 2
+        assert st["replica_deaths"] == 0 and st["respawns"] == 0
+
+        # -- machine loss under traffic -----------------------------------
+        futs = [fleet.submit("kmeans", X[: 32 + (i % 8)])
+                for i in range(30)]
+        inj.kill_machine("m1", after_results=0)
+        for i, fut in enumerate(futs):
+            n = 32 + (i % 8)
+            assert np.array_equal(fut.result(120), direct[:n]), i
+        deadline = time.monotonic() + 60.0
+        while fleet.stats()["respawns"] < 1:
+            assert time.monotonic() < deadline, "no respawn after kill"
+            time.sleep(0.05)
+        st = fleet.stats()
+        assert st["machine_deaths"] == 1
+        assert st["machines"]["m1"]["down"]
+        assert inj.injected["machine_kill"] == 1
+        # the dead machine's slot came back on the SURVIVOR, and its
+        # chunks were already cached there: the link carried no bytes
+        live = {name: row for name, row in st["replicas"].items()
+                if not row["dead"] and not row["retired"]}
+        assert len(live) == 2
+        assert {row["machine"] for row in live.values()} == {"m0"}
+        respawned = [row for row in live.values() if row["gen"] > 1]
+        assert respawned and all(
+            row["snapshot_fetch"]["bytes_fetched"] == 0
+            for row in respawned)
+
+        # -- the rejoined fleet serves bit-identically, zero compiles -----
+        out = fleet.submit("kmeans", X).result(120)
+        assert np.array_equal(out, direct)
+        for name, rst in fleet.remote_stats().items():
+            assert rst["steady_compiles"] == 0, name
+    finally:
+        fleet.stop()

@@ -200,6 +200,25 @@ def test_sigkill_respawn_zero_drops(pfleet, fitted):
     assert victim.pid in {st["pid"] for st in remote.values()}
 
 
+def test_stale_death_verdict_is_a_noop(pfleet):
+    """Double-respawn race pin: the monitor observes a death verdict
+    with the GENERATION it was computed against; if the slot respawned
+    in between (gen moved on), ``_declare_dead`` must be a no-op — not
+    a false kill of the fresh healthy process. Pinned deterministically
+    by presenting a verdict one generation stale."""
+    rep = next(r for r in pfleet._procs if not r.dead and not r.retired)
+    deaths_before = pfleet.n_replica_deaths
+    up_before = pfleet.replicas_up()
+    pfleet._declare_dead(rep, "heartbeat stale 9.99s", gen=rep.gen - 1)
+    assert not rep.dead and not rep.retired
+    assert rep.proc.poll() is None  # the real process was never touched
+    assert pfleet.n_replica_deaths == deaths_before
+    assert pfleet.replicas_up() == up_before
+    # and the CURRENT generation's verdict still lands (sanity that the
+    # guard compares gens rather than swallowing everything): exercised
+    # end-to-end by the SIGKILL test above via the monitor thread.
+
+
 # ---------------------------------------------------------------------------
 # hedging + telemetry mirror exactness
 # ---------------------------------------------------------------------------
