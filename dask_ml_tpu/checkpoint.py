@@ -397,6 +397,12 @@ class CellJournal:
     def __init__(self, path: str):
         self.path = path
         self._lock = threading.Lock()
+        #: lifetime records appended / restored through THIS handle —
+        #: host-side mirrors of the ``checkpoint.cells_journaled`` /
+        #: ``checkpoint.cells_restored`` registry counters, incremented at
+        #: the same sites (docs/observability.md discipline)
+        self.n_appended = 0
+        self.n_restored = 0
         d = os.path.dirname(os.path.abspath(path)) or "."
         os.makedirs(d, exist_ok=True)
 
@@ -420,6 +426,12 @@ class CellJournal:
         if done:
             logger.info("search checkpoint %s: restored %d completed cells",
                         self.path, len(done))
+            self.n_restored += len(done)
+            from dask_ml_tpu.parallel import telemetry
+
+            if telemetry.enabled():
+                telemetry.metrics().counter(
+                    "checkpoint.cells_restored").inc(len(done))
         return done
 
     def append(self, key: str, result) -> None:
@@ -429,3 +441,8 @@ class CellJournal:
                             protocol=pickle.HIGHEST_PROTOCOL)
                 f.flush()
                 os.fsync(f.fileno())
+            self.n_appended += 1
+        from dask_ml_tpu.parallel import telemetry
+
+        if telemetry.enabled():
+            telemetry.metrics().counter("checkpoint.cells_journaled").inc()

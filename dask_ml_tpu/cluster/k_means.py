@@ -26,6 +26,14 @@ from dask_ml_tpu.utils.validation import check_array, check_random_state
 
 logger = logging.getLogger(__name__)
 
+#: Sketched-epilogue dispatch for the QuicK-means restricted Lloyd
+#: rounds: ``True`` (default) runs them through ``lloyd_loop_bounded``,
+#: driving the fused family's ``row_need`` block-skip on the staged
+#: sketch columns — exact by the BOUNDS theorem, so the sketched fit is
+#: bit-identical to the fused-loop epilogue (pinned in
+#: tests/test_asha.py; tests flip this to obtain the fused reference).
+_SKETCHED_BOUNDED = True
+
 
 class KMeans(TransformerMixin, BaseEstimator):
     """Scalable KMeans with k-means|| initialization.
@@ -317,12 +325,33 @@ class KMeans(TransformerMixin, BaseEstimator):
                 centers - mu[None, :].astype(centers.dtype), p,
                 n_iter=int(self.sketch_iters))
             Zp = _sketch_stage(ft, data.X, mu, support)
+        def _restricted_lloyd(Zp_, vals0_, tol_):
+            # One restricted Lloyd round. Default dispatch is the BOUNDED
+            # loop: the sketch staging Zp is plain (n, p) data to the
+            # family, so the Elkan/Yinyang bounds drive the ``row_need``
+            # block-skip through the sketched epilogue's distance passes —
+            # and by the BOUNDS theorem the trajectory is bit-identical
+            # to the fused loop (pruning removes work, never changes
+            # bytes; pinned in tests/test_asha.py). Returns
+            # (vals, n_iter, prune_stats-or-None).
+            if _SKETCHED_BOUNDED:
+                from dask_ml_tpu.parallel.precision import \
+                    lloyd_bounds_dtype
+
+                vals_, _, n_it, _, _, stats = core.lloyd_loop_bounded(
+                    Zp_, data.weights, vals0_, tol_, mesh=data.mesh,
+                    max_iter=self.max_iter,
+                    bounds_dtype=lloyd_bounds_dtype(Zp_.dtype))
+                return vals_, int(n_it), stats
+            vals_, _, n_it, _ = core.lloyd_loop_fused(
+                Zp_, data.weights, vals0_, tol_,
+                mesh=data.mesh, max_iter=self.max_iter)
+            return vals_, int(n_it), None
+
         with telemetry.span("kmeans-lloyd", logger=logger,
                             algorithm="sketched"):
             tol = core.scaled_tolerance(Zp, data.weights, self.tol)
-            vals, _, n_iter1, _ = core.lloyd_loop_fused(
-                Zp, data.weights, vals0, tol,
-                mesh=data.mesh, max_iter=self.max_iter)
+            vals, n_iter1, prune1 = _restricted_lloyd(Zp, vals0, tol)
             # round 2: refit on the converged (centered) reconstruction,
             # re-stage, continue the loop in the refreshed support
             with telemetry.span("kmeans.sketch-refit", p=p):
@@ -331,9 +360,7 @@ class KMeans(TransformerMixin, BaseEstimator):
                     n_iter=int(self.sketch_iters))
                 Zp = _sketch_stage(ft, data.X, mu, support)
             tol = core.scaled_tolerance(Zp, data.weights, self.tol)
-            vals, _, n_iter2, _ = core.lloyd_loop_fused(
-                Zp, data.weights, vals0, tol,
-                mesh=data.mesh, max_iter=self.max_iter)
+            vals, n_iter2, prune2 = _restricted_lloyd(Zp, vals0, tol)
             n_iter = int(n_iter1) + int(n_iter2)
         with telemetry.span("kmeans.finalize"):
             centers_sk = ftm.reconstruct(ft, vals, support) + mu[None, :]
@@ -368,6 +395,41 @@ class KMeans(TransformerMixin, BaseEstimator):
         self.sketch_staging_ = np.asarray(Wp)
         self.sketch_offset_ = np.asarray(off)
         self.sketch_loss_ = float(fit_loss)
+        if prune1 is not None:
+            # pruning observability for the restricted rounds, the shape
+            # of the exact path's ``lloyd_pruning_`` summed over both
+            # QuicK-means rounds (and the same registry mirrors, at the
+            # same increment site)
+            skip = np.concatenate([
+                np.asarray(jax.device_get(st["rows_skipped"]))[:ni]
+                for st, ni in ((prune1, n_iter1), (prune2, n_iter2))])
+            held = np.concatenate([
+                np.asarray(jax.device_get(st["bounds_held"]))[:ni]
+                for st, ni in ((prune1, n_iter1), (prune2, n_iter2))])
+            n_real = int(jax.device_get(
+                jnp.sum((data.weights > 0).astype(jnp.int32))))
+            denom = max(n_real, 1)
+            self.sketch_pruning_ = {
+                "rows_skipped": int(skip.sum()),
+                "rows_considered": int(n_iter) * n_real,
+                "distances_avoided": int(skip.sum()) * int(self.n_clusters),
+                "pruned_fraction_per_iter": [
+                    float(s) / denom for s in skip],
+                "bound_held_fraction_per_iter": [
+                    float(h) / denom for h in held],
+            }
+            if telemetry.enabled():
+                reg = telemetry.metrics()
+                reg.counter("kmeans.lloyd.rows_skipped").inc(
+                    self.sketch_pruning_["rows_skipped"])
+                reg.counter("kmeans.lloyd.rows_considered").inc(
+                    self.sketch_pruning_["rows_considered"])
+                reg.counter("kmeans.lloyd.distances_avoided").inc(
+                    self.sketch_pruning_["distances_avoided"])
+                h = reg.histogram("kmeans.lloyd.pruned_fraction")
+                for frac in self.sketch_pruning_[
+                        "pruned_fraction_per_iter"]:
+                    h.observe(frac)
         if self.n_clusters <= 255:
             labels = labels.astype(jnp.uint8)
         self.labels_ = np.asarray(

@@ -1,6 +1,10 @@
 """Hyper-parameter search and CV splitting
 (reference: dask_ml/model_selection/__init__.py)."""
 
+from dask_ml_tpu.model_selection._incremental import (
+    HyperbandSearchCV,
+    SuccessiveHalvingSearchCV,
+)
 from dask_ml_tpu.model_selection._search import (
     GridSearchCV,
     RandomizedSearchCV,
@@ -16,7 +20,9 @@ from dask_ml_tpu.model_selection._split import (
 
 __all__ = [
     "GridSearchCV",
+    "HyperbandSearchCV",
     "RandomizedSearchCV",
+    "SuccessiveHalvingSearchCV",
     "TPUBaseSearchCV",
     "KFold",
     "ShuffleSplit",

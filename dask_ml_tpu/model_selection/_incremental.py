@@ -1,0 +1,984 @@
+"""Asynchronous successive-halving / Hyperband search on the elastic
+data plane (reference: dask_ml/model_selection/_incremental.py,
+_successive_halving.py, _hyperband.py).
+
+The grid/random driver (``_search.py``) is SYNCHRONOUS: one generation,
+every candidate fit to completion, a single straggler cell gating the
+sweep, budget spent equally on doomed candidates. This module spends the
+budget at progressively finer resolution on survivors only — dask-ml's
+own later-era flagship (PAPER.md pillar 4), rebuilt on the substrate
+this repo owns instead of dask futures:
+
+- **rungs are epochs over the elastic data plane**: training data is
+  split once into host-side blocks; a rung advances every surviving
+  candidate ``partial_fit``-wise through N epochs whose per-epoch block
+  order is a seeded :class:`~dask_ml_tpu.parallel.elastic.BlockPlan`
+  permutation — a pure function of (seed, epoch), so every host and
+  every resume replays the identical stream.
+- **promotion is host-side arithmetic over journaled scores**: each
+  (candidate, rung) result — validation score AND the candidate's full
+  post-rung model state — is one content-addressed
+  :class:`~dask_ml_tpu.checkpoint.CellJournal` record. Keep the top
+  ``1/aggressiveness`` by (score, lowest id) and multiply the epoch
+  budget; a killed search resumes mid-bracket and reproduces the
+  remaining rungs bit-identically, because a rung result is a pure
+  function of (rung-start journaled state, seeded epoch orders).
+- **asynchronous promotion ≠ compile storm**: candidates of a bracket
+  advance through ONE jitted program
+  (:func:`dask_ml_tpu.models.glm.make_batched_sgd_epoch`) whose
+  per-member hyperparameters are traced vectors and whose fixed batch
+  width carries an alive-mask — a promotion shrinks the mask, never a
+  shape, so after a bracket's first rung (where every candidate and
+  every program runs) later rungs execute ZERO fresh heavy compiles
+  (gated per rung via
+  :func:`~dask_ml_tpu.parallel.shapes.track_compiles`). Estimators
+  outside the batched fast path (e.g. ``MiniBatchKMeans``) run
+  per-candidate ``partial_fit`` whose jitted step/score programs all
+  compile in rung 0 for the same reason.
+- **multi-host**: pass ``elastic=`` an
+  :class:`~dask_ml_tpu.parallel.elastic.ElasticRun` and the rung's
+  (candidate × rung) work items become elastic BLOCKS — each host
+  computes its contiguous share, publishes atomically, and
+  ``collect_epoch`` handles death re-deals plus the speculative
+  straggler re-deal (``speculate_after``). Candidate results are pure,
+  so any host recomputing one reproduces its bytes: a kill-one-host
+  drill mid-search drops zero candidates and changes zero bits.
+
+Timeout semantics differ from the synchronous driver's by design: a
+cell that exceeds ``cell_timeout`` there scores ``error_score``; a
+STREAMING candidate that exceeds the per-rung deadline keeps its last
+COMPLETED rung's journaled score and is merely stopped (degraded, not
+deleted) — a straggler loses the promotion race, not its history.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import time
+from typing import Optional
+
+import numpy as np
+from sklearn.base import BaseEstimator, MetaEstimatorMixin, clone
+from sklearn.model_selection import ParameterGrid, ParameterSampler
+
+from dask_ml_tpu.model_selection._search import (
+    _content_array,
+    _index,
+    _n_rows,
+    _scoring_identity,
+    run_with_soft_deadline,
+)
+from dask_ml_tpu.model_selection._tokenize import tokenize
+from dask_ml_tpu.parallel import telemetry
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["SuccessiveHalvingSearchCV", "HyperbandSearchCV",
+           "bracket_rungs", "hyperband_brackets"]
+
+
+# ---------------------------------------------------------------------------
+# bracket arithmetic (pure, host-side — what the tests hand-compute)
+# ---------------------------------------------------------------------------
+
+
+def bracket_rungs(n0: int, r0: int, eta: int,
+                  max_epochs: Optional[int] = None) -> list:
+    """The successive-halving schedule for one bracket:
+    ``[(rung, n_alive, cumulative_epochs)]``.
+
+    Rung k holds ``n_k`` candidates trained to ``r_k`` TOTAL epochs;
+    promotion keeps ``max(1, n_k // eta)`` of them and multiplies the
+    budget by ``eta`` (capped at ``max_epochs``). With ``max_epochs``
+    set, a lone survivor still trains on to the cap (the classic
+    Hyperband last rung); without it the bracket ends at the first rung
+    a single candidate survives.
+    """
+    eta = int(eta)
+    if eta < 2:
+        raise ValueError(f"aggressiveness must be >= 2, got {eta}")
+    cap = None if max_epochs is None else int(max_epochs)
+    n, r, k = int(n0), int(r0), 0
+    if cap is not None:
+        r = min(r, cap)
+    out = []
+    while True:
+        out.append((k, n, r))
+        if (n == 1 and (cap is None or r >= cap)) or (
+                cap is not None and r >= cap):
+            return out
+        n = max(1, n // eta)
+        r = r * eta if cap is None else min(r * eta, cap)
+        k += 1
+
+
+def hyperband_brackets(max_epochs: int, eta: int) -> list:
+    """The Hyperband bracket set ``[(s, n0, r0)]``, most exploratory
+    first: ``s_max = floor(log_eta(max_epochs))`` brackets trading
+    initial candidates against initial epochs at roughly equal total
+    budget (Li et al., arxiv 1603.06560 — the bracket arithmetic
+    dask-ml's ``HyperbandSearchCV`` uses)."""
+    eta = int(eta)
+    R = int(max_epochs)
+    if eta < 2:
+        raise ValueError(f"aggressiveness must be >= 2, got {eta}")
+    if R < 1:
+        raise ValueError(f"max_epochs must be >= 1, got {R}")
+    s_max = int(np.floor(np.log(R) / np.log(eta)))
+    out = []
+    for s in range(s_max, -1, -1):
+        n0 = int(np.ceil((s_max + 1) / (s + 1) * eta ** s))
+        r0 = max(1, int(R * eta ** -s))
+        out.append((s, n0, r0))
+    return out
+
+
+class _RungTimeout(Exception):
+    """Internal: a candidate's rung exceeded the soft deadline."""
+
+    def __init__(self, cid: int):
+        super().__init__(f"candidate {cid} rung timed out")
+        self.cid = cid
+
+
+def _record_to_tree(rec: Optional[dict]) -> dict:
+    """A rung record as a numpy pytree for atomic elastic publication
+    (``save_pytree`` frames arrays, not arbitrary objects). ``None``
+    (a timed-out candidate) publishes a sentinel so peers' rung
+    assembly never blocks on a straggler that was already degraded."""
+    if rec is None:
+        return {"timeout": np.int64(1)}
+    return {
+        "score": np.float64(rec["score"]),
+        "blob": np.frombuffer(rec["blob"], dtype=np.uint8).copy(),
+        "n_epochs": np.int64(rec["n_epochs"]),
+        "pf_calls": np.int64(rec["pf_calls"]),
+        "fit_seconds": np.float64(rec["fit_seconds"]),
+        "score_seconds": np.float64(rec["score_seconds"]),
+    }
+
+
+def _tree_to_record(tree: dict) -> Optional[dict]:
+    if "timeout" in tree:
+        return None
+    return {
+        "score": float(tree["score"]),
+        "blob": np.asarray(tree["blob"], dtype=np.uint8).tobytes(),
+        "n_epochs": int(tree["n_epochs"]),
+        "pf_calls": int(tree["pf_calls"]),
+        "fit_seconds": float(tree["fit_seconds"]),
+        "score_seconds": float(tree["score_seconds"]),
+    }
+
+
+# ---------------------------------------------------------------------------
+# the driver
+# ---------------------------------------------------------------------------
+
+
+class BaseIncrementalSearchCV(BaseEstimator, MetaEstimatorMixin):
+    """Shared machinery of the incremental (``partial_fit``) searches;
+    subclasses define the bracket set (:meth:`_brackets`) and their
+    constructor surface. See the module docstring for the architecture.
+    """
+
+    # -- subclass surface -------------------------------------------------
+
+    def _brackets(self) -> list:
+        raise NotImplementedError  # pragma: no cover - abstract
+
+    def _draw_candidates(self, bracket: int, n0: int) -> list:
+        """The bracket's parameter draw: the full grid when
+        ``n_initial_parameters='grid'``, otherwise a seeded
+        ``ParameterSampler`` draw (per-bracket seed, so Hyperband
+        brackets explore different points)."""
+        if getattr(self, "n_initial_parameters", None) == "grid":
+            grid = list(ParameterGrid(self.parameters))
+            return grid
+        return list(ParameterSampler(
+            self.parameters, n0,
+            random_state=int(self.random_state) + 1000 * int(bracket)))
+
+    # -- scoring ----------------------------------------------------------
+
+    def _score_estimator(self, est, X_val, y_val) -> float:
+        if callable(self.scoring):
+            return float(self.scoring(est, X_val, y_val))
+        if self.scoring not in (None, "passthrough"):
+            raise ValueError(
+                "incremental search supports scoring=None (the "
+                "estimator's own score) or a callable(est, X, y); got "
+                f"{self.scoring!r}")
+        if y_val is None:
+            return float(est.score(X_val))
+        return float(est.score(X_val, y_val))
+
+    # -- batched fast path (one program per bracket) ----------------------
+
+    def _plan_batched(self, est, params_list, y_train, classes):
+        """Eligibility + member arrays for the batched rung program.
+        Returns ``None`` (fall back to per-candidate ``partial_fit``)
+        unless every candidate of the bracket is the SAME streaming GLM
+        problem at different (lamduh, eta0, power_t) — the only knobs
+        :func:`~dask_ml_tpu.models.glm.make_batched_sgd_epoch` traces.
+        """
+        if not getattr(self, "batched_rungs", True):
+            return None
+        if self.scoring not in (None, "passthrough"):
+            return None
+        if not hasattr(est, "_sgd_config"):
+            return None
+        if getattr(est, "family", None) not in ("logistic", "normal"):
+            return None
+        if y_train is None:
+            return None
+        cfgs = []
+        for p in params_list:
+            if not set(p) <= {"C", "solver_kwargs"}:
+                return None
+            sk = p.get("solver_kwargs")
+            if sk is not None and not set(sk) <= {"eta0", "power_t"}:
+                return None
+            try:
+                cfgs.append(clone(est).set_params(**p)._sgd_config())
+            except Exception:
+                return None
+        base = [(c["family"], c["regularizer"], c["fit_intercept"],
+                 c.get("n_classes")) for c in cfgs]
+        if len(set(base)) != 1 or base[0][3] is not None:
+            return None
+        # encoding reference: pins the class set (binary only — the
+        # softmax stream state is (width, K), outside the batched
+        # program) and owns _encode_eval_y for validation scoring
+        ref = clone(est)
+        try:
+            y_enc = ref._encode_y_partial(np.asarray(y_train), classes)
+        except Exception:
+            return None
+        if len(getattr(ref, "_pf_classes", [0, 1])) > 2:
+            return None
+        lam = np.asarray([c["lamduh"] for c in cfgs], np.float32)
+        eta0 = np.asarray([c["eta0"] for c in cfgs], np.float32)
+        power_t = np.asarray([c["power_t"] for c in cfgs], np.float32)
+        fam, reg, fi, _ = base[0]
+        return {"ref": ref, "y_enc": y_enc, "lam": lam, "eta0": eta0,
+                "power_t": power_t, "family": fam, "regularizer": reg,
+                "fit_intercept": bool(fi)}
+
+    # -- fit --------------------------------------------------------------
+
+    def fit(self, X, y=None, classes=None, **fit_params):
+        if fit_params:
+            raise ValueError(
+                "incremental search streams raw blocks through "
+                f"partial_fit; fit_params {sorted(fit_params)} are not "
+                "supported")
+        from dask_ml_tpu.parallel.elastic import BlockPlan
+        from dask_ml_tpu.parallel.shapes import track_compiles
+
+        t_fit0 = time.time()
+        est = self.estimator
+        eta = int(self.aggressiveness)
+        if eta < 2:
+            raise ValueError(
+                f"aggressiveness must be >= 2, got {self.aggressiveness}")
+        run = self.elastic
+
+        # -- deterministic holdout split + block partition ----------------
+        n = _n_rows(X)
+        rng = np.random.RandomState(self.random_state)
+        perm = rng.permutation(n)
+        n_test = max(1, int(round(float(self.test_size) * n)))
+        if n_test >= n:
+            raise ValueError(
+                f"test_size={self.test_size} leaves no training rows "
+                f"(n={n})")
+        test_idx = np.sort(perm[:n_test])
+        train_pool = perm[n_test:]
+        n_blocks = max(1, min(int(self.n_blocks), len(train_pool)))
+        n_used = (len(train_pool) // n_blocks) * n_blocks
+        train_idx = train_pool[:n_used]  # tail trim: equal block shapes
+        block_rows = np.split(train_idx, n_blocks)
+        data_plan = BlockPlan(n_blocks, seed=int(self.shuffle_seed),
+                              shuffle=True)
+        Xblocks = [_index(X, bi) for bi in block_rows]
+        yblocks = (None if y is None
+                   else [_index(y, bi) for bi in block_rows])
+        y_train = None if y is None else _index(y, train_idx)
+        X_val = _index(X, test_idx)
+        y_val = None if y is None else _index(y, test_idx)
+
+        # -- brackets + candidates ----------------------------------------
+        brackets = self._brackets()
+        cand_params: list = []      # cid -> params dict
+        cand_bracket: list = []     # cid -> bracket id s
+        cand_model_id: list = []
+        bracket_cids: dict = {}     # s -> [cid]
+        for s, n0, _r0 in brackets:
+            cids = []
+            for i, p in enumerate(self._draw_candidates(s, n0)):
+                cid = len(cand_params)
+                cand_params.append(p)
+                cand_bracket.append(s)
+                cand_model_id.append(f"bracket={s}-{i}")
+                cids.append(cid)
+            bracket_cids[s] = cids
+
+        # -- journal (content-addressed resume) ---------------------------
+        journal = None
+        done: dict = {}
+        est_token = None
+        scoring_id = _scoring_identity(self.scoring)
+        if self.checkpoint:
+            from dask_ml_tpu.checkpoint import CellJournal
+
+            # per-rank journal path under an elastic roster: concurrent
+            # processes must not interleave appends in one file; each
+            # host's journal alone is enough to resume it (and the
+            # namespace's published blocks cover the rest of the fleet)
+            path = (f"{self.checkpoint}.r{run.rank}" if run is not None
+                    else self.checkpoint)
+            journal = CellJournal(path)
+            done = journal.load()
+        est_token = tokenize(
+            type(est), est.get_params(deep=True), _content_array(X),
+            _content_array(y), classes if classes is None
+            else _content_array(classes))
+
+        def rung_key(cid, rung, cum):
+            return tokenize(
+                "rung", est_token, cand_params[cid], cand_bracket[cid],
+                rung, cum, n_blocks, int(self.shuffle_seed), scoring_id,
+                _content_array(test_idx))
+
+        if run is not None:
+            run.bind_problem(
+                "asha", token=est_token,
+                grid=tokenize(cand_params), eta=eta, n_blocks=n_blocks,
+                seed=int(self.shuffle_seed),
+                scoring=scoring_id)
+        elastic_before = (
+            (run.blocks_rebalanced, run.blocks_speculated)
+            if run is not None else (0, 0))
+
+        # -- fit-wide state -----------------------------------------------
+        records: dict = {}      # cid -> latest completed-rung record
+        cand_rung: dict = {}    # cid -> last completed rung index
+        cand_status: dict = {}
+        history: list = []
+        rung_table: list = []
+        self.n_rungs_completed_ = 0
+        self.n_promotions_ = 0
+        self.n_candidates_stopped_ = 0
+        self.n_rung_timeouts_ = 0
+        self.n_rung_retries_ = 0
+        self.n_resumed_rungs_ = 0
+        self.rung_compile_stats_ = []
+        budget_spent = [0]
+
+        cap = getattr(self, "max_epochs", None)
+        cap = None if cap is None else int(cap)
+        deepest = [0]
+
+        # one batched plan per bracket (fixed batch width = the
+        # bracket's n0: a promotion changes the alive-MASK, not a shape)
+        bplans = {}
+        bstage: dict = {}  # lazy device stacks shared by every bracket
+
+        def batched_stage(bplan):
+            if "Xb" in bstage:
+                return bstage
+            import jax.numpy as jnp
+
+            Xb = np.stack([np.asarray(b, np.float32) for b in Xblocks])
+            if bplan["fit_intercept"]:
+                Xb = np.concatenate(
+                    [Xb, np.ones(Xb.shape[:2] + (1,), np.float32)],
+                    axis=2)
+            yb = np.asarray(bplan["y_enc"], np.float32).reshape(
+                n_blocks, -1)
+            wb = np.ones(yb.shape, np.float32)
+            Ev = np.asarray(X_val, np.float32)
+            if bplan["fit_intercept"]:
+                Ev = np.concatenate(
+                    [Ev, np.ones((Ev.shape[0], 1), np.float32)], axis=1)
+            yv = np.asarray(
+                bplan["ref"]._encode_eval_y(np.asarray(y_val)),
+                np.float32)
+            wv = np.ones(yv.shape, np.float32)
+            bstage.update(
+                Xb=jnp.asarray(Xb), yb=jnp.asarray(yb),
+                wb=jnp.asarray(wb), Ev=jnp.asarray(Ev),
+                yv=jnp.asarray(yv), wv=jnp.asarray(wv),
+                width=int(Xb.shape[2]))
+            return bstage
+
+        def train_generic_one(cid, prev_cum, cum):
+            """One candidate's rung: restore (or build) the estimator,
+            stream (cum - prev_cum) seeded epochs of partial_fit blocks,
+            score on the holdout. Pure in (previous record, epoch
+            seeds), which is what makes re-deals and resumes
+            bit-identical."""
+            prev = records.get(cid)
+            t0 = time.time()
+            if prev is None:
+                m = clone(est).set_params(**cand_params[cid])
+            else:
+                m = pickle.loads(prev["blob"])
+            calls = 0
+            for e in range(prev_cum, cum):
+                for b in data_plan.epoch_order(e):
+                    if yblocks is None:
+                        m.partial_fit(Xblocks[b])
+                    elif classes is not None:
+                        m.partial_fit(Xblocks[b], yblocks[b],
+                                      classes=classes)
+                    else:
+                        m.partial_fit(Xblocks[b], yblocks[b])
+                    calls += 1
+            t1 = time.time()
+            score = self._score_estimator(m, X_val, y_val)
+            return {
+                "score": score, "blob": pickle.dumps(m),
+                "n_epochs": cum,
+                "pf_calls": (0 if prev is None else prev["pf_calls"])
+                + calls,
+                "fit_seconds": t1 - t0, "score_seconds": time.time() - t1,
+            }
+
+        def train_batched_all(s, bplan, need, prev_cum, cum):
+            """The whole bracket's rung as ONE program: stacked (M,
+            width) states advance through the seeded epochs with traced
+            per-member hyperparameters and an alive-mask (stopped lanes
+            freeze; their values cannot reach live lanes — vmap member
+            independence, which is also why any elastic host recomputes
+            any member bit-identically). Scores all lanes in one
+            batched pass; materializes per-candidate estimators only
+            for ``need``."""
+            import jax.numpy as jnp
+
+            from dask_ml_tpu.models import glm as glm_core
+
+            stage = batched_stage(bplan)
+            cids = bracket_cids[s]
+            M, width = len(cids), stage["width"]
+            betas = np.zeros((M, width), np.float32)
+            ts = np.zeros((M,), np.float32)
+            live = np.zeros((M,), bool)
+            for j, cid in enumerate(cids):
+                if cid in need:
+                    live[j] = True
+                prev = records.get(cid)
+                if prev is not None:
+                    beta, t = pickle.loads(prev["blob"])._pf_state
+                    betas[j], ts[j] = beta, t
+            t0 = time.time()
+            ep_fn = glm_core.get_batched_sgd_epoch(
+                bplan["family"], bplan["regularizer"],
+                bplan["fit_intercept"])
+            db, dt = jnp.asarray(betas), jnp.asarray(ts)
+            lam, e0, pt = (jnp.asarray(bplan["lam"]),
+                           jnp.asarray(bplan["eta0"]),
+                           jnp.asarray(bplan["power_t"]))
+            lv = jnp.asarray(live)
+            for e in range(prev_cum, cum):
+                order = jnp.asarray(data_plan.epoch_order(e), jnp.int32)
+                db, dt = ep_fn(db, dt, lam, e0, pt, lv,
+                               stage["Xb"], stage["yb"], stage["wb"],
+                               order)
+            t1 = time.time()
+            scores = np.asarray(glm_core.batched_eval_scores(
+                stage["Ev"], stage["yv"], stage["wv"], db,
+                family=bplan["family"]))
+            nb, nt = np.asarray(db), np.asarray(dt)
+            t2 = time.time()
+            n_need = max(len(need), 1)
+            out = {}
+            ref = bplan["ref"]
+            for j, cid in enumerate(cids):
+                if cid not in need:
+                    continue
+                m = clone(est).set_params(**cand_params[cid])
+                pf = getattr(ref, "_pf_classes", None)
+                if pf is not None:
+                    m._pf_classes = np.asarray(pf)
+                    m.classes_ = np.asarray(pf)
+                m._store_pf_state((nb[j], float(nt[j])))
+                prev = records.get(cid)
+                out[cid] = {
+                    "score": float(scores[j]), "blob": pickle.dumps(m),
+                    "n_epochs": cum,
+                    "pf_calls": (0 if prev is None
+                                 else prev["pf_calls"])
+                    + (cum - prev_cum) * n_blocks,
+                    "fit_seconds": (t1 - t0) / n_need,
+                    "score_seconds": (t2 - t1) / n_need,
+                }
+            return out
+
+        def run_rung(s, rung, uid, alive, prev_cum, cum):
+            """Compute/restore every alive candidate's rung record.
+            Returns {cid: record}; a timed-out candidate maps to None.
+            """
+            keys = {cid: rung_key(cid, rung, cum) for cid in alive}
+            restored = {cid: done[k] for cid, k in keys.items()
+                        if k in done}
+            self.n_resumed_rungs_ += len(restored)
+            need = [cid for cid in alive if cid not in restored]
+            bplan = bplans.get(s)
+            bmemo: dict = {}
+
+            def make_record(cid):
+                # the elastic compute_publish unit — also the local path
+                if cid in restored:
+                    return restored[cid]
+                if bplan is not None:
+                    if not bmemo:
+                        bmemo.update(train_batched_all(
+                            s, bplan, set(need), prev_cum, cum))
+                    return bmemo[cid]
+                last_err = None
+                for _attempt in range(int(self.cell_retries) + 1):
+                    try:
+                        value, timed_out = run_with_soft_deadline(
+                            lambda: train_generic_one(
+                                cid, prev_cum, cum),
+                            self.cell_timeout,
+                            name=f"asha-rung-{s}-{rung}-{cid}")
+                        if timed_out:
+                            raise _RungTimeout(cid)
+                        return value
+                    except _RungTimeout:
+                        raise
+                    except Exception as e:
+                        last_err = e
+                        self.n_rung_retries_ += 1
+                        telemetry.counter("search.rung_retries").inc()
+                        logger.warning(
+                            "asha: candidate %d rung %d attempt failed "
+                            "(%s); retrying", cid, rung, e)
+                raise last_err
+
+            results = {}
+            if run is None:
+                for cid in alive:
+                    try:
+                        results[cid] = make_record(cid)
+                    except _RungTimeout:
+                        results[cid] = None
+            else:
+                results = self._rung_elastic(
+                    run, uid, list(alive), make_record)
+            if journal is not None:
+                for cid in alive:
+                    rec = results.get(cid)
+                    k = keys[cid]
+                    # timeouts are never journaled: a resume retries them
+                    if rec is not None and k not in done:
+                        journal.append(k, rec)
+                        done[k] = rec
+            return results
+
+        # -- bracket loop -------------------------------------------------
+        from dask_ml_tpu.parallel.shapes import compile_stats  # noqa: F401
+
+        for s, n0, r0 in brackets:
+            cids0 = bracket_cids[s]
+            bplan = self._plan_batched(
+                est, [cand_params[c] for c in cids0], y_train, classes)
+            if bplan is not None:
+                bplans[s] = bplan
+            alive = list(cids0)
+            for cid in alive:
+                cand_status[cid] = "running"
+            rung, prev_cum = 0, 0
+            cum = r0 if cap is None else min(r0, cap)
+            with telemetry.span("search.bracket", bracket=s,
+                                candidates=n0, r0=r0):
+                while True:
+                    uid = 1000 * (s + 1) + rung
+                    with telemetry.span("search.rung", bracket=s,
+                                        rung=rung,
+                                        candidates=len(alive)), \
+                            track_compiles() as tc:
+                        results = run_rung(s, rung, uid, alive,
+                                           prev_cum, cum)
+                    self.rung_compile_stats_.append({
+                        "bracket": s, "rung": rung,
+                        "candidates": len(alive),
+                        "n_compiles": int(tc["n_compiles"]),
+                    })
+                    self.n_rungs_completed_ += 1
+                    telemetry.counter("search.rungs_completed").inc()
+                    budget_spent[0] += (cum - prev_cum) * len(alive)
+                    deepest[0] = max(deepest[0], cum)
+                    timeouts = [cid for cid in alive
+                                if results.get(cid) is None]
+                    for cid in timeouts:
+                        # the satellite fix: degrade, don't delete — the
+                        # candidate keeps its LAST completed rung score
+                        self.n_rung_timeouts_ += 1
+                        telemetry.counter("search.rung_timeouts").inc()
+                        cand_status[cid] = "stopped (rung timeout)"
+                        logger.warning(
+                            "asha: candidate %d timed out at bracket %d "
+                            "rung %d; keeping its rung-%d score", cid, s,
+                            rung, rung - 1)
+                    survivors = [cid for cid in alive
+                                 if results.get(cid) is not None]
+                    for cid in survivors:
+                        records[cid] = results[cid]
+                        cand_rung[cid] = rung
+                        history.append({
+                            "model_id": cand_model_id[cid],
+                            "bracket": s, "rung": rung,
+                            "n_epochs": cum,
+                            "score": results[cid]["score"],
+                            "partial_fit_calls":
+                                results[cid]["pf_calls"],
+                            "elapsed_wall_time": time.time() - t_fit0,
+                        })
+                    survivors.sort(
+                        key=lambda cid: (-records[cid]["score"], cid))
+                    final = (len(survivors) <= 1
+                             and (cap is None or cum >= cap)) or (
+                                 cap is not None and cum >= cap)
+                    if final:
+                        n_next = len(survivors)
+                        promoted, stopped = survivors, []
+                    else:
+                        n_next = max(1, len(survivors) // eta)
+                        promoted = survivors[:n_next]
+                        stopped = survivors[n_next:]
+                    for cid in stopped:
+                        cand_status[cid] = "stopped"
+                    self.n_promotions_ += 0 if final else len(promoted)
+                    if not final and promoted:
+                        telemetry.counter("search.promotions").inc(
+                            len(promoted))
+                    if stopped or timeouts:
+                        self.n_candidates_stopped_ += (len(stopped)
+                                                       + len(timeouts))
+                        telemetry.counter(
+                            "search.candidates_stopped").inc(
+                            len(stopped) + len(timeouts))
+                    rung_table.append({
+                        "bracket": s, "rung": rung, "n_epochs": cum,
+                        "alive": len(alive), "scored": len(survivors),
+                        "promoted": 0 if final else len(promoted),
+                        "stopped": len(stopped), "timeouts":
+                            len(timeouts), "final": bool(final),
+                    })
+                    if final:
+                        for cid in promoted:
+                            cand_status[cid] = "stopped"
+                        if promoted:
+                            cand_status[promoted[0]] = "best in bracket"
+                        break
+                    if not promoted:
+                        break  # every candidate timed out
+                    alive = promoted
+                    rung += 1
+                    prev_cum = cum
+                    cum = cum * eta if cap is None else min(cum * eta,
+                                                            cap)
+
+        if not records:
+            raise RuntimeError(
+                "incremental search finished with no scored candidate "
+                "(every rung-0 candidate timed out)")
+
+        # -- results ------------------------------------------------------
+        self._build_results(
+            cand_params, cand_bracket, cand_model_id, cand_rung,
+            cand_status, records, history, rung_table, brackets,
+            budget_spent[0], deepest[0], n_blocks)
+        if run is not None:
+            self.n_blocks_rebalanced_ = (run.blocks_rebalanced
+                                         - elastic_before[0])
+            self.n_blocks_speculated_ = (run.blocks_speculated
+                                         - elastic_before[1])
+        else:
+            self.n_blocks_rebalanced_ = 0
+            self.n_blocks_speculated_ = 0
+        return self
+
+    # -- elastic rung -----------------------------------------------------
+
+    def _rung_elastic(self, run, uid, cids, make_record) -> dict:
+        """One rung over the elastic plane: the rung's candidates are
+        the epoch's BLOCKS (identity order — candidate shards need no
+        shuffling; the DATA epochs inside each candidate are the seeded
+        permutations), dealt contiguously over the live roster. Each
+        host computes its share, publishes atomically, and
+        ``collect_epoch`` re-deals the blocks of dead hosts (and — with
+        ``speculate_after`` — of merely slow ones) to survivors. A
+        candidate's rung is a pure function of its journaled state and
+        the seeds, so whichever host computes it publishes identical
+        bytes: first publication wins."""
+        from dask_ml_tpu.parallel.elastic import (BlockPlan,
+                                                  _epoch_assignment)
+
+        order = list(range(len(cids)))
+        plan = BlockPlan(len(order), seed=0, shuffle=False)
+        owner = _epoch_assignment(run, order)
+
+        def compute_publish(grab):
+            for b in grab:
+                try:
+                    rec = make_record(cids[b])
+                except _RungTimeout:
+                    rec = None
+                run.publish(uid, b, _record_to_tree(rec))
+                run.beat()
+                run.maybe_die(b, uid)
+
+        have = run.published(uid)
+        mine = [b for b in order
+                if owner.get(b) == run.rank and b not in have]
+        compute_publish(mine)
+        out = run.collect_epoch(plan, uid, order, owner, compute_publish)
+        return {cids[b]: _tree_to_record(out[b]) for b in order}
+
+    # -- cv_results_ ------------------------------------------------------
+
+    def _build_results(self, cand_params, cand_bracket, cand_model_id,
+                       cand_rung, cand_status, records, history,
+                       rung_table, brackets, budget_spent, deepest,
+                       n_blocks):
+        n_models = len(cand_params)
+        scores = np.full(n_models, np.nan)
+        n_epochs = np.zeros(n_models, np.int64)
+        pf_calls = np.zeros(n_models, np.int64)
+        rung_arr = np.full(n_models, -1, np.int64)
+        fit_t = np.zeros(n_models)
+        score_t = np.zeros(n_models)
+        for cid, rec in records.items():
+            scores[cid] = rec["score"]
+            n_epochs[cid] = rec["n_epochs"]
+            pf_calls[cid] = rec["pf_calls"]
+            rung_arr[cid] = cand_rung[cid]
+            fit_t[cid] = rec["fit_seconds"] / max(rec["n_epochs"], 1)
+            score_t[cid] = rec["score_seconds"]
+        order = sorted(
+            range(n_models),
+            key=lambda c: (-(scores[c] if np.isfinite(scores[c])
+                             else -np.inf), c))
+        rank = np.zeros(n_models, np.int32)
+        for pos, cid in enumerate(order):
+            if pos > 0 and scores[cid] == scores[order[pos - 1]]:
+                rank[cid] = rank[order[pos - 1]]
+            else:
+                rank[cid] = pos + 1
+        keys = sorted({k for p in cand_params for k in p})
+        results = {
+            "params": np.asarray(cand_params, dtype=object),
+            "model_id": np.asarray(cand_model_id, dtype=object),
+            "bracket_": np.asarray(cand_bracket, np.int64),
+            "rung_": rung_arr,
+            "n_epochs_": n_epochs,
+            "partial_fit_calls": pf_calls,
+            "test_score": scores,
+            "rank_test_score": rank,
+            "mean_partial_fit_time": fit_t,
+            "mean_score_time": score_t,
+            "status": np.asarray(
+                [cand_status.get(c, "running") for c in range(n_models)],
+                dtype=object),
+        }
+        for k in keys:
+            results[f"param_{k}"] = np.asarray(
+                [p.get(k, np.nan) for p in cand_params], dtype=object)
+        self.cv_results_ = results
+        self.history_ = history
+        self.rung_table_ = rung_table
+        best = order[0]
+        self.best_index_ = int(best)
+        self.best_score_ = float(scores[best])
+        self.best_params_ = cand_params[best]
+        self.best_estimator_ = pickle.loads(records[best]["blob"])
+        self.multimetric_ = False
+        self.scorer_ = self.scoring
+        self.n_splits_ = 1
+        sync = n_models * deepest
+        self.budget_spent_ = int(budget_spent)
+        self.budget_synchronous_ = int(sync)
+        self.metadata_ = {
+            "n_models": n_models,
+            "partial_fit_calls": int(pf_calls.sum()),
+            "fit_epochs": int(budget_spent),
+            "fit_epochs_synchronous": int(sync),
+            "brackets": [
+                {"bracket": s, "n_models": n0, "r0": r0,
+                 "rungs": bracket_rungs(
+                     n0, r0, int(self.aggressiveness),
+                     getattr(self, "max_epochs", None))}
+                for s, n0, r0 in brackets
+            ],
+        }
+
+    # -- introspection ----------------------------------------------------
+
+    def shared_fit_report(self) -> str:
+        """The incremental analogue of the synchronous driver's
+        work-sharing report: the rung table (candidates alive /
+        promoted / stopped per rung), straggler re-deals, and the
+        fit-epoch budget against the synchronous grid equivalent —
+        the evidence that budget concentrated on survivors."""
+        if not hasattr(self, "rung_table_"):
+            raise AttributeError("Not fitted; call fit first")
+        md = self.metadata_
+        pct = 100.0 * md["fit_epochs"] / max(
+            md["fit_epochs_synchronous"], 1)
+        lines = [
+            (f"{md['n_models']} candidates over "
+             f"{self.n_rungs_completed_} rungs: "
+             f"{md['fit_epochs']} fit-epochs spent vs "
+             f"{md['fit_epochs_synchronous']} synchronous-equivalent "
+             f"({pct:.0f}%)"),
+            "",
+            (f"{'bracket':>7} {'rung':>4} {'epochs':>6} {'alive':>5} "
+             f"{'promoted':>8} {'stopped':>7} {'timeouts':>8}"),
+        ]
+        for row in self.rung_table_:
+            lines.append(
+                f"{row['bracket']:>7} {row['rung']:>4} "
+                f"{row['n_epochs']:>6} {row['alive']:>5} "
+                f"{row['promoted']:>8} {row['stopped']:>7} "
+                f"{row['timeouts']:>8}")
+        extras = []
+        if self.n_blocks_rebalanced_ or self.n_blocks_speculated_:
+            extras.append(
+                f"{self.n_blocks_rebalanced_} candidate-rung(s) "
+                f"re-dealt from lost hosts, "
+                f"{self.n_blocks_speculated_} speculatively re-dealt "
+                f"from stragglers")
+        if self.n_resumed_rungs_:
+            extras.append(
+                f"{self.n_resumed_rungs_} candidate-rung(s) restored "
+                "from the journal")
+        if self.n_rung_retries_ or self.n_rung_timeouts_:
+            extras.append(
+                f"{self.n_rung_retries_} rung retr"
+                f"{'y' if self.n_rung_retries_ == 1 else 'ies'}, "
+                f"{self.n_rung_timeouts_} rung timeout"
+                f"{'' if self.n_rung_timeouts_ == 1 else 's'} "
+                "(degraded to last completed rung)")
+        if extras:
+            lines += [""] + extras
+        if telemetry.enabled() or telemetry.spans():
+            lines += ["", telemetry.render_report()]
+        return "\n".join(lines)
+
+    # -- post-fit delegation ----------------------------------------------
+
+    def _check_is_fitted(self, method_name):
+        if not hasattr(self, "best_estimator_"):
+            raise AttributeError("Not fitted; call fit first")
+
+    @property
+    def classes_(self):
+        self._check_is_fitted("classes_")
+        return self.best_estimator_.classes_
+
+    def predict(self, X):
+        self._check_is_fitted("predict")
+        return self.best_estimator_.predict(X)
+
+    def predict_proba(self, X):
+        self._check_is_fitted("predict_proba")
+        return self.best_estimator_.predict_proba(X)
+
+    def decision_function(self, X):
+        self._check_is_fitted("decision_function")
+        return self.best_estimator_.decision_function(X)
+
+    def transform(self, X):
+        self._check_is_fitted("transform")
+        return self.best_estimator_.transform(X)
+
+    def score(self, X, y=None):
+        self._check_is_fitted("score")
+        return self._score_estimator(self.best_estimator_, X, y)
+
+
+class SuccessiveHalvingSearchCV(BaseIncrementalSearchCV):
+    """Asynchronous successive halving (ASHA) over ``partial_fit``
+    estimators — ONE bracket of :func:`bracket_rungs`.
+
+    ``n_initial_parameters`` is the rung-0 candidate count drawn from
+    ``parameters`` with a seeded ``ParameterSampler``, or the string
+    ``'grid'`` for the full ``ParameterGrid`` (the bench's
+    finds-the-grid-optimum configuration). ``n_initial_epochs`` is the
+    rung-0 budget; each promotion keeps the top ``1/aggressiveness`` of
+    the scored candidates and multiplies the cumulative epoch budget by
+    ``aggressiveness``, up to ``max_epochs``. See the module docstring
+    for rung/epoch semantics, journaling, batching, and the elastic
+    plane; see :class:`HyperbandSearchCV` for the multi-bracket sweep.
+    """
+
+    def __init__(self, estimator, parameters, *,
+                 n_initial_parameters=10, n_initial_epochs=1,
+                 aggressiveness=3, max_epochs=None, test_size=0.2,
+                 n_blocks=4, shuffle_seed=0, random_state=0,
+                 scoring=None, checkpoint=None, cell_timeout=None,
+                 cell_retries=0, elastic=None, batched_rungs=True):
+        self.estimator = estimator
+        self.parameters = parameters
+        self.n_initial_parameters = n_initial_parameters
+        self.n_initial_epochs = n_initial_epochs
+        self.aggressiveness = aggressiveness
+        self.max_epochs = max_epochs
+        self.test_size = test_size
+        self.n_blocks = n_blocks
+        self.shuffle_seed = shuffle_seed
+        self.random_state = random_state
+        self.scoring = scoring
+        self.checkpoint = checkpoint
+        self.cell_timeout = cell_timeout
+        self.cell_retries = cell_retries
+        self.elastic = elastic
+        self.batched_rungs = batched_rungs
+
+    def _brackets(self) -> list:
+        if self.n_initial_parameters == "grid":
+            n0 = len(list(ParameterGrid(self.parameters)))
+        else:
+            n0 = int(self.n_initial_parameters)
+        return [(0, n0, int(self.n_initial_epochs))]
+
+
+class HyperbandSearchCV(BaseIncrementalSearchCV):
+    """Hyperband: every :func:`hyperband_brackets` bracket of
+    :class:`SuccessiveHalvingSearchCV`, from most exploratory (many
+    candidates, one epoch) to least (few candidates, ``max_epochs``
+    each), sharing the data plane, the journal, and — per bracket —
+    one batched program. ``cv_results_`` spans all brackets
+    (``bracket_`` column); ``best_*`` is the argmax over every
+    candidate's final score, mirroring dask-ml's
+    ``HyperbandSearchCV`` metadata shape."""
+
+    def __init__(self, estimator, parameters, *, max_epochs=27,
+                 aggressiveness=3, test_size=0.2, n_blocks=4,
+                 shuffle_seed=0, random_state=0, scoring=None,
+                 checkpoint=None, cell_timeout=None, cell_retries=0,
+                 elastic=None, batched_rungs=True):
+        self.estimator = estimator
+        self.parameters = parameters
+        self.max_epochs = max_epochs
+        self.aggressiveness = aggressiveness
+        self.test_size = test_size
+        self.n_blocks = n_blocks
+        self.shuffle_seed = shuffle_seed
+        self.random_state = random_state
+        self.scoring = scoring
+        self.checkpoint = checkpoint
+        self.cell_timeout = cell_timeout
+        self.cell_retries = cell_retries
+        self.elastic = elastic
+        self.batched_rungs = batched_rungs
+
+    def _brackets(self) -> list:
+        return hyperband_brackets(int(self.max_epochs),
+                                  int(self.aggressiveness))

@@ -52,6 +52,51 @@ __all__ = ["GridSearchCV", "RandomizedSearchCV", "TPUBaseSearchCV"]
 # ---------------------------------------------------------------------------
 
 
+def run_with_soft_deadline(fn, timeout, *, caller_cfg=None,
+                           name="search-cell"):
+    """Run ``fn()`` under a soft daemon-thread deadline: the caller waits
+    at most ``timeout`` seconds, then abandons the thread (threads cannot
+    be killed — the stray computation finishes in the background but no
+    longer blocks the run). Returns ``(value, timed_out)``; exceptions
+    from ``fn`` re-raise on the caller. A falsy ``timeout`` runs inline.
+
+    ``caller_cfg`` (a :func:`dask_ml_tpu.config.get_config` subset) is
+    re-entered on the deadline thread — config is thread-local, so the
+    caller's dtype/staging knobs must travel with the work.
+
+    One timeout discipline, two consumers: the grid/random driver's
+    per-CELL deadline (below) and the incremental ASHA driver's per-RUNG
+    deadline (``_incremental.py``), whose contract differs only in what a
+    timeout means — error_score for a cell, *degrade to the last
+    completed rung score* for a streaming candidate.
+    """
+    if not timeout:
+        return fn(), False
+    from dask_ml_tpu import config as config_lib
+
+    box: dict = {}
+
+    def target():
+        # config is thread-local: the deadline thread re-enters it
+        try:
+            if caller_cfg is None:
+                box["result"] = fn()
+            else:
+                with config_lib.config_context(**caller_cfg):
+                    box["result"] = fn()
+        except BaseException as e:  # re-raised on the caller
+            box["error"] = e
+
+    t = threading.Thread(target=target, daemon=True, name=name)
+    t.start()
+    t.join(float(timeout))
+    if t.is_alive():
+        return None, True
+    if "error" in box:
+        raise box["error"]
+    return box["result"], False
+
+
 def _is_pairwise(est) -> bool:
     try:
         return bool(est.__sklearn_tags__().input_tags.pairwise)
@@ -1336,32 +1381,17 @@ class TPUBaseSearchCV(BaseEstimator, MetaEstimatorMixin):
                 return _compute_cell_deadline_inner(ci, si)
 
         def _compute_cell_deadline_inner(ci, si):
-            if not self.cell_timeout:
-                return _compute_cell(ci, si)
-            box: dict = {}
-
-            def target():
-                # config is thread-local: the cell thread re-enters it
-                try:
-                    with config_lib.config_context(**caller_cfg):
-                        box["result"] = _compute_cell(ci, si)
-                except BaseException as e:  # re-raised on the worker
-                    box["error"] = e
-
-            t = threading.Thread(target=target, daemon=True,
-                                 name=f"search-cell-{ci}-{si}")
-            t.start()
-            t.join(float(self.cell_timeout))
-            if t.is_alive():
+            value, timed_out = run_with_soft_deadline(
+                lambda: _compute_cell(ci, si), self.cell_timeout,
+                caller_cfg=caller_cfg, name=f"search-cell-{ci}-{si}")
+            if timed_out:
                 with timeout_lock:
                     timeout_counts[0] += 1
                 # registry mirror of the timeout count surfaced as
                 # n_cell_timeouts_ (same increment site)
                 telemetry.counter("search.cell_timeouts").inc()
                 return _timed_out_result(ci, si)
-            if "error" in box:
-                raise box["error"]
-            return box["result"]
+            return value
 
         def run_cell(ci, si):
             with config_lib.config_context(**caller_cfg):

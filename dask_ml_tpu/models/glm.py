@@ -1458,6 +1458,86 @@ def get_stream_step(family="logistic", regularizer="l2", lamduh=0.0,
     return _STREAM_CACHE[key]
 
 
+def make_batched_sgd_epoch(family="logistic", regularizer="l2",
+                           fit_intercept=True):
+    """Build the batched-candidate streaming epoch for asynchronous
+    search rungs (model_selection/_incremental.py): M hyperparameter
+    members advance through ONE data epoch as ONE jitted program.
+
+    :func:`make_sgd_step` bakes ``lamduh``/``eta0``/``power_t`` into the
+    step as Python closure constants — one compiled program PER
+    hyperparameter point, which is exactly the compile storm an
+    asynchronous search must not pay as rungs shrink. Here they are
+    TRACED (M,) vectors and the per-member update is a vmap of the same
+    proximal-SGD math, so every candidate of a bracket shares one
+    executable for the whole search:
+
+    ``epoch(betas, ts, lam, eta0, power_t, live, Xb, yb, wb, order)``
+    scans the (B, bs, width) block stack in the traced ``order``
+    permutation (a different seeded epoch order never recompiles) and
+    returns updated ``(betas, ts)``. ``Xb`` arrives WITH the intercept
+    ones-column already appended (the stack is built once per fit, so
+    the per-step append of :func:`make_sgd_step` would be waste);
+    ``live`` freezes stopped candidates — a promotion that shrinks the
+    rung changes the mask, never a shape, which is what keeps later
+    rungs at zero fresh compiles. Member outputs depend only on that
+    member's (state, hyperparameters) and the shared blocks, so any
+    host of an elastic roster recomputing a member reproduces its bytes
+    exactly (the purity the re-deal protocol rides on).
+    """
+    loss_fn, _ = FAMILIES[family]
+    _, pen_prox = _penalty(regularizer)
+
+    def member_step(beta, t, lam, eta0, power_t, x, y, w):
+        wsum = jnp.maximum(jnp.sum(w), 1e-12)
+
+        def block_loss(b):
+            return jnp.sum(w * loss_fn(x @ b, y)) / wsum
+
+        g = jax.grad(block_loss)(beta)
+        lr = eta0 / (1.0 + t) ** power_t
+        cand = beta - lr * g
+        prox = pen_prox(cand, lr * lam)
+        if fit_intercept:
+            cand = cand.at[:-1].set(prox[:-1])
+        else:
+            cand = prox
+        return cand, t + 1.0
+
+    vstep = jax.vmap(member_step,
+                     in_axes=(0, 0, 0, 0, 0, None, None, None))
+
+    def epoch(betas, ts, lam, eta0, power_t, live, Xb, yb, wb, order):
+        def body(carry, b):
+            bs, ts_ = carry
+            nb, nt = vstep(bs, ts_, lam, eta0, power_t,
+                           Xb[b], yb[b], wb[b])
+            bs = jnp.where(live[:, None], nb, bs)
+            ts_ = jnp.where(live, nt, ts_)
+            return (bs, ts_), None
+
+        (betas, ts), _ = jax.lax.scan(body, (betas, ts), order)
+        return betas, ts
+
+    return jax.jit(epoch)
+
+
+# One compiled batched epoch per (family, regularizer, fit_intercept):
+# stable identity keeps the jit cache warm across searches and resumes.
+_BATCHED_STREAM_CACHE: dict = {}
+
+
+def get_batched_sgd_epoch(family="logistic", regularizer="l2",
+                          fit_intercept=True):
+    """Cached :func:`make_batched_sgd_epoch`."""
+    key = (family, regularizer, bool(fit_intercept))
+    if key not in _BATCHED_STREAM_CACHE:
+        _BATCHED_STREAM_CACHE[key] = make_batched_sgd_epoch(
+            family=family, regularizer=regularizer,
+            fit_intercept=fit_intercept)
+    return _BATCHED_STREAM_CACHE[key]
+
+
 SOLVERS = ("admm", "gradient_descent", "newton", "lbfgs", "proximal_grad")
 
 

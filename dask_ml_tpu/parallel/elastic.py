@@ -237,9 +237,10 @@ class ElasticRun:
     (so survivors skip the timeout) and raises
     :class:`~dask_ml_tpu.parallel.faults.Preempted`.
 
-    Counters ``hosts_lost`` / ``blocks_rebalanced`` mirror into the
-    telemetry registry (``elastic.host_lost`` /
-    ``elastic.blocks_rebalanced``) at their increment sites —
+    Counters ``hosts_lost`` / ``blocks_rebalanced`` /
+    ``blocks_speculated`` mirror into the telemetry registry
+    (``elastic.host_lost`` / ``elastic.blocks_rebalanced`` /
+    ``elastic.blocks_speculated``) at their increment sites —
     docs/observability.md discipline, pinned in
     ``tests/test_telemetry.py``.
     """
@@ -248,7 +249,7 @@ class ElasticRun:
                  world: Optional[int] = None, shuffle_seed: int = 0,
                  shuffle: bool = True, heartbeat_timeout: float = 10.0,
                  poll_interval: float = 0.05, fault_injector=None,
-                 drain=None):
+                 drain=None, speculate_after: Optional[float] = None):
         from dask_ml_tpu.parallel import runtime
 
         self.rank = runtime.process_rank() if rank is None else int(rank)
@@ -263,8 +264,21 @@ class ElasticRun:
         self.poll_interval = float(poll_interval)
         self.fault_injector = fault_injector
         self.drain = drain
+        #: straggler (not death) mitigation: with ``speculate_after`` set
+        #: (seconds, sensibly < ``heartbeat_timeout``), an IDLE host —
+        #: done with its own share, seeing every owner alive but no new
+        #: publication for that long — speculatively computes a share of
+        #: the missing blocks without declaring anyone dead. First
+        #: publication wins by the existing idempotence (block results
+        #: are pure functions of epoch-start state + block data, so the
+        #: duplicate bytes are identical). ``None`` (default) disables
+        #: speculation; the heartbeat-timeout re-deal remains the
+        #: correctness backstop either way.
+        self.speculate_after = (None if speculate_after is None
+                                else float(speculate_after))
         self.hosts_lost = 0
         self.blocks_rebalanced = 0
+        self.blocks_speculated = 0
         self._known_dead: set = set()
         #: ranks ever COUNTED as lost by this handle: `_known_dead` resets
         #: per problem namespace (a restarted peer may rejoin the next
@@ -517,6 +531,7 @@ class ElasticRun:
         duplicate compute."""
         last_progress = time.time()
         n_have = -1
+        speculated: set = set()
         while True:
             have = self.published(epoch)
             if len(have) != n_have:
@@ -545,6 +560,44 @@ class ElasticRun:
             lost = self.lost_hosts()
             orphans = [b for b in missing
                        if owner.get(b) in lost or owner.get(b) is None]
+            if (not orphans and self.speculate_after is not None
+                    and time.time() - last_progress
+                    > self.speculate_after):
+                # speculative re-deal (straggler mitigation): every owner
+                # is alive yet nothing has landed for speculate_after
+                # seconds — someone is merely SLOW. The idle hosts (those
+                # not owning any missing block: stalled owners are busy
+                # computing, not polling here) deal the stragglers' blocks
+                # among themselves and duplicate the work WITHOUT marking
+                # anyone dead; the owner's own publication may still land
+                # first, and either way the bytes are identical (per-block
+                # purity), so first-publication-wins costs duplicate
+                # compute, never correctness. Each idle host speculates a
+                # given block at most once per epoch — the heartbeat
+                # fallback below stays the backstop if speculation itself
+                # stalls.
+                stalled = {owner.get(b) for b in missing}
+                idle = [r for r in range(self.world)
+                        if r not in lost and r not in stalled]
+                if self.rank in idle:
+                    deal = BlockPlan.redeal(
+                        [b for b in missing if b not in speculated], idle)
+                    grab = [b for b, r in deal.items() if r == self.rank]
+                    if grab:
+                        logger.warning(
+                            "elastic: rank %d speculatively computing %d "
+                            "straggler block(s) of epoch %d: %s",
+                            self.rank, len(grab), epoch, grab)
+                        speculated.update(grab)
+                        with telemetry.span("elastic.speculate",
+                                            epoch=epoch,
+                                            blocks=len(grab)):
+                            compute_publish(grab)
+                        self.blocks_speculated += len(grab)
+                        if telemetry.enabled():
+                            telemetry.metrics().counter(
+                                "elastic.blocks_speculated").inc(len(grab))
+                        continue
             if not orphans and (time.time() - last_progress
                                 > self.heartbeat_timeout):
                 # crossed-views liveness fallback (see docstring): every

@@ -23,7 +23,18 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 SECTIONS = [
     ("dask_ml_tpu.model_selection", "Model Selection",
      "Drop-in grid/randomized search with pipeline-prefix work-sharing, "
-     "plus blockwise CV splitters."),
+     "blockwise CV splitters, and the incremental (partial_fit) "
+     "successive-halving/Hyperband searches."),
+    ("dask_ml_tpu.model_selection._incremental",
+     "Incremental search (ASHA / Hyperband)",
+     "Asynchronous successive halving on the elastic data plane "
+     "(docs/search.md): rungs are seeded-permutation epochs of "
+     "partial_fit blocks, promotion is host-side arithmetic over "
+     "journaled scores (bit-identical mid-bracket resume), candidates "
+     "of a bracket advance through one batched program (zero heavy "
+     "compiles after each bracket's first rung), and multi-host rungs "
+     "ride the elastic re-deal — a kill-one-host drill drops zero "
+     "candidates."),
     ("dask_ml_tpu.linear_model", "Generalized Linear Models",
      "GLM estimators over the native on-device solver suite "
      "(L-BFGS, Newton, ADMM, proximal gradient, gradient descent)."),

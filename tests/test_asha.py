@@ -1,0 +1,535 @@
+"""Asynchronous successive-halving / Hyperband on the elastic data plane
+(:mod:`dask_ml_tpu.model_selection._incremental`).
+
+What is pinned, and why it is the contract:
+
+- **promotion arithmetic** against hand-computed brackets — the schedule
+  IS the search; a one-off in a rung budget silently changes which
+  candidate wins.
+- **batched rung == per-candidate rung, bit-exact** — the batched
+  program is a pure optimisation; any drift means the alive-mask or the
+  traced hyperparameters leak between lanes.
+- **zero heavy compiles after rung 0 of each bracket** — the tentpole's
+  perf claim: asynchronous promotion must not become a compile storm.
+- **journal resume mid-bracket is bit-identical** — a rung record is a
+  pure function of (journaled rung-start state, seeded epoch orders).
+- **kill-one-host drops zero candidates and changes zero bits** — the
+  candidate-plane re-deal (PR-8 drill style, in-process threads).
+- **a rung timeout degrades, never deletes** — the candidate keeps its
+  last COMPLETED rung's score (the satellite fix; the synchronous
+  driver's error_score semantics would erase its history).
+- **the sketched KMeans facade rides the bounded loop** — block-skip
+  ``row_need`` through the sketched epilogue, bit-identical to the
+  fused reference (BOUNDS theorem on the sketch columns).
+"""
+
+import importlib
+import os
+import pickle
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu.checkpoint import CellJournal
+from dask_ml_tpu.cluster.minibatch import MiniBatchKMeans
+from dask_ml_tpu.linear_model import LogisticRegression
+from dask_ml_tpu.model_selection import (
+    HyperbandSearchCV,
+    SuccessiveHalvingSearchCV,
+)
+from dask_ml_tpu.model_selection._incremental import (
+    bracket_rungs,
+    hyperband_brackets,
+)
+from dask_ml_tpu.parallel.elastic import (
+    BlockPlan,
+    ElasticRun,
+    SimulatedHostDeath,
+)
+from dask_ml_tpu.parallel.faults import FaultInjector
+
+SEED = 0
+
+
+def _problem(n=600, d=5, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    w = np.arange(1, d + 1, dtype=np.float64) * (-1.0) ** np.arange(d)
+    y = (X @ w + 0.3 * rng.randn(n) > 0).astype(np.int64)
+    return X, y
+
+
+GRID = {"C": [0.01, 0.1, 1.0, 10.0],
+        "solver_kwargs": [{"eta0": 0.5}, {"eta0": 1.0}]}
+KW = dict(n_initial_parameters="grid", n_initial_epochs=1,
+          aggressiveness=2, max_epochs=8, n_blocks=4,
+          random_state=SEED)
+
+
+def _est():
+    return LogisticRegression(solver="gradient_descent")
+
+
+# ---------------------------------------------------------------------------
+# bracket arithmetic
+# ---------------------------------------------------------------------------
+
+
+def test_bracket_rungs_hand_computed():
+    # n0=16, r0=1, eta=4, R=16: 16@1 -> 4@4 -> 1@16 (classic SHA)
+    assert bracket_rungs(16, 1, 4, 16) == [(0, 16, 1), (1, 4, 4),
+                                           (2, 1, 16)]
+    # promotion floor: 9 -> 3 -> 1, budgets 1, 3, 9; no cap stops at n=1
+    assert bracket_rungs(9, 1, 3, None) == [(0, 9, 1), (1, 3, 3),
+                                            (2, 1, 9)]
+    # a lone survivor trains on to the cap only when a cap exists
+    assert bracket_rungs(2, 1, 3, 27) == [(0, 2, 1), (1, 1, 3),
+                                          (2, 1, 9), (3, 1, 27)]
+    assert bracket_rungs(2, 1, 3, None) == [(0, 2, 1), (1, 1, 3)]
+    with pytest.raises(ValueError):
+        bracket_rungs(4, 1, 1, None)
+
+
+def test_hyperband_brackets_hand_computed():
+    # R=27, eta=3: s_max=3; the Li et al. table
+    assert hyperband_brackets(27, 3) == [(3, 27, 1), (2, 12, 3),
+                                         (1, 6, 9), (0, 4, 27)]
+    assert hyperband_brackets(9, 3) == [(2, 9, 1), (1, 5, 3), (0, 3, 9)]
+    assert hyperband_brackets(1, 3) == [(0, 1, 1)]
+
+
+def test_driver_follows_hand_computed_schedule():
+    X, y = _problem()
+    sh = SuccessiveHalvingSearchCV(_est(), GRID, **KW).fit(X, y)
+    # 8 candidates, eta=2, r0=1, R=8: 8@1 -> 4@2 -> 2@4 -> 1@8
+    got = [(r["rung"], r["alive"], r["n_epochs"]) for r in sh.rung_table_]
+    assert got == [(0, 8, 1), (1, 4, 2), (2, 2, 4), (3, 1, 8)]
+    assert [r["promoted"] for r in sh.rung_table_] == [4, 2, 1, 0]
+    assert [r["stopped"] for r in sh.rung_table_] == [4, 2, 1, 0]
+    # budget: 8*1 + 4*1 + 2*2 + 1*4 = 20 logical fit-epochs vs 8*8 sync
+    assert sh.budget_spent_ == 20
+    assert sh.budget_synchronous_ == 64
+    assert sh.metadata_["n_models"] == 8
+    assert sh.metadata_["brackets"][0]["rungs"] == bracket_rungs(8, 1, 2, 8)
+
+
+def test_promotion_picks_top_scores_with_id_tiebreak():
+    X, y = _problem()
+    sh = SuccessiveHalvingSearchCV(_est(), GRID, **KW).fit(X, y)
+    # replay rung 0 from history_: the promoted set must be the top-4
+    # scores (candidate id breaking ties)
+    r0 = [h for h in sh.history_ if h["rung"] == 0]
+    r1_ids = {h["model_id"] for h in sh.history_ if h["rung"] == 1}
+    order = sorted(r0, key=lambda h: (-h["score"],
+                                      int(h["model_id"].split("-")[-1])))
+    assert {h["model_id"] for h in order[:4]} == r1_ids
+
+
+# ---------------------------------------------------------------------------
+# batched rung program vs per-candidate partial_fit
+# ---------------------------------------------------------------------------
+
+
+def test_batched_rungs_equal_generic_path():
+    """The batched program computes the SAME math as per-candidate
+    partial_fit (ULP-level float32 drift from XLA program fusion aside):
+    same promotion decisions at every rung, same winner, same budgets.
+    Bit-exactness is pinned where the same program re-runs — journal
+    resume, elastic re-deals, roster changes — not across the two
+    different programs."""
+    X, y = _problem()
+    a = SuccessiveHalvingSearchCV(_est(), GRID, **KW).fit(X, y)
+    b = SuccessiveHalvingSearchCV(_est(), GRID, batched_rungs=False,
+                                  **KW).fit(X, y)
+    assert len(a.rung_compile_stats_) == len(b.rung_compile_stats_)
+    np.testing.assert_allclose(a.cv_results_["test_score"],
+                               b.cv_results_["test_score"],
+                               rtol=0, atol=1e-6)
+    np.testing.assert_array_equal(a.cv_results_["rung_"],
+                                  b.cv_results_["rung_"])
+    np.testing.assert_array_equal(a.cv_results_["n_epochs_"],
+                                  b.cv_results_["n_epochs_"])
+    assert a.best_params_ == b.best_params_
+    np.testing.assert_allclose(a.best_estimator_.coef_,
+                               b.best_estimator_.coef_,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_compile_gate_zero_compiles_after_rung0_per_bracket():
+    X, y = _problem()
+    hb = HyperbandSearchCV(_est(), GRID, max_epochs=9, aggressiveness=3,
+                           n_blocks=4, random_state=SEED).fit(X, y)
+    per_bracket = {}
+    for row in hb.rung_compile_stats_:
+        per_bracket.setdefault(row["bracket"], []).append(
+            (row["rung"], row["n_compiles"]))
+    assert set(per_bracket) == {0, 1, 2}
+    multi = 0
+    for s, rows in per_bracket.items():
+        later = [n for r, n in rows if r > 0]
+        multi += bool(later)
+        assert all(n == 0 for n in later), (
+            f"bracket {s} recompiled after rung 0: {rows}")
+    assert multi >= 2  # the gate actually saw post-rung-0 rungs
+
+
+def test_mini_batch_kmeans_rides_generic_path():
+    rng = np.random.RandomState(1)
+    X = np.concatenate(
+        [rng.randn(150, 4) + c for c in (0.0, 6.0, 12.0)]
+    ).astype(np.float32)
+    sh = SuccessiveHalvingSearchCV(
+        MiniBatchKMeans(n_clusters=3, random_state=0),
+        {"batch_size": [64, 128], "oversampling_factor": [2, 8]},
+        n_initial_parameters="grid", n_initial_epochs=1,
+        aggressiveness=2, max_epochs=4, n_blocks=3,
+        random_state=SEED).fit(X)
+    assert np.isfinite(sh.cv_results_["test_score"]).all()
+    assert isinstance(sh.best_estimator_, MiniBatchKMeans)
+    # y=None delegation on the fitted facade
+    assert np.isfinite(sh.score(X))
+
+
+# ---------------------------------------------------------------------------
+# journal resume
+# ---------------------------------------------------------------------------
+
+
+def test_journal_resume_mid_bracket_bit_identical(tmp_path):
+    X, y = _problem()
+    ck = os.fspath(tmp_path / "asha.journal")
+    a = SuccessiveHalvingSearchCV(_est(), GRID, checkpoint=ck,
+                                  **KW).fit(X, y)
+    full = list(CellJournal(ck).load().items())
+    assert len(full) == 8 + 4 + 2 + 1
+    # keep a prefix ending MID-bracket (rung 1 partially journaled)
+    ck2 = os.fspath(tmp_path / "resume.journal")
+    j2 = CellJournal(ck2)
+    for k, v in full[:10]:
+        j2.append(k, v)
+    b = SuccessiveHalvingSearchCV(_est(), GRID, checkpoint=ck2,
+                                  **KW).fit(X, y)
+    assert b.n_resumed_rungs_ == 10
+    np.testing.assert_array_equal(a.cv_results_["test_score"],
+                                  b.cv_results_["test_score"])
+    assert a.best_params_ == b.best_params_
+    assert (pickle.dumps(a.best_estimator_._pf_state)
+            == pickle.dumps(b.best_estimator_._pf_state))
+    # and the resumed run's journal converges to the same record set
+    assert set(CellJournal(ck2).load()) == set(dict(full))
+
+
+def test_journal_keys_self_invalidate_on_data_change(tmp_path):
+    X, y = _problem()
+    ck = os.fspath(tmp_path / "asha.journal")
+    SuccessiveHalvingSearchCV(_est(), GRID, checkpoint=ck, **KW).fit(X, y)
+    X2 = X.copy()
+    X2[0, 0] += 1.0
+    b = SuccessiveHalvingSearchCV(_est(), GRID, checkpoint=ck,
+                                  **KW).fit(X2, y)
+    assert b.n_resumed_rungs_ == 0  # different content -> no key hits
+
+
+class _Flaky(LogisticRegression):
+    """Raises once (module-level: rung records pickle the estimator)."""
+
+    fails: list = []
+
+    def partial_fit(self, X, y=None, classes=None, sample_weight=None):
+        if _Flaky.fails:
+            _Flaky.fails.pop()
+            raise RuntimeError("injected")
+        return super().partial_fit(X, y, classes=classes,
+                                   sample_weight=sample_weight)
+
+
+def test_failed_rung_is_never_journaled(tmp_path):
+    X, y = _problem()
+    _Flaky.fails = [1]
+    ck = os.fspath(tmp_path / "flaky.journal")
+    sh = SuccessiveHalvingSearchCV(
+        _Flaky(solver="gradient_descent"), GRID, checkpoint=ck,
+        cell_retries=1, batched_rungs=False, **KW).fit(X, y)
+    assert sh.n_rung_retries_ == 1
+    ref = SuccessiveHalvingSearchCV(_est(), GRID, batched_rungs=False,
+                                    **KW).fit(X, y)
+    np.testing.assert_array_equal(sh.cv_results_["test_score"],
+                                  ref.cv_results_["test_score"])
+
+
+# ---------------------------------------------------------------------------
+# timeout semantics: degrade, don't delete
+# ---------------------------------------------------------------------------
+
+
+class _SlowAfterRung0(LogisticRegression):
+    """Fast through the 4 blocks of rung 0, then stalls."""
+
+    def partial_fit(self, X, y=None, classes=None, sample_weight=None):
+        if getattr(self, "_seen", 0) >= 4:
+            time.sleep(0.6)
+        self._seen = getattr(self, "_seen", 0) + 1
+        return super().partial_fit(X, y, classes=classes,
+                                   sample_weight=sample_weight)
+
+
+def test_rung_timeout_keeps_last_completed_rung_score():
+    X, y = _problem(n=400)
+    sh = SuccessiveHalvingSearchCV(
+        _SlowAfterRung0(solver="gradient_descent"), {"C": [0.1, 1.0]},
+        n_initial_parameters="grid", n_initial_epochs=1,
+        aggressiveness=2, max_epochs=4, n_blocks=4, random_state=SEED,
+        cell_timeout=0.3, batched_rungs=False).fit(X, y)
+    assert sh.n_rung_timeouts_ == 1
+    # every candidate keeps a finite score — nobody got error_score'd
+    assert np.isfinite(sh.cv_results_["test_score"]).all()
+    assert "stopped (rung timeout)" in list(sh.cv_results_["status"])
+    # the timed-out candidate's record is its COMPLETED rung 0
+    assert list(sh.cv_results_["n_epochs_"]) == [1, 1]
+    rung0 = {h["model_id"]: h["score"] for h in sh.history_
+             if h["rung"] == 0}
+    for mid, score in zip(sh.cv_results_["model_id"],
+                          sh.cv_results_["test_score"]):
+        assert score == rung0[mid]
+
+
+# ---------------------------------------------------------------------------
+# elastic: kill drill, determinism across rosters, speculation
+# ---------------------------------------------------------------------------
+
+
+def _host(out, rank, wd, X, y, injector=None, speculate_after=None,
+          heartbeat_timeout=2.0):
+    def go():
+        run = ElasticRun(wd, rank=rank, world=2, poll_interval=0.05,
+                         heartbeat_timeout=heartbeat_timeout,
+                         fault_injector=injector,
+                         speculate_after=speculate_after)
+        sh = SuccessiveHalvingSearchCV(_est(), GRID, elastic=run, **KW)
+        try:
+            sh.fit(X, y)
+            out[rank] = sh
+        except SimulatedHostDeath:
+            out[rank] = "died"
+    t = threading.Thread(target=go, daemon=True)
+    t.start()
+    return t
+
+
+def test_elastic_world1_matches_nonelastic_bit_identical(tmp_path):
+    X, y = _problem()
+    ref = SuccessiveHalvingSearchCV(_est(), GRID, **KW).fit(X, y)
+    run = ElasticRun(tmp_path, rank=0, world=1)
+    sh = SuccessiveHalvingSearchCV(_est(), GRID, elastic=run,
+                                   **KW).fit(X, y)
+    np.testing.assert_array_equal(sh.cv_results_["test_score"],
+                                  ref.cv_results_["test_score"])
+    assert (pickle.dumps(sh.best_estimator_._pf_state)
+            == pickle.dumps(ref.best_estimator_._pf_state))
+
+
+def test_two_hosts_match_single_host_and_each_other(tmp_path):
+    X, y = _problem()
+    ref = SuccessiveHalvingSearchCV(_est(), GRID, **KW).fit(X, y)
+    out = {}
+    ts = [_host(out, r, tmp_path, X, y) for r in (0, 1)]
+    for t in ts:
+        t.join(120)
+    for r in (0, 1):
+        sh = out[r]
+        assert sh != "died"
+        np.testing.assert_array_equal(sh.cv_results_["test_score"],
+                                      ref.cv_results_["test_score"])
+        assert sh.best_params_ == ref.best_params_
+
+
+def test_kill_one_host_mid_bracket_drops_zero_candidates(tmp_path):
+    X, y = _problem()
+    ref = SuccessiveHalvingSearchCV(_est(), GRID, **KW).fit(X, y)
+    out = {}
+    # rung 1 of bracket 0 publishes under uid 1001; rank 1 owns the
+    # upper candidate shard {2, 3} of its 4 alive — die after block 2
+    inj = FaultInjector().die_at(2, epoch=1001)
+    t0 = _host(out, 0, tmp_path, X, y)
+    t1 = _host(out, 1, tmp_path, X, y, injector=inj)
+    t0.join(120)
+    t1.join(120)
+    assert out[1] == "died"
+    sh = out[0]
+    assert sh.n_blocks_rebalanced_ >= 1
+    # zero dropped candidates: every one of the 8 has a score...
+    assert np.isfinite(sh.cv_results_["test_score"]).all()
+    # ...and the survivor's results are bit-identical to single-host
+    np.testing.assert_array_equal(sh.cv_results_["test_score"],
+                                  ref.cv_results_["test_score"])
+    assert (pickle.dumps(sh.best_estimator_._pf_state)
+            == pickle.dumps(ref.best_estimator_._pf_state))
+
+
+def test_speculative_redeal_of_straggler_blocks(tmp_path):
+    """Elastic-level pin of the `speculate_after` branch: a healthy but
+    stalled peer's block is speculatively recomputed by an idle
+    survivor; the real owner's later publication is a no-op (first
+    publication wins) and results are unchanged."""
+    run = ElasticRun(tmp_path, rank=0, world=2, poll_interval=0.05,
+                     heartbeat_timeout=30.0, speculate_after=0.3)
+    peer = ElasticRun(tmp_path, rank=1, world=2, poll_interval=0.05,
+                      heartbeat_timeout=30.0)
+    run.bind_problem("spec", x=1)
+    peer.bind_problem("spec", x=1)
+    order = [0, 1, 2, 3]
+    plan = BlockPlan(4, seed=0, shuffle=False)
+    owner = {0: 0, 1: 0, 2: 1, 3: 1}
+
+    def make(host, b):
+        return {"v": np.full(3, 10.0 * b)}
+
+    stop = threading.Event()
+
+    def slow_peer():
+        peer.publish(7, 2, make(peer, 2))
+        peer.beat()
+        while not stop.is_set():  # healthy heartbeat, block 3 stalled
+            peer.beat()
+            time.sleep(0.05)
+
+    t = threading.Thread(target=slow_peer, daemon=True)
+    t.start()
+    try:
+        def compute_publish(blocks):
+            for b in blocks:
+                run.publish(7, b, make(run, b))
+                run.beat()
+        compute_publish([0, 1])
+        out = run.collect_epoch(plan, 7, order, owner, compute_publish)
+    finally:
+        stop.set()
+        t.join(5)
+    assert run.blocks_speculated == 1
+    assert run.blocks_rebalanced == 0  # nobody died
+    for b in order:
+        np.testing.assert_array_equal(out[b]["v"], np.full(3, 10.0 * b))
+
+
+@pytest.mark.slow
+def test_seeded_determinism_across_rosters(tmp_path):
+    """world=1 and world=2 rosters produce identical cv_results_ — the
+    candidate deal changes WHO computes, never WHAT."""
+    X, y = _problem()
+    run1 = ElasticRun(tmp_path / "w1", rank=0, world=1)
+    a = SuccessiveHalvingSearchCV(_est(), GRID, elastic=run1,
+                                  **KW).fit(X, y)
+    out = {}
+    ts = [_host(out, r, tmp_path / "w2", X, y) for r in (0, 1)]
+    for t in ts:
+        t.join(120)
+    b = out[0]
+    np.testing.assert_array_equal(a.cv_results_["test_score"],
+                                  b.cv_results_["test_score"])
+    for k in ("rung_", "n_epochs_", "partial_fit_calls",
+              "rank_test_score"):
+        np.testing.assert_array_equal(a.cv_results_[k],
+                                      b.cv_results_[k])
+    assert [h["score"] for h in a.history_] == [h["score"]
+                                                for h in b.history_]
+
+
+# ---------------------------------------------------------------------------
+# results surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_cv_results_hyperband_metadata_shape():
+    X, y = _problem()
+    hb = HyperbandSearchCV(_est(), GRID, max_epochs=9, aggressiveness=3,
+                           n_blocks=4, random_state=SEED).fit(X, y)
+    cv = hb.cv_results_
+    n = hb.metadata_["n_models"]
+    for col in ("params", "model_id", "bracket_", "rung_", "n_epochs_",
+                "partial_fit_calls", "test_score", "rank_test_score",
+                "mean_partial_fit_time", "mean_score_time", "status",
+                "param_C", "param_solver_kwargs"):
+        assert len(cv[col]) == n, col
+    assert set(cv["bracket_"]) == {0, 1, 2}
+    assert cv["model_id"][0].startswith("bracket=")
+    assert cv["rank_test_score"][hb.best_index_] == 1
+    assert hb.best_score_ == max(cv["test_score"])
+    assert hb.metadata_["partial_fit_calls"] == cv["partial_fit_calls"].sum()
+    assert [b["bracket"] for b in hb.metadata_["brackets"]] == [2, 1, 0]
+    # dask-ml Hyperband semantics: best model is served as-is, no refit
+    assert hb.predict(X[:3]).shape == (3,)
+    assert np.isfinite(hb.score(X, y))
+
+
+def test_shared_fit_report_rung_table_and_budget():
+    X, y = _problem()
+    sh = SuccessiveHalvingSearchCV(_est(), GRID, **KW).fit(X, y)
+    rep = sh.shared_fit_report()
+    assert "20 fit-epochs spent vs 64 synchronous-equivalent" in rep
+    assert "bracket" in rep and "promoted" in rep and "timeouts" in rep
+    # one row per rung
+    assert len([ln for ln in rep.splitlines()
+                if ln.strip().startswith("0 ")]) == 4
+    unfit = SuccessiveHalvingSearchCV(_est(), GRID, **KW)
+    with pytest.raises(AttributeError):
+        unfit.shared_fit_report()
+
+
+def test_search_telemetry_counters_and_spans():
+    from dask_ml_tpu import config
+    from dask_ml_tpu.parallel import telemetry
+
+    X, y = _problem()
+    telemetry.reset_telemetry()
+    telemetry.metrics().reset()
+    try:
+        with config.config_context(telemetry=True):
+            SuccessiveHalvingSearchCV(_est(), GRID, **KW).fit(X, y)
+        counters = telemetry.metrics().snapshot()["counters"]
+        assert counters.get("search.rungs_completed") == 4
+        assert counters.get("search.promotions") == 7  # 4 + 2 + 1
+        assert counters.get("search.candidates_stopped") == 7
+        names = {r["name"] for r in telemetry.spans()}
+        assert {"search.bracket", "search.rung"} <= names
+    finally:
+        telemetry.reset_telemetry()
+        telemetry.metrics().reset()
+
+
+# ---------------------------------------------------------------------------
+# sketched KMeans facade rides the bounded loop (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_sketched_kmeans_bounded_epilogue_bit_identical_to_fused():
+    km_mod = importlib.import_module("dask_ml_tpu.cluster.k_means")
+    rng = np.random.RandomState(3)
+    X = np.concatenate(
+        [rng.randn(300, 16) + 4.0 * c for c in range(4)]
+    ).astype(np.float32)
+
+    def fit():
+        return km_mod.KMeans(
+            n_clusters=4, algorithm="sketched", sketch_cols=8,
+            max_iter=20, random_state=0).fit(X)
+
+    a = fit()  # _SKETCHED_BOUNDED=True default: bounded row_need loop
+    assert hasattr(a, "sketch_pruning_")
+    stats = a.sketch_pruning_
+    assert stats["rows_considered"] > 0
+    assert len(stats["pruned_fraction_per_iter"]) == len(
+        stats["bound_held_fraction_per_iter"])
+    old = km_mod._SKETCHED_BOUNDED
+    km_mod._SKETCHED_BOUNDED = False
+    try:
+        b = fit()  # fused reference epilogue
+    finally:
+        km_mod._SKETCHED_BOUNDED = old
+    assert not hasattr(b, "sketch_pruning_")
+    np.testing.assert_array_equal(a.cluster_centers_, b.cluster_centers_)
+    np.testing.assert_array_equal(a.labels_, b.labels_)
+    assert a.inertia_ == b.inertia_
+    assert a.n_iter_ == b.n_iter_
+    np.testing.assert_array_equal(a.sketch_vals_, b.sketch_vals_)
