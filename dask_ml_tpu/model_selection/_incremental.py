@@ -374,8 +374,22 @@ class BaseIncrementalSearchCV(BaseEstimator, MetaEstimatorMixin):
         self.n_rung_timeouts_ = 0
         self.n_rung_retries_ = 0
         self.n_resumed_rungs_ = 0
+        self.n_plateau_stops_ = 0
         self.rung_compile_stats_ = []
         budget_spent = [0]
+
+        # plateau stop (patience): a candidate whose journaled rung
+        # scores improve < tol for `patience` consecutive scored rungs
+        # stops early even if its RANK would have promoted it — rank
+        # can stay high while learning has stalled, and a stalled
+        # candidate's remaining epochs are pure budget leak
+        patience_n = getattr(self, "patience", None)
+        patience_n = None if patience_n is None else int(patience_n)
+        if patience_n is not None and patience_n < 1:
+            raise ValueError(f"patience must be >= 1, got {patience_n}")
+        plateau_tol = float(getattr(self, "tol", 1e-3) or 0.0)
+        plateau_best: dict = {}    # cid -> best score seen (ratchet)
+        plateau_streak: dict = {}  # cid -> consecutive sub-tol rungs
 
         cap = getattr(self, "max_epochs", None)
         cap = None if cap is None else int(cap)
@@ -644,6 +658,30 @@ class BaseIncrementalSearchCV(BaseEstimator, MetaEstimatorMixin):
                     final = (len(survivors) <= 1
                              and (cap is None or cum >= cap)) or (
                                  cap is not None and cum >= cap)
+                    plateaued: list = []
+                    if patience_n is not None and not final:
+                        keep = []
+                        for cid in survivors:
+                            sc = records[cid]["score"]
+                            best = plateau_best.get(cid)
+                            if best is None or sc > best + plateau_tol:
+                                plateau_best[cid] = (
+                                    sc if best is None else max(sc, best))
+                                plateau_streak[cid] = 0
+                                keep.append(cid)
+                                continue
+                            plateau_streak[cid] = (
+                                plateau_streak.get(cid, 0) + 1)
+                            if plateau_streak[cid] >= patience_n:
+                                plateaued.append(cid)
+                                cand_status[cid] = "stopped (plateau)"
+                            else:
+                                keep.append(cid)
+                        survivors = keep
+                        if plateaued:
+                            self.n_plateau_stops_ += len(plateaued)
+                            telemetry.counter(
+                                "search.plateau_stops").inc(len(plateaued))
                     if final:
                         n_next = len(survivors)
                         promoted, stopped = survivors, []
@@ -657,18 +695,20 @@ class BaseIncrementalSearchCV(BaseEstimator, MetaEstimatorMixin):
                     if not final and promoted:
                         telemetry.counter("search.promotions").inc(
                             len(promoted))
-                    if stopped or timeouts:
-                        self.n_candidates_stopped_ += (len(stopped)
-                                                       + len(timeouts))
+                    if stopped or timeouts or plateaued:
+                        self.n_candidates_stopped_ += (
+                            len(stopped) + len(timeouts) + len(plateaued))
                         telemetry.counter(
                             "search.candidates_stopped").inc(
-                            len(stopped) + len(timeouts))
+                            len(stopped) + len(timeouts) + len(plateaued))
                     rung_table.append({
                         "bracket": s, "rung": rung, "n_epochs": cum,
-                        "alive": len(alive), "scored": len(survivors),
+                        "alive": len(alive),
+                        "scored": len(survivors) + len(plateaued),
                         "promoted": 0 if final else len(promoted),
                         "stopped": len(stopped), "timeouts":
-                            len(timeouts), "final": bool(final),
+                            len(timeouts), "plateau": len(plateaued),
+                        "final": bool(final),
                     })
                     if final:
                         for cid in promoted:
@@ -839,14 +879,15 @@ class BaseIncrementalSearchCV(BaseEstimator, MetaEstimatorMixin):
              f"({pct:.0f}%)"),
             "",
             (f"{'bracket':>7} {'rung':>4} {'epochs':>6} {'alive':>5} "
-             f"{'promoted':>8} {'stopped':>7} {'timeouts':>8}"),
+             f"{'promoted':>8} {'stopped':>7} {'timeouts':>8} "
+             f"{'plateau':>7}"),
         ]
         for row in self.rung_table_:
             lines.append(
                 f"{row['bracket']:>7} {row['rung']:>4} "
                 f"{row['n_epochs']:>6} {row['alive']:>5} "
                 f"{row['promoted']:>8} {row['stopped']:>7} "
-                f"{row['timeouts']:>8}")
+                f"{row['timeouts']:>8} {row.get('plateau', 0):>7}")
         extras = []
         if self.n_blocks_rebalanced_ or self.n_blocks_speculated_:
             extras.append(
@@ -865,6 +906,13 @@ class BaseIncrementalSearchCV(BaseEstimator, MetaEstimatorMixin):
                 f"{self.n_rung_timeouts_} rung timeout"
                 f"{'' if self.n_rung_timeouts_ == 1 else 's'} "
                 "(degraded to last completed rung)")
+        if getattr(self, "n_plateau_stops_", 0):
+            extras.append(
+                f"{self.n_plateau_stops_} candidate"
+                f"{'' if self.n_plateau_stops_ == 1 else 's'} "
+                f"plateau-stopped (< {getattr(self, 'tol', 1e-3)} score "
+                f"improvement for {getattr(self, 'patience', '?')} "
+                "rungs)")
         if extras:
             lines += [""] + extras
         if telemetry.enabled() or telemetry.spans():
@@ -913,7 +961,14 @@ class SuccessiveHalvingSearchCV(BaseIncrementalSearchCV):
     finds-the-grid-optimum configuration). ``n_initial_epochs`` is the
     rung-0 budget; each promotion keeps the top ``1/aggressiveness`` of
     the scored candidates and multiplies the cumulative epoch budget by
-    ``aggressiveness``, up to ``max_epochs``. See the module docstring
+    ``aggressiveness``, up to ``max_epochs``.
+
+    ``patience`` (optional) adds a plateau stop on top of the halving
+    rule: a candidate whose journaled rung score improves by less than
+    ``tol`` for ``patience`` consecutive rungs is stopped even if it
+    would otherwise be promoted. Plateau stops are counted in
+    ``n_plateau_stops_`` and reported per rung in ``rung_table_``
+    (``plateau`` column). See the module docstring
     for rung/epoch semantics, journaling, batching, and the elastic
     plane; see :class:`HyperbandSearchCV` for the multi-bracket sweep.
     """
@@ -923,7 +978,8 @@ class SuccessiveHalvingSearchCV(BaseIncrementalSearchCV):
                  aggressiveness=3, max_epochs=None, test_size=0.2,
                  n_blocks=4, shuffle_seed=0, random_state=0,
                  scoring=None, checkpoint=None, cell_timeout=None,
-                 cell_retries=0, elastic=None, batched_rungs=True):
+                 cell_retries=0, elastic=None, batched_rungs=True,
+                 patience=None, tol=1e-3):
         self.estimator = estimator
         self.parameters = parameters
         self.n_initial_parameters = n_initial_parameters
@@ -940,6 +996,8 @@ class SuccessiveHalvingSearchCV(BaseIncrementalSearchCV):
         self.cell_retries = cell_retries
         self.elastic = elastic
         self.batched_rungs = batched_rungs
+        self.patience = patience
+        self.tol = tol
 
     def _brackets(self) -> list:
         if self.n_initial_parameters == "grid":
@@ -963,7 +1021,8 @@ class HyperbandSearchCV(BaseIncrementalSearchCV):
                  aggressiveness=3, test_size=0.2, n_blocks=4,
                  shuffle_seed=0, random_state=0, scoring=None,
                  checkpoint=None, cell_timeout=None, cell_retries=0,
-                 elastic=None, batched_rungs=True):
+                 elastic=None, batched_rungs=True, patience=None,
+                 tol=1e-3):
         self.estimator = estimator
         self.parameters = parameters
         self.max_epochs = max_epochs
@@ -978,6 +1037,8 @@ class HyperbandSearchCV(BaseIncrementalSearchCV):
         self.cell_retries = cell_retries
         self.elastic = elastic
         self.batched_rungs = batched_rungs
+        self.patience = patience
+        self.tol = tol
 
     def _brackets(self) -> list:
         return hyperband_brackets(int(self.max_epochs),

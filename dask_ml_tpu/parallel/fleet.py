@@ -231,7 +231,7 @@ class ServingFleet:
                  policy=None,
                  max_batch_rows: int = 2048,
                  max_queue: int = 4096,
-                 coalesce_window_s: float = 0.0,
+                 coalesce_window_s="adaptive",
                  heartbeat_interval_s: float = 0.05,
                  heartbeat_timeout_s: float = 2.0,
                  max_consecutive_failures: int = 3,
@@ -252,7 +252,9 @@ class ServingFleet:
         self.policy = policy
         self.max_batch_rows = int(max_batch_rows)
         self.max_queue = int(max_queue)
-        self.coalesce_window_s = float(coalesce_window_s)
+        self.coalesce_window_s = (
+            coalesce_window_s if isinstance(coalesce_window_s, str)
+            else float(coalesce_window_s))
         self.heartbeat_interval_s = float(heartbeat_interval_s)
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
         self.max_consecutive_failures = int(max_consecutive_failures)
@@ -890,6 +892,23 @@ _WIRE_ERRORS = {
 }
 
 
+class _ShmSwitch:
+    """Writer-queue marker: every response enqueued after it leaves over
+    the negotiated shm ring instead of the TCP frame wire (FIFO order
+    guarantees the hello ACK, enqueued just before, went out on TCP)."""
+
+    __slots__ = ("ep",)
+
+    def __init__(self, ep):
+        self.ep = ep
+
+
+#: one byte on the retained TCP socket after every shm ring write: the
+#: peer blocks in the kernel (cheap, instant wakeup) instead of polling
+#: the ring, and the byte stream doubles as the liveness/EOF channel
+_DOORBELL = b"\x01"
+
+
 class FleetServer:
     """Socket front-end for a :class:`ServingFleet` (or a single
     :class:`ServingLoop`): out-of-process clients submit inference
@@ -924,10 +943,12 @@ class FleetServer:
 
     def __init__(self, fleet, host: str = "127.0.0.1", port: int = 0, *,
                  max_payload: int = 256 * 1024 * 1024,
-                 extra_stats=None):
+                 extra_stats=None, shm: bool = True):
         self.fleet = fleet
         self.max_payload = int(max_payload)
         self._extra_stats = extra_stats
+        self.shm = bool(shm)
+        self.n_shm_conns = 0
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, int(port)))
@@ -981,63 +1002,113 @@ class FleetServer:
     #: rather than buffering unboundedly
     MAX_PENDING_RESPONSES = 1024
 
-    def _send(self, conn, out_q, control: dict, arrays=()) -> None:
+    def _send(self, conn, out_q, control: dict, arrays=(),
+              release=None) -> None:
         """Enqueue one response for the connection's writer thread. The
         write itself happens OFF the caller's thread: responses are
         delivered from future callbacks that run on replica dispatch
         threads, and a blocking ``sendall`` to a stalled client there
         would freeze the replica's dispatch loop (and read as a death to
-        the health monitor)."""
+        the health monitor). ``release`` (the shm record hold of the
+        request this response answers) runs after the response bytes
+        left — the response may alias the request's in-ring buffers, so
+        the record cannot be recycled a moment earlier."""
         import queue as queue_mod
 
         try:
-            out_q.put_nowait((control, tuple(arrays)))
+            out_q.put_nowait((control, tuple(arrays), release))
         except queue_mod.Full:
+            if release is not None:
+                release()
             try:
                 conn.close()  # reader+writer unwind on the closed socket
             except OSError:
                 pass
 
     def _write_loop(self, conn, out_q) -> None:
+        from dask_ml_tpu.parallel import telemetry
+
+        shm_ep = None
         while True:
             msg = out_q.get()
             if msg is None:
                 return
-            control, arrays = msg
+            if msg.__class__ is _ShmSwitch:
+                # everything enqueued after this marker leaves over the
+                # negotiated shm ring; the hello ACK sits BEFORE it in
+                # this FIFO, so the client reads the ACK on TCP and only
+                # then arms its ring reader
+                shm_ep = msg.ep
+                continue
+            control, arrays, release = msg
             try:
-                payload = framing.encode_payload(control, arrays)
-            except framing.PayloadError as e:
-                # an un-encodable RESPONSE (e.g. a host-fallback model
-                # returning string labels — a dtype the typed wire
-                # refuses) fails ITS caller with an error frame; the
-                # writer must survive, or every later response on this
-                # connection silently wedges
-                payload = framing.encode_payload({
-                    "id": control.get("id"), "ok": False,
-                    "error": "PayloadError",
-                    "message": f"response not wire-encodable: "
-                               f"{str(e)[:512]}"})
-            try:
-                framing.write_frame(conn, payload,
-                                    magic=framing.WIRE_MAGIC)
+                try:
+                    if shm_ep is not None:
+                        shm_ep.send(control, arrays,
+                                    timeout=self.SEND_TIMEOUT_S)
+                        conn.sendall(_DOORBELL)
+                    else:
+                        # encode ONCE and write from the retained
+                        # buffers: a single digest pass over the parts,
+                        # no payload concatenation copy per response
+                        n = framing.write_frame_parts(
+                            conn,
+                            framing.encode_payload_parts(control, arrays),
+                            magic=framing.WIRE_MAGIC,
+                            checksum=framing.WIRE_CHECKSUM)
+                        if telemetry.enabled():
+                            telemetry.metrics().counter(
+                                "wire.bytes", transport="tcp").inc(n)
+                except framing.PayloadError as e:
+                    # an un-encodable RESPONSE (e.g. a host-fallback
+                    # model returning string labels — a dtype the typed
+                    # wire refuses) fails ITS caller with an error
+                    # frame; the writer must survive, or every later
+                    # response on this connection silently wedges
+                    err = {"id": control.get("id"), "ok": False,
+                           "error": "PayloadError",
+                           "message": f"response not wire-encodable: "
+                                      f"{str(e)[:512]}"}
+                    if shm_ep is not None:
+                        shm_ep.send(err, timeout=self.SEND_TIMEOUT_S)
+                        conn.sendall(_DOORBELL)
+                    else:
+                        framing.write_frame(
+                            conn, framing.encode_payload(err),
+                            magic=framing.WIRE_MAGIC,
+                            checksum=framing.WIRE_CHECKSUM)
             except OSError:
                 return  # peer went away; nothing to deliver to
+            finally:
+                if release is not None:
+                    release()
+
+    #: writer-side bound on one shm ring send — a client that stopped
+    #: draining its response ring for this long is treated as gone
+    SEND_TIMEOUT_S = 30.0
 
     def _serve_conn(self, conn) -> None:
         import queue as queue_mod
 
         out_q: "queue_mod.Queue" = queue_mod.Queue(
             maxsize=self.MAX_PENDING_RESPONSES)
+        state: dict = {"shm": None}
         writer = threading.Thread(target=self._write_loop,
                                   args=(conn, out_q),
                                   name="fleet-server-writer", daemon=True)
         writer.start()
         try:
             while not self._stop.is_set():
+                ep = state["shm"]
+                if ep is not None:
+                    if not self._serve_shm_step(conn, out_q, ep):
+                        return
+                    continue
                 try:
                     payload = framing.read_frame(
                         conn, magic=framing.WIRE_MAGIC,
-                        max_payload=self.max_payload)
+                        max_payload=self.max_payload,
+                        checksum=framing.WIRE_CHECKSUM)
                 except framing.FrameError as e:
                     # a torn/corrupt frame fails ITS caller and ends the
                     # stream: byte alignment is gone, so nothing later on
@@ -1049,7 +1120,7 @@ class FleetServer:
                     return
                 if payload is None:
                     return  # clean EOF
-                self._handle(conn, out_q, payload)
+                self._handle(conn, out_q, payload, state)
         finally:
             # let queued responses flush, then stop the writer; closing
             # the socket afterwards unblocks a writer stalled mid-send
@@ -1058,12 +1129,104 @@ class FleetServer:
             except queue_mod.Full:
                 pass
             writer.join(5.0)
+            ep = state.get("shm")
+            if ep is not None:
+                ep.close()
             try:
                 conn.close()
             except OSError:
                 pass
             if conn in self._conns:
                 self._conns.remove(conn)
+
+    def _serve_shm_step(self, conn, out_q, ep) -> bool:
+        """One step of a negotiated shm connection: drain the request
+        ring, then BLOCK on the TCP socket for the client's doorbell
+        byte — a kernel wakeup instead of a poll loop, so an idle (or
+        GIL-contended) link costs nothing. Every ring record is paired
+        with one doorbell byte sent after its READY publish, so a
+        drain-to-empty after every wakeup can never strand a record;
+        stale coalesced bytes just buy a benign extra drain pass. False
+        ends the connection. A ``kill -9``'d client surfaces here
+        exactly the way it does on the framed wire: as EOF/reset on the
+        socket."""
+        import select
+
+        try:
+            rec = ep.recv(timeout=0.0)
+        except framing.PayloadError as e:
+            # typed decode failed but the record frame was intact: fails
+            # its request only (record already released), the ring and
+            # the connection survive — same contract as the TCP wire
+            self._send(conn, out_q, {
+                "id": None, "ok": False,
+                "error": type(e).__name__, "message": str(e)})
+            return True
+        except (framing.FrameError, ConnectionError) as e:
+            self.n_frame_errors += 1
+            self._send(conn, out_q, {
+                "id": None, "ok": False,
+                "error": type(e).__name__, "message": str(e)})
+            return False
+        if rec is None:
+            try:
+                ready, _, _ = select.select([conn], [], [], 0.25)
+                if not ready:
+                    return True  # idle: loop to re-check server stop
+                b = conn.recv(4096)
+                if b == b"":
+                    return False  # client closed cleanly
+            except (OSError, ValueError):
+                return False  # reset/abort/closed-fd: client died
+            return True
+        msg, arrays, token = rec
+
+        def release(t=token):
+            ep.release(t)
+
+        self._handle_msg(conn, out_q, msg, arrays, release)
+        return True
+
+    def _handle_hello(self, conn, out_q, msg: dict, state: dict) -> None:
+        """``op="shm_hello"``: the client created a shared-memory
+        segment and asks this server to attach. Attach can only succeed
+        when both ends share a kernel — that IS the same-machine test —
+        so any failure just answers ``shm: false`` and the connection
+        stays on the framed TCP wire, byte-identical semantics."""
+        rid = msg.get("id") if isinstance(msg.get("id"), str) else None
+        if not self.shm or state.get("shm") is not None:
+            self._send(conn, out_q, {
+                "id": rid, "ok": True, "shm": False,
+                "reason": ("shm disabled on this server" if not self.shm
+                           else "shm already negotiated")})
+            return
+        try:
+            from dask_ml_tpu.parallel import shm as shm_lib
+
+            ep = shm_lib.ShmServer(
+                str(msg.get("segment")),
+                ring_bytes=msg.get("ring_bytes"),
+                checksum=msg.get("checksum"))
+        except Exception as e:  # noqa: BLE001 — any attach/validate
+            # failure means "this link stays on TCP", never an error
+            self._send(conn, out_q, {
+                "id": rid, "ok": True, "shm": False,
+                "reason": f"{type(e).__name__}: {str(e)[:256]}"})
+            return
+        import queue as queue_mod
+
+        self._send(conn, out_q, {"id": rid, "ok": True, "shm": True})
+        try:
+            out_q.put_nowait(_ShmSwitch(ep))
+        except queue_mod.Full:
+            ep.close()
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        state["shm"] = ep
+        self.n_shm_conns += 1
 
     def _stats_snapshot(self) -> dict:
         """The routing-signal summary ``op="stats"`` answers with —
@@ -1084,10 +1247,30 @@ class FleetServer:
             out.update(self._extra_stats())
         return out
 
-    def _handle(self, conn, out_q, payload: bytes) -> None:
-        rid = None
+    def _handle(self, conn, out_q, payload, state=None) -> None:
+        """One framed TCP request: typed decode, then either the
+        shm negotiation op or the shared dispatch."""
         try:
             msg, arrays = framing.decode_payload(payload)
+        except Exception as e:  # noqa: BLE001 — per-frame error delivery
+            self._send(conn, out_q, {
+                "id": None, "ok": False,
+                "error": type(e).__name__, "message": str(e)})
+            return
+        if msg.get("op") == "shm_hello" and state is not None:
+            self._handle_hello(conn, out_q, msg, state)
+            return
+        self._handle_msg(conn, out_q, msg, arrays, None)
+
+    def _handle_msg(self, conn, out_q, msg: dict, arrays,
+                    release) -> None:
+        """Dispatch one decoded request, transport-agnostic. ``release``
+        (shm only) is handed to exactly one ``_send`` — the writer runs
+        it after the response leaves, which is when the request's
+        in-ring buffers (possibly aliased by the response) are last
+        read."""
+        rid = None
+        try:
             op = msg.get("op")
             rid = msg.get("id")
             if rid is not None and not isinstance(rid, str):
@@ -1097,11 +1280,13 @@ class FleetServer:
             if op == "ping":
                 self._send(conn, out_q, {"id": rid, "ok": True,
                                          "pong": True,
-                                         "pid": os.getpid()})
+                                         "pid": os.getpid()},
+                           release=release)
                 return
             if op == "stats":
                 self._send(conn, out_q, {"id": rid, "ok": True,
-                                         "stats": self._stats_snapshot()})
+                                         "stats": self._stats_snapshot()},
+                           release=release)
                 return
             if op != "submit":
                 raise ValueError(f"unknown wire op {op!r}")
@@ -1127,7 +1312,8 @@ class FleetServer:
         except Exception as e:  # noqa: BLE001 — per-frame error delivery
             self._send(conn, out_q, {
                 "id": rid, "ok": False,
-                "error": type(e).__name__, "message": str(e)})
+                "error": type(e).__name__, "message": str(e)},
+                release=release)
             return
 
         def deliver(f, rid=rid):
@@ -1136,10 +1322,11 @@ class FleetServer:
             except Exception as e:  # noqa: BLE001
                 self._send(conn, out_q, {
                     "id": rid, "ok": False,
-                    "error": type(e).__name__, "message": str(e)})
+                    "error": type(e).__name__, "message": str(e)},
+                    release=release)
             else:
                 self._send(conn, out_q, {"id": rid, "ok": True},
-                           arrays=(np.asarray(out),))
+                           arrays=(np.asarray(out),), release=release)
 
         fut.add_done_callback(deliver)
 
@@ -1221,12 +1408,22 @@ class FleetClient:
                  request_timeout: Optional[float] = None,
                  send_timeout: Optional[float] = 30.0,
                  retries: int = 0,
-                 retry_budget: Optional[RetryBudget] = None):
+                 retry_budget: Optional[RetryBudget] = None,
+                 shm: bool = True,
+                 shm_ring_bytes: Optional[int] = None):
         self.address = (address[0], int(address[1]))
         self._connect_timeout = timeout
         self.request_timeout = request_timeout
         self.send_timeout = send_timeout
         self.retries = int(retries)
+        self._shm_enabled = bool(shm)
+        self._shm_ring_bytes = shm_ring_bytes
+        self._shm = None  # negotiated ShmClient endpoint, else None
+        # (rid, endpoint) of an in-flight shm offer: the READ LOOP arms
+        # the ring when the matching ACK arrives, so no framed read can
+        # race the server's first doorbell byte
+        self._shm_pending = None
+        self.n_shm_connects = 0
         # retries without a budget would be exactly the retry-storm
         # amplifier the budget exists to prevent: default one in
         self.retry_budget = (retry_budget if retry_budget is not None
@@ -1253,6 +1450,7 @@ class FleetClient:
 
         self._telemetry_inherit = telemetry.enabled()
         self._sock = self._connect()
+        self._negotiate_shm()
 
     def _connect(self):
         import struct as struct_mod
@@ -1282,6 +1480,9 @@ class FleetClient:
 
     def close(self) -> None:
         self._closed = True
+        ep, self._shm = self._shm, None
+        if ep is not None:
+            ep.close()
         try:
             self._sock.close()
         except OSError:
@@ -1293,29 +1494,136 @@ class FleetClient:
     def __exit__(self, *exc) -> None:
         self.close()
 
+    def _negotiate_shm(self) -> None:
+        """Offer the server a shared-memory ring for this connection
+        (``op="shm_hello"`` over the just-established TCP wire). Create
+        the segment, name it, wait for the attach verdict: yes → both
+        directions move to the ring and the socket stays open as the
+        doorbell + liveness/EOF channel; no (cross-machine, disabled,
+        old server) → unlink the segment and keep the framed wire.
+        Never raises — TCP is always the safe landing."""
+        if not self._shm_enabled or self._closed:
+            return
+        try:
+            from dask_ml_tpu.parallel import shm as shm_lib
+
+            kwargs = ({} if self._shm_ring_bytes is None
+                      else {"ring_bytes": int(self._shm_ring_bytes)})
+            ep = shm_lib.ShmClient(**kwargs)
+        except Exception:  # noqa: BLE001 — no shm on this platform
+            return
+        with self._lock:
+            self._seq += 1
+            rid = f"{self._rid_prefix}-{self._seq}"
+            fut: Future = Future()
+            self._pending[rid] = fut
+        ok = False
+        try:
+            hello = dict(ep.hello())
+            hello["id"] = rid
+            # the READ LOOP arms the ring the moment it decodes the ACK
+            # (before delivering this future): the very next byte the
+            # server sends after a yes is a doorbell, not a frame, so
+            # the switch cannot be left to this thread — the reader
+            # would already be blocked inside read_frame misparsing it
+            self._shm_pending = (rid, ep)
+            # written directly (the caller already holds _wlock on the
+            # reconnect path; _send_msg would deadlock on it)
+            framing.write_frame_parts(
+                self._sock, framing.encode_payload_parts(hello),
+                magic=framing.WIRE_MAGIC,
+                checksum=framing.WIRE_CHECKSUM)
+            msg = fut.result(10.0)
+            ok = isinstance(msg, dict) and msg.get("shm") is True
+        except Exception:  # noqa: BLE001 — any failure → TCP fallback
+            ok = False
+        finally:
+            self._shm_pending = None
+            with self._lock:
+                self._pending.pop(rid, None)
+                self._deadlines.pop(rid, None)
+        if not ok:
+            with self._lock:
+                armed = self._shm is ep
+                if armed:  # ACK raced the 10s verdict timeout: keep it
+                    ok = True
+            if not ok:
+                ep.close(unlink=True)
+
+    def _drain_shm(self, ep) -> None:
+        """Deliver every response currently in the ring. Responses are
+        small (one result array): copy out and release the record
+        immediately — the hold-until-done discipline matters on the
+        server's request side, not here."""
+        while True:
+            try:
+                rec = ep.recv(timeout=0.0)
+            except framing.PayloadError:
+                continue  # malformed response fails its frame only
+            if rec is None:
+                return
+            msg, arrays, token = rec
+            try:
+                copies = [np.array(a) for a in arrays]
+            finally:
+                ep.release(token)
+            self._dispatch_msg(msg, copies)
+
+    def _shm_doorbell_loop(self, ep, sock) -> bool:
+        """The read loop's shm mode: block on the TCP socket for the
+        server's doorbell byte, then drain the response ring. Every ring
+        record is paired with one byte sent after its READY publish, so
+        drain-to-empty per wakeup never strands a response. Returns True
+        on clean server EOF (mirrors ``read_frame`` returning None);
+        ring corruption raises FrameError, socket death OSError — both
+        unwind through the read loop's one pending-failure path."""
+        while not self._closed and not ep.closed:
+            self._drain_shm(ep)
+            b = sock.recv(4096)
+            if b == b"":
+                return True
+        return False
+
+    def _dispatch_msg(self, msg: dict, arrays) -> None:
+        """Demultiplex one response (either transport) to its future."""
+        rid = msg.get("id")
+        with self._lock:
+            fut = self._pending.pop(rid, None)
+            self._deadlines.pop(rid, None)
+        if fut is None:
+            return  # response to a caller that went away
+        if msg.get("ok"):
+            _set_future(fut, arrays[0] if arrays else msg)
+        else:
+            cls = _WIRE_ERRORS.get(msg.get("error"), RuntimeError)
+            _fail_future(fut, cls(
+                f"[remote {msg.get('error')}] {msg.get('message')}"))
+
     def _read_loop(self, sock) -> None:
         exc: BaseException = ServingStopped("wire connection closed")
         clean = False
         try:
             while True:
-                payload = framing.read_frame(sock,
-                                             magic=framing.WIRE_MAGIC)
+                payload = framing.read_frame(
+                    sock, magic=framing.WIRE_MAGIC,
+                    checksum=framing.WIRE_CHECKSUM)
                 if payload is None:
                     clean = True
                     break
                 msg, arrays = framing.decode_payload(payload)
-                rid = msg.get("id")
-                with self._lock:
-                    fut = self._pending.pop(rid, None)
-                    self._deadlines.pop(rid, None)
-                if fut is None:
-                    continue  # response to a caller that went away
-                if msg.get("ok"):
-                    _set_future(fut, arrays[0] if arrays else msg)
-                else:
-                    cls = _WIRE_ERRORS.get(msg.get("error"), RuntimeError)
-                    _fail_future(fut, cls(
-                        f"[remote {msg.get('error')}] {msg.get('message')}"))
+                pend = self._shm_pending
+                if (pend is not None and msg.get("id") == pend[0]
+                        and msg.get("ok") and msg.get("shm") is True):
+                    # the server attached: arm the ring BEFORE waking
+                    # the negotiator, then leave framed mode for good —
+                    # everything after this frame is doorbell bytes
+                    with self._lock:
+                        self._shm = pend[1]
+                    self.n_shm_connects += 1
+                    self._dispatch_msg(msg, arrays)
+                    clean = self._shm_doorbell_loop(pend[1], sock)
+                    break
+                self._dispatch_msg(msg, arrays)
         except (OSError, framing.FrameError) as e:
             exc = e
         finally:
@@ -1324,9 +1632,14 @@ class FleetClient:
                     # a cleanly-closed connection arms the one-shot
                     # reconnect; a torn one stays down
                     self._clean_eof = clean and not self._closed
+                    ep, self._shm = self._shm, None
+                else:
+                    ep = None
                 pending = list(self._pending.values())
                 self._pending.clear()
                 self._deadlines.clear()
+            if ep is not None:
+                ep.close()  # unlink: this connection's segment dies here
             cause = (ServingStopped("wire connection closed by server")
                      if clean else ServingStopped(
                          f"wire connection lost: {exc!r}"))
@@ -1346,6 +1659,9 @@ class FleetClient:
             self._clean_eof = False
             self._reconnected = True
             self.n_reconnects += 1
+        ep, self._shm = self._shm, None
+        if ep is not None:
+            ep.close()  # a fresh connection negotiates a fresh segment
         try:
             try:
                 self._sock.close()
@@ -1354,6 +1670,7 @@ class FleetClient:
             self._sock = self._connect()
         except OSError as e:
             raise ServingStopped(f"wire reconnect failed: {e!r}")
+        self._negotiate_shm()
 
     def _count_timeout(self) -> None:
         from dask_ml_tpu.parallel import telemetry
@@ -1405,18 +1722,39 @@ class FleetClient:
                 self._reaper.start()
 
     def _send_msg(self, control: dict, arrays=()) -> None:
-        payload = framing.encode_payload(control, arrays)
+        from dask_ml_tpu.parallel import telemetry
+
         with self._wlock:
             self._ensure_connected()
+            ep = self._shm
+            if ep is not None:
+                # negotiated ring: one encode pass straight into shared
+                # memory (its own wire.bytes{transport="shm"} mirror),
+                # then the doorbell byte that wakes the server's
+                # kernel-blocked reader
+                ep.send(control, arrays, timeout=self.send_timeout)
+                self._sock.sendall(_DOORBELL)
+                return
+            parts = framing.encode_payload_parts(control, arrays)
             try:
-                framing.write_frame(self._sock, payload,
-                                    magic=framing.WIRE_MAGIC)
+                n = framing.write_frame_parts(
+                    self._sock, parts, magic=framing.WIRE_MAGIC,
+                    checksum=framing.WIRE_CHECKSUM)
             except OSError:
                 # the close may have raced the write; one clean-EOF
                 # reconnect attempt, then give up loudly
                 self._ensure_connected()
-                framing.write_frame(self._sock, payload,
-                                    magic=framing.WIRE_MAGIC)
+                ep = self._shm
+                if ep is not None:
+                    ep.send(control, arrays, timeout=self.send_timeout)
+                    self._sock.sendall(_DOORBELL)
+                    return
+                n = framing.write_frame_parts(
+                    self._sock, parts, magic=framing.WIRE_MAGIC,
+                    checksum=framing.WIRE_CHECKSUM)
+            if telemetry.enabled():
+                telemetry.metrics().counter(
+                    "wire.bytes", transport="tcp").inc(n)
 
     def _new_request(self) -> tuple:
         with self._lock:

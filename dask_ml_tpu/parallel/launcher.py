@@ -86,6 +86,12 @@ class MachineSpec:
     devices: int = 0
     host: str = "127.0.0.1"
     env: dict = dataclasses.field(default_factory=dict)
+    #: whether the router may OFFER a shared-memory ring to replicas on
+    #: this machine (the offer is still attach-verified at negotiation —
+    #: a genuinely remote machine falls back to TCP on its own — so this
+    #: flag only short-circuits the attempt, e.g. for a roster entry
+    #: known to sit behind a network hop or a broken /dev/shm)
+    shm: bool = True
 
 
 class Launcher:

@@ -475,7 +475,9 @@ class ProcessFleet:
                             self._timeouts_base += rep.client.n_timeouts
                     rep.client = FleetClient(
                         rep.address, timeout=10.0,
-                        request_timeout=self.request_timeout_s)
+                        request_timeout=self.request_timeout_s,
+                        shm=(rep.machine.shm
+                             if rep.machine is not None else True))
                     rep.client.ping(timeout=30.0)
                     rep.lat.clear()
                     rep.ewma_s = 0.0

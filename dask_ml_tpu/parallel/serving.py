@@ -553,12 +553,21 @@ class ServingLoop:
     max_queue : int
         Bounded queue capacity in REQUESTS; ``submit`` past it raises
         :class:`ServingQueueFull` (backpressure, never silent dropping).
-    coalesce_window_s : float
+    coalesce_window_s : float or "adaptive"
         Extra time the dispatcher may wait after picking a batch's first
-        request to let the batch fill. The default 0 never waits —
-        under load, batching emerges naturally from dispatch latency
-        (continuous batching); a small positive window trades p50 latency
-        for occupancy on lightly-loaded mixes.
+        request to let the batch fill. ``"adaptive"`` (the default) runs
+        the arrival-rate controller: at dispatch time the window is the
+        predicted time for the batch to fill its CURRENT pad bucket —
+        rows the padded program computes anyway, so occupancy is free —
+        at the submit-side rows/s EWMA, clamped to
+        ``coalesce_window_max_s`` and to the batch's tightest deadline
+        slack (minus a compute-latency margin), and collapsed to EXACT
+        zero when arrivals went idle. A float keeps the fixed-window
+        semantics: 0 never waits (batching emerges from dispatch
+        latency alone); a positive value always waits that long.
+    coalesce_window_max_s : float
+        Ceiling on the adaptive window (default 10 ms) — the most p50
+        latency the controller may ever spend buying occupancy.
     mesh, drain, retry_policy, fault_injector
         Mesh override; a :class:`~dask_ml_tpu.parallel.faults.
         GracefulDrain` to compose shutdown with SIGTERM; a
@@ -572,7 +581,8 @@ class ServingLoop:
                  policy: Optional[PadPolicy] = None,
                  max_batch_rows: int = 2048,
                  max_queue: int = 4096,
-                 coalesce_window_s: float = 0.0,
+                 coalesce_window_s="adaptive",
+                 coalesce_window_max_s: float = 0.010,
                  mesh=None,
                  drain=None,
                  retry_policy=None,
@@ -582,7 +592,15 @@ class ServingLoop:
         self.policy = policy if policy is not None else DEFAULT_SERVING_POLICY
         self.max_batch_rows = int(max_batch_rows)
         self.max_queue = int(max_queue)
-        self.coalesce_window_s = float(coalesce_window_s)
+        if isinstance(coalesce_window_s, str):
+            if coalesce_window_s != "adaptive":
+                raise ValueError(
+                    f"coalesce_window_s must be a float or 'adaptive', "
+                    f"got {coalesce_window_s!r}")
+            self.coalesce_window_s = "adaptive"
+        else:
+            self.coalesce_window_s = float(coalesce_window_s)
+        self.coalesce_window_max_s = float(coalesce_window_max_s)
         self.name = str(name)
         self._mesh = mesh
         self._drain = drain
@@ -611,6 +629,15 @@ class ServingLoop:
         #: injected slow-replica penalty); the fleet router balances on
         #: this together with queue_depth()
         self._latency_ewma = 0.0
+        # arrival-rate controller state (written under _cond at submit,
+        # read — racily but benignly, they're floats — at dispatch):
+        # inter-arrival gap EWMA, rows-per-request EWMA, last arrival
+        self._ia_ewma = 0.0
+        self._arrival_rows_ewma = 0.0
+        self._last_arrival: Optional[float] = None
+        #: the window the dispatcher chose for the LAST batch (the
+        #: serving.window_s gauge's source)
+        self.last_window_s = 0.0
         #: True while the dispatch thread is inside _execute — an
         #: in-flight batch is load the queue no longer shows, so the
         #: fleet router counts it
@@ -870,6 +897,15 @@ class ServingLoop:
             self._queue.append(req)
             depth = len(self._queue)
             self.n_submitted += 1
+            # arrival-rate tracking for the adaptive coalesce window
+            if self._last_arrival is not None:
+                gap = max(now - self._last_arrival, 1e-06)
+                self._ia_ewma = (gap if self._ia_ewma == 0.0
+                                 else 0.8 * self._ia_ewma + 0.2 * gap)
+            self._arrival_rows_ewma = (
+                float(req.n) if self._arrival_rows_ewma == 0.0
+                else 0.8 * self._arrival_rows_ewma + 0.2 * req.n)
+            self._last_arrival = now
             self._cond.notify()
         if telemetry.enabled():
             telemetry.metrics().gauge("serving.queue_depth").set(depth)
@@ -1011,8 +1047,15 @@ class ServingLoop:
                 rows = self._pull_mates_locked(first.key, batch, first.n)
         finally:
             self._resolve_shed(shed)
-        if self.coalesce_window_s > 0:
-            deadline = first.t_enqueue + self.coalesce_window_s
+        if self.coalesce_window_s == "adaptive":
+            now = time.perf_counter()
+            window = self._adaptive_window(batch, rows, now)
+            deadline = now + window
+        else:
+            window = self.coalesce_window_s
+            deadline = first.t_enqueue + window
+        self.last_window_s = window
+        if window > 0:
             while time.perf_counter() < deadline \
                     and rows < self.max_batch_rows:
                 with self._cond:
@@ -1028,6 +1071,51 @@ class ServingLoop:
                 if not pulled and time.perf_counter() >= deadline:
                     break
         return batch
+
+    #: arrivals older than max(this, 10 inter-arrival EWMAs) read as an
+    #: idle trace — the adaptive window collapses to exact zero
+    IDLE_AFTER_S = 0.005
+
+    def _adaptive_window(self, batch: list, rows: int,
+                         now: float) -> float:
+        """The arrival-rate controller's window for one batch: the
+        predicted time for ``rows`` to grow into their CURRENT pad
+        bucket (capacity the padded program computes whether or not it
+        is used, so filling it is free occupancy), at the submit-side
+        rows/s EWMA. Zero when idle, when the batch is already full or
+        at a bucket boundary, or when the rate says waiting buys
+        nothing within the ``coalesce_window_max_s`` budget; otherwise
+        clamped to that budget and to the batch's tightest deadline
+        slack minus a compute-latency margin."""
+        ia = self._ia_ewma
+        if ia <= 0.0 or rows >= self.max_batch_rows:
+            return 0.0
+        last = self._last_arrival
+        if last is None \
+                or now - last > max(10.0 * ia, self.IDLE_AFTER_S):
+            return 0.0  # idle trace: dispatch immediately
+        bucket = min(self.policy.bucket(rows, align=self._align),
+                     self.max_batch_rows)
+        if rows >= bucket:
+            return 0.0  # at the boundary: more rows would cost a recompile-sized bucket
+        rate = self._arrival_rows_ewma / ia  # rows per second
+        if rate <= 0.0:
+            return 0.0
+        window = (bucket - rows) / rate
+        if window > self.coalesce_window_max_s:
+            # the bucket cannot fill within the budget: wait the budget
+            # only if it still buys at least one more arrival, else the
+            # wait is pure latency — dispatch now
+            if ia > self.coalesce_window_max_s:
+                return 0.0
+            window = self.coalesce_window_max_s
+        slack = min((r.deadline - now for r in batch
+                     if r.deadline is not None), default=None)
+        if slack is not None:
+            # leave room to actually compute the batch before the
+            # tightest deadline sheds it
+            window = min(window, slack - 1.5 * self._latency_ewma)
+        return max(window, 0.0)
 
     def _execute(self, batch: list) -> None:
         from dask_ml_tpu.parallel import telemetry
@@ -1099,6 +1187,9 @@ class ServingLoop:
             reg.counter("serving.requests", model=model_name).inc(len(batch))
             reg.counter("serving.rows", model=model_name).inc(rows)
             reg.gauge("serving.batch_occupancy").set(rows / max(bucket, 1))
+            reg.gauge("serving.window_s").set(self.last_window_s)
+            reg.histogram("serving.occupancy").observe(
+                rows / max(bucket, 1))
             reg.histogram("serving.batch_rows").observe(rows)
             reg.histogram("serving.batch_seconds").observe(dt)
             lat = reg.histogram("serving.request_seconds", model=model_name)

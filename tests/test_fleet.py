@@ -591,9 +591,33 @@ def test_wire_validation_fails_caller_not_connection(wired, fitted):
 
 def _wire_response(sock):
     """Read one typed response frame → (control, arrays)."""
-    payload = framing.read_frame(sock, magic=framing.WIRE_MAGIC)
+    payload = framing.read_frame(sock, magic=framing.WIRE_MAGIC,
+                                 checksum=framing.WIRE_CHECKSUM)
     assert payload is not None
     return framing.decode_payload(payload)
+
+
+def test_wire_huge_control_length_fails_frame_not_connection(wired):
+    """A well-framed payload whose control-length prefix claims > 2 GiB
+    errors ITS frame only — the decode guard fires before any
+    allocation and the same TCP connection keeps serving."""
+    import struct
+
+    fleet, server = wired
+    sock = socket.create_connection(server.address, timeout=10)
+    try:
+        hostile = struct.pack(">I", (1 << 32) - 1) + b"junk"
+        framing.write_frame(sock, hostile, magic=framing.WIRE_MAGIC,
+                            checksum=framing.WIRE_CHECKSUM)
+        msg, _ = _wire_response(sock)
+        assert msg["ok"] is False
+        framing.write_frame(
+            sock, framing.encode_payload({"op": "ping", "id": "p1"}),
+            magic=framing.WIRE_MAGIC, checksum=framing.WIRE_CHECKSUM)
+        msg, _ = _wire_response(sock)
+        assert msg["ok"] is True  # the connection survived
+    finally:
+        sock.close()
 
 
 def test_wire_corrupt_frame_fails_caller_and_closes(wired):
@@ -605,14 +629,16 @@ def test_wire_corrupt_frame_fails_caller_and_closes(wired):
     try:
         good = framing.encode_frame(
             framing.encode_payload({"op": "ping", "id": "x"}),
-            magic=framing.WIRE_MAGIC)
+            magic=framing.WIRE_MAGIC, checksum=framing.WIRE_CHECKSUM)
         bad = bytearray(good)
         bad[-1] ^= 0xFF  # flip a payload byte: checksum fails
         sock.sendall(bytes(bad))
         msg, _ = _wire_response(sock)
         assert msg["ok"] is False
         assert "Corrupt" in msg["error"]
-        assert framing.read_frame(sock, magic=framing.WIRE_MAGIC) is None
+        assert framing.read_frame(
+            sock, magic=framing.WIRE_MAGIC,
+            checksum=framing.WIRE_CHECKSUM) is None
     finally:
         sock.close()
     assert server.n_frame_errors == 1
@@ -740,7 +766,8 @@ def test_wire_fuzz_garbage_bytes(wired, fitted):
                 msg, _ = _wire_response(sock)
                 assert msg["ok"] is False
                 assert framing.read_frame(
-                    sock, magic=framing.WIRE_MAGIC) is None  # closed
+                    sock, magic=framing.WIRE_MAGIC,
+                    checksum=framing.WIRE_CHECKSUM) is None  # closed
             except (ConnectionError, framing.FrameError):
                 pass  # reset mid-response: the connection died, as planned
         finally:
@@ -754,8 +781,9 @@ def test_wire_fuzz_truncated_frames_every_header_offset(wired, fitted):
     fleet, server = wired
     frame = framing.encode_frame(
         framing.encode_payload({"op": "ping", "id": "t"}),
-        magic=framing.WIRE_MAGIC)
-    head = framing.header_length(framing.WIRE_MAGIC)
+        magic=framing.WIRE_MAGIC, checksum=framing.WIRE_CHECKSUM)
+    head = framing.header_length(framing.WIRE_MAGIC,
+                                 checksum=framing.WIRE_CHECKSUM)
     cuts = list(range(1, head + 1)) + [head + 3, len(frame) - 1]
     for cut in cuts:
         sock = socket.create_connection(server.address, timeout=10)
@@ -766,7 +794,8 @@ def test_wire_fuzz_truncated_frames_every_header_offset(wired, fitted):
             # arrived to attribute) and/or a close — never a hang
             sock.settimeout(10)
             try:
-                framing.read_frame(sock, magic=framing.WIRE_MAGIC)
+                framing.read_frame(sock, magic=framing.WIRE_MAGIC,
+                                   checksum=framing.WIRE_CHECKSUM)
             except framing.FrameError:
                 pass
         finally:
@@ -782,8 +811,9 @@ def test_wire_fuzz_oversized_payload_rejected(fitted):
     try:
         sock = socket.create_connection(server.address, timeout=10)
         try:
-            big = framing.encode_frame(b"x" * 4096,
-                                       magic=framing.WIRE_MAGIC)
+            big = framing.encode_frame(
+                b"x" * 4096, magic=framing.WIRE_MAGIC,
+                checksum=framing.WIRE_CHECKSUM)
             sock.sendall(big)
             msg, _ = _wire_response(sock)
             assert msg["ok"] is False
@@ -823,7 +853,8 @@ def test_wire_fuzz_malformed_control_envelopes(wired, fitted):
     sock = socket.create_connection(server.address, timeout=10)
     try:
         for payload in hostile:
-            framing.write_frame(sock, payload, magic=framing.WIRE_MAGIC)
+            framing.write_frame(sock, payload, magic=framing.WIRE_MAGIC,
+                                checksum=framing.WIRE_CHECKSUM)
             msg, _ = _wire_response(sock)
             assert msg["ok"] is False, payload[:40]
         # the SAME connection still serves a well-formed request
@@ -832,7 +863,7 @@ def test_wire_fuzz_malformed_control_envelopes(wired, fitted):
             framing.encode_payload(
                 {"op": "submit", "id": "ok", "model": "kmeans",
                  "method": "predict"}, arrays=(fitted["X"][:4],)),
-            magic=framing.WIRE_MAGIC)
+            magic=framing.WIRE_MAGIC, checksum=framing.WIRE_CHECKSUM)
         msg, arrays = _wire_response(sock)
         assert msg["ok"] is True and msg["id"] == "ok"
         assert np.array_equal(arrays[0],

@@ -228,3 +228,180 @@ def test_checkpoint_uses_shared_codec(tmp_path):
     body = framing.decode_frame(blob, magic=ckpt._SNAPSHOT_MAGIC)
     payload = pickle.loads(body)
     assert payload["meta"] == {"k": "v"} and payload["tree"] == {"a": 1}
+
+
+# ---------------------------------------------------------------------------
+# integrity tiering: crc32c wire frames, sha256 snapshots (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+
+CHECKSUMS = ("sha256", "crc32c")
+
+
+def test_checksum_registry_and_digest_lengths():
+    assert set(framing.CHECKSUMS) == set(CHECKSUMS)
+    assert framing.digest_length("sha256") == 32
+    assert framing.digest_length("crc32c") == 4
+    assert framing.WIRE_CHECKSUM == "crc32c"
+    with pytest.raises(ValueError):
+        framing.digest_length("md5")
+
+
+def test_crc32c_known_answer_both_engines(monkeypatch):
+    """CRC-32C (Castagnoli) of b"123456789" is 0xE3069283 — pinned for
+    the C extension AND the pure-python fallback (a frame written by
+    one engine must verify under the other)."""
+    kat = (0xE3069283).to_bytes(4, "big")
+
+    def digest():
+        h = framing._new_hasher("crc32c")
+        h.update(b"1234")
+        h.update(memoryview(b"56789"))  # chunked + memoryview input
+        return h.digest()
+
+    engines = [digest()]
+    monkeypatch.setattr(framing, "_google_crc32c", None)
+    engines.append(digest())
+    assert engines == [kat, kat]
+
+
+@pytest.mark.parametrize("checksum", CHECKSUMS)
+def test_frame_round_trip_any_checksum(checksum):
+    payload = bytes(range(256)) * 7
+    frame = framing.encode_frame(payload, magic=MAGIC, checksum=checksum)
+    assert framing.decode_frame(frame, magic=MAGIC,
+                                checksum=checksum) == payload
+    assert len(frame) == framing.header_length(
+        MAGIC, checksum=checksum) + len(payload)
+
+
+@pytest.mark.parametrize("checksum", CHECKSUMS)
+def test_frame_bit_flip_sweep_any_checksum(checksum):
+    frame = framing.encode_frame(b"payload-bytes", magic=MAGIC,
+                                 checksum=checksum)
+    for i in range(len(MAGIC) + 8, len(frame)):
+        blob = bytearray(frame)
+        blob[i] ^= 0xFF
+        with pytest.raises(framing.FrameCorruptError):
+            framing.decode_frame(bytes(blob), magic=MAGIC,
+                                 checksum=checksum)
+
+
+def test_frame_checksum_mismatch_is_corruption():
+    frame = framing.encode_frame(b"abc", magic=MAGIC, checksum="crc32c")
+    with pytest.raises(framing.FrameError):
+        framing.decode_frame(frame, magic=MAGIC, checksum="sha256")
+
+
+def test_write_frame_returns_payload_byte_count():
+    buf = io.BytesIO()
+    assert framing.write_frame(buf, b"abcde", magic=MAGIC) == 5
+    import numpy as np
+
+    parts = framing.encode_payload_parts(
+        {"op": "x"}, [np.zeros((3, 4), np.float32)])
+    total = sum(p.nbytes if isinstance(p, memoryview) else len(p)
+                for p in parts)
+    buf2 = io.BytesIO()
+    assert framing.write_frame_parts(buf2, parts, magic=MAGIC) == total
+
+
+def test_parts_encoding_is_byte_identical_to_joined():
+    """encode_payload_parts/write_frame_parts are pure perf: the bytes
+    on the wire are EXACTLY the single-buffer encoding's."""
+    import numpy as np
+
+    control = {"op": "submit", "id": "r9"}
+    arrays = [np.arange(24, dtype=np.float32).reshape(2, 3, 4),
+              np.zeros((0, 5), np.int64)]
+    joined = framing.encode_payload(control, arrays)
+    assert b"".join(framing.encode_payload_parts(control, arrays)) \
+        == joined
+    for checksum in CHECKSUMS:
+        a, b = io.BytesIO(), io.BytesIO()
+        framing.write_frame(a, joined, magic=MAGIC, checksum=checksum)
+        framing.write_frame_parts(
+            b, framing.encode_payload_parts(control, arrays),
+            magic=MAGIC, checksum=checksum)
+        assert a.getvalue() == b.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# payload decode edge cases (ISSUE 20 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_payload_zero_row_arrays_round_trip():
+    import numpy as np
+
+    arrays = [np.zeros((0,), np.float64), np.zeros((0, 7), np.float32),
+              np.zeros((3, 0, 2), np.int32)]
+    ctrl, out = framing.decode_payload(
+        framing.encode_payload({"op": "z"}, arrays))
+    for a, b in zip(arrays, out):
+        assert a.shape == b.shape and a.dtype == b.dtype
+
+
+def test_payload_non_contiguous_inputs_made_contiguous_at_encode():
+    import numpy as np
+
+    base = np.arange(48, dtype=np.float32).reshape(6, 8)
+    hostile = [base.T, base[::2], base[:, 1::3], base[::-1]]
+    ctrl, out = framing.decode_payload(
+        framing.encode_payload({"op": "nc"}, hostile))
+    for a, b in zip(hostile, out):
+        assert np.array_equal(a, b)
+        assert b.flags["C_CONTIGUOUS"]
+
+
+def test_payload_caps_at_exact_boundary():
+    """MAX_ARRAYS buffers and MAX_NDIM dims are ACCEPTED; one more of
+    either is refused (the cap is a boundary, not a fudge factor)."""
+    import numpy as np
+
+    at_cap = [np.zeros(1, np.uint8)] * framing.MAX_ARRAYS
+    ctrl, out = framing.decode_payload(
+        framing.encode_payload({}, at_cap))
+    assert len(out) == framing.MAX_ARRAYS
+    with pytest.raises(framing.PayloadError):
+        framing.decode_payload(framing.encode_payload(
+            {}, [np.zeros(1, np.uint8)] * (framing.MAX_ARRAYS + 1)))
+    deep = np.zeros((1,) * framing.MAX_NDIM, np.float32)
+    ctrl, out = framing.decode_payload(
+        framing.encode_payload({}, [deep]))
+    assert out[0].ndim == framing.MAX_NDIM
+    with pytest.raises(framing.PayloadError):
+        framing.decode_payload(framing.encode_payload(
+            {}, [np.zeros((1,) * (framing.MAX_NDIM + 1), np.float32)]))
+
+
+def test_payload_over_two_gib_control_length_fails_the_frame():
+    """A control-length prefix past 2 GiB is a PayloadError — the FRAME
+    fails, the connection-level codec never sees it (transport survival
+    is pinned in test_fleet.py / test_shm.py on the live wires)."""
+    import struct as _struct
+
+    for hlen in (1 << 31, (1 << 32) - 1, framing.MAX_CONTROL_BYTES + 1):
+        blob = _struct.pack(">I", hlen) + b"{}"
+        with pytest.raises(framing.PayloadError):
+            framing.decode_payload(blob)
+
+
+def test_payload_decode_from_memoryview_is_zero_copy():
+    """bytes in → owned copies; memoryview in → views INTO the buffer
+    (the shm ring's contract)."""
+    import numpy as np
+
+    x = np.arange(64, dtype=np.float32).reshape(8, 8)
+    payload = framing.encode_payload({"op": "v"}, [x])
+    mv = memoryview(bytearray(payload))  # writable backing store
+    ctrl, out = framing.decode_payload(mv)
+    src = np.frombuffer(mv, dtype=np.uint8)
+    lo = src.__array_interface__["data"][0]
+    hi = lo + src.nbytes
+    addr = out[0].__array_interface__["data"][0]
+    assert lo <= addr < hi
+    # and mutating the backing store shows through the view
+    out_before = out[0][0, 0]
+    mv[-x.nbytes] ^= 0xFF
+    assert out[0][0, 0] != out_before

@@ -965,3 +965,116 @@ def test_dispatch_thread_death_fails_everything(fitted):
     with pytest.raises(ServingStopped):
         lp.submit("bomb", X)
     lp.stop()
+
+
+# ---------------------------------------------------------------------------
+# adaptive coalesce window (the arrival-rate controller)
+# ---------------------------------------------------------------------------
+
+
+def _controller_loop(**kw):
+    """An UNSTARTED loop — `_adaptive_window` is a pure function of the
+    controller state set below, so no dispatch thread is needed."""
+    kw.setdefault("max_batch_rows", 256)
+    return ServingLoop(ModelRegistry(), **kw)
+
+
+def _req(n=8, deadline=None):
+    from concurrent.futures import Future
+
+    from dask_ml_tpu.parallel.serving import _Request
+    return _Request(model="m", method="predict",
+                    X=np.zeros((n, 2), np.float32), n=n,
+                    future=Future(), t_enqueue=0.0, deadline=deadline)
+
+
+def test_coalesce_window_validation():
+    with pytest.raises(ValueError, match="adaptive"):
+        ServingLoop(ModelRegistry(), coalesce_window_s="bogus")
+    assert ServingLoop(ModelRegistry()).coalesce_window_s == "adaptive"
+    lp = ServingLoop(ModelRegistry(), coalesce_window_s=0.002)
+    assert lp.coalesce_window_s == 0.002  # floats keep fixed semantics
+
+
+def test_adaptive_window_idle_and_boundary_collapse_to_zero():
+    lp = _controller_loop()
+    now = time.perf_counter()
+    # no arrivals observed yet -> no rate to extrapolate
+    assert lp._adaptive_window([_req()], 8, now) == 0.0
+    # steady trace that then went idle: last arrival >> 10 gap EWMAs
+    lp._ia_ewma = 1e-3
+    lp._arrival_rows_ewma = 32.0
+    lp._last_arrival = now - 1.0
+    assert lp._adaptive_window([_req()], 8, now) == 0.0
+    # batch already at its pad-bucket boundary: one more row would jump
+    # a recompile-sized bucket, waiting buys nothing free
+    lp._last_arrival = now
+    assert lp._adaptive_window([_req(32)], 32, now) == 0.0
+    # batch at the row cap
+    assert lp._adaptive_window([_req()], lp.max_batch_rows, now) == 0.0
+
+
+def test_adaptive_window_predicts_bucket_fill_time():
+    lp = _controller_loop()
+    now = time.perf_counter()
+    lp._ia_ewma = 1e-3                 # 1k requests/s
+    lp._arrival_rows_ewma = 32.0       # -> 32k rows/s
+    lp._last_arrival = now
+    # 33 rows pad to the 64 bucket: 31 free rows / 32k rows/s
+    w = lp._adaptive_window([_req(33)], 33, now)
+    assert w == pytest.approx(31.0 / 32000.0)
+    assert 0.0 < w < lp.coalesce_window_max_s
+
+
+def test_adaptive_window_budget_rules():
+    lp = _controller_loop(coalesce_window_max_s=0.005)
+    now = time.perf_counter()
+    lp._arrival_rows_ewma = 1.0
+    lp._last_arrival = now
+    # fill time exceeds the budget but arrivals land within it: clamp
+    lp._ia_ewma = 4e-3                 # 250 rows/s -> fill takes ~0.1s
+    assert lp._adaptive_window([_req(33)], 33, now) == 0.005
+    # fill time exceeds the budget AND the next arrival is past it too:
+    # the wait is pure latency, dispatch now
+    lp._ia_ewma = 6e-3
+    lp._last_arrival = now             # not idle (gap < 10 EWMAs)
+    assert lp._adaptive_window([_req(33)], 33, now) == 0.0
+
+
+def test_adaptive_window_respects_deadline_slack():
+    lp = _controller_loop()
+    now = time.perf_counter()
+    lp._ia_ewma = 1e-2                 # slow fill: unclamped window = max
+    lp._arrival_rows_ewma = 1.0
+    lp._last_arrival = now
+    lp._latency_ewma = 0.001
+    open_w = lp._adaptive_window([_req(33)], 33, now)
+    assert open_w == lp.coalesce_window_max_s
+    # a tight deadline caps the window at slack - 1.5 * latency EWMA
+    tight = lp._adaptive_window([_req(33, deadline=now + 0.004)], 33, now)
+    assert tight == pytest.approx(0.004 - 1.5 * 0.001, abs=1e-4)
+    # a deadline already inside the compute margin forbids waiting
+    assert lp._adaptive_window(
+        [_req(33, deadline=now + 0.001)], 33, now) == 0.0
+
+
+def test_adaptive_serving_bit_identical_and_gauged(fitted):
+    """End to end under the adaptive default: concurrent ragged submits
+    coalesce, results stay bit-identical to direct predict, and the
+    serving.window_s gauge + serving.occupancy histogram mirror."""
+    reg = ModelRegistry()
+    reg.register("kmeans", fitted["kmeans"])
+    telemetry.reset_telemetry()
+    with config.config_context(telemetry=True):
+        with ServingLoop(reg, max_batch_rows=256) as lp:
+            Xs = [_data(n, 8, seed=n) for n in (5, 33, 64, 1)]
+            futs = [lp.submit("kmeans", X) for X in Xs]
+            outs = [f.result(60) for f in futs]
+        for X, out in zip(Xs, outs):
+            np.testing.assert_array_equal(
+                out, fitted["kmeans"].predict(X))
+        snap = telemetry.metrics().snapshot()
+        assert "serving.window_s" in snap["gauges"]
+        occ = snap["histograms"]["serving.occupancy"]
+        assert occ["count"] >= 1
+        assert 0.0 < occ["max"] <= 1.0

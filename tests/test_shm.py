@@ -1,0 +1,440 @@
+"""The shared-memory ring transport (parallel/shm.py) and its
+negotiation into the fleet wire (docs/serving.md, "The wire").
+
+The load-bearing pins: decode on the receiving side is ZERO-COPY —
+array views point INTO the segment (buffer-address identity, the
+tentpole's perf claim); the SPSC ring survives wraparound at every
+offset; a corrupt record kills the connection exactly like a torn TCP
+frame while a payload-level decode failure kills only that frame; the
+creator's close unlinks and nothing leaks into ``/dev/shm``; and a
+client negotiating against an shm-disabled (or remote) server falls
+back to TCP transparently.
+"""
+
+import os
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu.parallel import framing
+from dask_ml_tpu.parallel import shm as shm_lib
+from dask_ml_tpu.parallel.shm import ShmClient, ShmServer
+
+CHECKSUMS = ("sha256", "crc32c")
+
+
+def _pair(ring_bytes=1 << 16, checksum="crc32c"):
+    cli = ShmClient(ring_bytes=ring_bytes, checksum=checksum)
+    srv = ShmServer(cli.segment, ring_bytes=cli.ring_bytes,
+                    checksum=checksum)
+    return cli, srv
+
+
+def _close(cli, srv):
+    srv.close()
+    cli.close(unlink=True)
+
+
+def _buffer_range(ep):
+    base = np.frombuffer(ep._shm.buf, dtype=np.uint8)
+    addr = base.__array_interface__["data"][0]
+    return addr, addr + base.nbytes
+
+
+# ---------------------------------------------------------------------------
+# round trips
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("checksum", CHECKSUMS)
+def test_round_trip_both_directions_and_checksums(checksum):
+    cli, srv = _pair(checksum=checksum)
+    try:
+        rng = np.random.RandomState(0)
+        for n in (0, 1, 7, 64, 500):
+            x = rng.randn(n, 5).astype(np.float32)
+            cli.send({"op": "submit", "id": f"r{n}"}, [x])
+            ctrl, arrays, tok = srv.recv(timeout=5.0)
+            assert ctrl == {"op": "submit", "id": f"r{n}"}
+            assert np.array_equal(arrays[0], x)
+            srv.send({"op": "result", "id": ctrl["id"]},
+                     [np.asarray(arrays[0])])
+            srv.release(tok)
+            ctrl2, arrays2, tok2 = cli.recv(timeout=5.0)
+            assert ctrl2["id"] == f"r{n}"
+            assert np.array_equal(arrays2[0], x)
+            cli.release(tok2)
+            del arrays, arrays2  # drop the zero-copy views pre-close
+    finally:
+        _close(cli, srv)
+
+
+def test_decode_is_zero_copy_into_the_segment():
+    """The tentpole pin: a received array's memory IS ring memory."""
+    cli, srv = _pair()
+    try:
+        x = np.arange(1024, dtype=np.float64).reshape(32, 32)
+        cli.send({"op": "submit", "id": "z"}, [x])
+        ctrl, arrays, tok = srv.recv(timeout=5.0)
+        lo, hi = _buffer_range(srv)
+        addr = arrays[0].__array_interface__["data"][0]
+        assert lo <= addr < hi  # view into the shared segment
+        assert addr + arrays[0].nbytes <= hi
+        # and a plain copy is NOT in the segment (control case)
+        copy_addr = np.array(arrays[0]).__array_interface__["data"][0]
+        assert not (lo <= copy_addr < hi)
+        del arrays
+        srv.release(tok)
+    finally:
+        _close(cli, srv)
+
+
+def test_ring_wraparound_at_every_offset():
+    """Varying record sizes march the write cursor across the ring
+    boundary at many distinct offsets; every message round-trips."""
+    cli, srv = _pair(ring_bytes=1 << 16)
+    try:
+        rng = np.random.RandomState(7)
+        wrapped_offsets = set()
+        for i in range(1200):
+            n = int(rng.randint(0, 1600))
+            x = rng.randint(0, 255, size=n).astype(np.uint8)
+            before = cli._writer._wpos % cli._writer._cap
+            cli.send({"i": i}, [x])
+            after = cli._writer._wpos % cli._writer._cap
+            if after < before:
+                wrapped_offsets.add(before)
+            ctrl, arrays, tok = srv.recv(timeout=5.0)
+            assert ctrl["i"] == i
+            assert np.array_equal(arrays[0], x)
+            srv.release(tok)
+            del arrays
+        assert len(wrapped_offsets) > 8  # genuinely exercised the seam
+    finally:
+        _close(cli, srv)
+
+
+def test_out_of_order_release_parks_then_sweeps():
+    cli, srv = _pair(ring_bytes=1 << 16)
+    try:
+        for i in range(3):
+            cli.send({"i": i}, [np.zeros(100, np.float32)])
+        recs = [srv.recv(timeout=5.0) for _ in range(3)]
+        # release the tail first: the cursor must NOT advance past the
+        # held head
+        srv.release(recs[2][2])
+        srv.release(recs[0][2])
+        srv.release(recs[1][2])
+        # the whole ring is reclaimable again: a near-cap burst fits
+        big = np.zeros(cli._writer.max_message_bytes() - 4096, np.uint8)
+        cli.send({"op": "big"}, [big], timeout=2.0)
+        ctrl, arrays, tok = srv.recv(timeout=5.0)
+        assert arrays[0].nbytes == big.nbytes
+        srv.release(tok)
+        del recs, arrays
+    finally:
+        _close(cli, srv)
+
+
+def test_double_release_is_idempotent():
+    cli, srv = _pair()
+    try:
+        cli.send({"op": "x"}, [np.zeros(8, np.float32)])
+        _, _, tok = srv.recv(timeout=5.0)
+        srv.release(tok)
+        srv.release(tok)  # no-op, no corruption
+        cli.send({"op": "y"}, ())
+        ctrl, _, tok2 = srv.recv(timeout=5.0)
+        assert ctrl == {"op": "y"}
+        srv.release(tok2)
+    finally:
+        _close(cli, srv)
+
+
+# ---------------------------------------------------------------------------
+# failure semantics: frame-level vs connection-level
+# ---------------------------------------------------------------------------
+
+
+def test_oversized_record_fails_the_frame_not_the_connection():
+    cli, srv = _pair(ring_bytes=1 << 16)
+    try:
+        too_big = np.zeros((1 << 15), np.uint8)  # > cap // 2 with headers
+        with pytest.raises(framing.PayloadError):
+            cli.send({"op": "submit"}, [too_big])
+        cli.send({"op": "after"}, ())  # the link survived
+        ctrl, _, tok = srv.recv(timeout=5.0)
+        assert ctrl == {"op": "after"}
+        srv.release(tok)
+    finally:
+        _close(cli, srv)
+
+
+def test_ring_full_times_out_as_connection_error():
+    cli, srv = _pair(ring_bytes=1 << 16)
+    try:
+        payload = np.zeros(12000, np.uint8)
+        with pytest.raises(ConnectionError, match="full"):
+            for _ in range(100):  # nobody consumes
+                cli.send({"op": "fill"}, [payload], timeout=0.2)
+    finally:
+        _close(cli, srv)
+
+
+def test_bad_payload_releases_record_and_link_survives():
+    """A record that frames correctly but fails the TYPED decode raises
+    PayloadError with the record already released — the peer's next
+    message still flows (frame-fails-the-caller, same as TCP)."""
+    cli, srv = _pair()
+    try:
+        hostile = (struct.pack(">I", 3) + b"{]x",        # not JSON
+                   struct.pack(">I", (1 << 32) - 1) + b"!")  # > 2 GiB claim
+        for bad in hostile:
+            cli._writer.write([bad], timeout=1.0, dead=cli._dead)
+            with pytest.raises(framing.PayloadError):
+                srv.recv(timeout=5.0)
+        cli.send({"op": "good"}, ())
+        ctrl, _, tok = srv.recv(timeout=5.0)
+        assert ctrl == {"op": "good"}
+        srv.release(tok)
+    finally:
+        _close(cli, srv)
+
+
+def test_fuzz_torn_status_kills_the_connection():
+    cli, srv = _pair()
+    try:
+        cli.send({"op": "x"}, [np.zeros(64, np.float32)])
+        # tear the record's status word to garbage before the peer reads
+        struct.pack_into(">I", cli._shm.buf, srv._reader._data, 0xDEAD)
+        with pytest.raises(framing.FrameCorruptError, match="status"):
+            srv.recv(timeout=1.0)
+    finally:
+        _close(cli, srv)
+
+
+def test_fuzz_torn_length_kills_the_connection():
+    cli, srv = _pair()
+    try:
+        cli.send({"op": "x"}, [np.zeros(64, np.float32)])
+        struct.pack_into(">I", cli._shm.buf, srv._reader._data + 4,
+                         0x7FFFFFFF)  # length overruns the ring
+        with pytest.raises(framing.FrameCorruptError, match="torn"):
+            srv.recv(timeout=1.0)
+    finally:
+        _close(cli, srv)
+
+
+@pytest.mark.parametrize("checksum", CHECKSUMS)
+def test_fuzz_payload_bit_flip_fails_digest(checksum):
+    cli, srv = _pair(checksum=checksum)
+    try:
+        cli.send({"op": "x"}, [np.zeros(64, np.float32)])
+        dlen = framing.digest_length(checksum)
+        off = srv._reader._data + 8 + dlen + 10  # a payload byte
+        cli._shm.buf[off] ^= 0xFF
+        with pytest.raises(framing.FrameCorruptError, match="checksum"):
+            srv.recv(timeout=1.0)
+    finally:
+        _close(cli, srv)
+
+
+def test_send_after_close_raises_connection_error():
+    cli, srv = _pair()
+    _close(cli, srv)
+    with pytest.raises(ConnectionError):
+        cli.send({"op": "x"}, ())
+    with pytest.raises(ConnectionError):
+        srv.recv(timeout=0.01)
+
+
+# ---------------------------------------------------------------------------
+# attach validation (the hostile-hello surface)
+# ---------------------------------------------------------------------------
+
+
+def test_attach_requires_the_segment_prefix():
+    with pytest.raises(framing.PayloadError, match="prefix"):
+        ShmServer("psm_someoneelse")
+
+
+def test_attach_to_missing_segment_is_file_not_found():
+    with pytest.raises(FileNotFoundError):
+        ShmServer(shm_lib.SEGMENT_PREFIX + "0" * 16)
+
+
+def test_attach_rejects_foreign_magic_and_closes_mapping():
+    cli = ShmClient(ring_bytes=1 << 16)
+    try:
+        cli._shm.buf[0:8] = b"NOTMAGIC"
+        with pytest.raises(framing.FrameCorruptError, match="magic"):
+            ShmServer(cli.segment)
+    finally:
+        cli._shm.buf[0:8] = shm_lib.SEGMENT_MAGIC
+        cli.close(unlink=True)
+    assert cli.segment.lstrip("/") not in shm_lib.list_segments()
+
+
+def test_attach_rejects_version_checksum_and_size_mismatch():
+    cli = ShmClient(ring_bytes=1 << 16, checksum="crc32c")
+    try:
+        with pytest.raises(framing.FrameCorruptError, match="ring_bytes"):
+            ShmServer(cli.segment, ring_bytes=cli.ring_bytes * 2)
+        with pytest.raises(framing.FrameCorruptError, match="checksum"):
+            ShmServer(cli.segment, checksum="sha256")
+        struct.pack_into(">I", cli._shm.buf, 8, 99)  # version
+        with pytest.raises(framing.FrameCorruptError, match="version"):
+            ShmServer(cli.segment)
+        struct.pack_into(">I", cli._shm.buf, 8, shm_lib.SEGMENT_VERSION)
+        struct.pack_into(">I", cli._shm.buf, 12, 77)  # checksum code
+        with pytest.raises(framing.FrameCorruptError, match="unknown"):
+            ShmServer(cli.segment)
+        struct.pack_into(
+            ">I", cli._shm.buf, 12,
+            shm_lib._CHECKSUM_CODES[cli.checksum])
+        struct.pack_into(">Q", cli._shm.buf, 16, 1 << 40)  # cap vs size
+        with pytest.raises(framing.FrameCorruptError, match="describes"):
+            ShmServer(cli.segment)
+    finally:
+        struct.pack_into(">Q", cli._shm.buf, 16, cli.ring_bytes)
+        cli.close(unlink=True)
+
+
+def test_close_unlinks_and_nothing_leaks():
+    before = set(shm_lib.list_segments())
+    cli, srv = _pair()
+    name = cli.segment.lstrip("/")
+    assert name in shm_lib.list_segments()
+    _close(cli, srv)
+    assert name not in shm_lib.list_segments()
+    assert set(shm_lib.list_segments()) <= before
+
+
+def test_pure_python_crc32c_round_trips(monkeypatch):
+    """With the C engine gone the pure-python CRC32C table produces the
+    same digests — a segment written by one engine reads by the other."""
+    cli, srv = _pair(checksum="crc32c")
+    try:
+        x = np.arange(256, dtype=np.int32)
+        cli.send({"op": "mixed"}, [x])
+        monkeypatch.setattr(framing, "_google_crc32c", None)
+        ctrl, arrays, tok = srv.recv(timeout=5.0)  # pure verifies C's digest
+        assert np.array_equal(arrays[0], x)
+        srv.send({"op": "back"}, [np.asarray(arrays[0])])  # pure writes
+        srv.release(tok)
+        monkeypatch.undo()
+        ctrl2, arrays2, tok2 = cli.recv(timeout=5.0)  # C verifies pure's
+        assert np.array_equal(arrays2[0], x)
+        cli.release(tok2)
+        del arrays, arrays2
+    finally:
+        _close(cli, srv)
+
+
+# ---------------------------------------------------------------------------
+# negotiation into the fleet wire
+# ---------------------------------------------------------------------------
+
+
+def _echo_registry():
+    from dask_ml_tpu.parallel.serving import ModelRegistry
+
+    class _Echo:
+        n_features_in_ = 4
+
+        def predict(self, X):
+            return np.asarray(X)
+
+    reg = ModelRegistry()
+    reg.register("echo", _Echo())
+    return reg
+
+
+def _loop():
+    from dask_ml_tpu.parallel.serving import ServingLoop
+
+    lp = ServingLoop(_echo_registry(), max_batch_rows=256,
+                     coalesce_window_s=0.0)
+    lp.start()
+    return lp
+
+
+def test_fleet_negotiates_shm_and_round_trips():
+    from dask_ml_tpu.parallel.fleet import FleetClient, FleetServer
+
+    lp = _loop()
+    server = FleetServer(lp).start()
+    try:
+        with FleetClient(server.address) as cli:
+            assert cli._shm is not None
+            assert cli.n_shm_connects == 1
+            assert server.n_shm_conns == 1
+            x = np.random.RandomState(0).randn(33, 4).astype(np.float32)
+            out = cli.call("echo", x, timeout=30)
+            assert np.array_equal(out, x)
+            assert cli.ping()
+            assert server.n_frame_errors == 0
+        time.sleep(0.1)
+        assert not shm_lib.list_segments()  # client close unlinked it
+    finally:
+        server.stop()
+        lp.stop()
+
+
+def test_fleet_falls_back_to_tcp_when_server_disables_shm():
+    from dask_ml_tpu.parallel.fleet import FleetClient, FleetServer
+
+    lp = _loop()
+    server = FleetServer(lp, shm=False).start()
+    try:
+        with FleetClient(server.address) as cli:
+            assert cli._shm is None
+            assert server.n_shm_conns == 0
+            x = np.random.RandomState(0).randn(5, 4).astype(np.float32)
+            assert np.array_equal(cli.call("echo", x, timeout=30), x)
+        assert not shm_lib.list_segments()  # offer was unlinked on refusal
+    finally:
+        server.stop()
+        lp.stop()
+
+
+def test_fleet_client_can_opt_out_of_shm():
+    from dask_ml_tpu.parallel.fleet import FleetClient, FleetServer
+
+    lp = _loop()
+    server = FleetServer(lp).start()
+    try:
+        with FleetClient(server.address, shm=False) as cli:
+            assert cli._shm is None
+            x = np.ones((3, 4), np.float32)
+            assert np.array_equal(cli.call("echo", x, timeout=30), x)
+    finally:
+        server.stop()
+        lp.stop()
+
+
+def test_fleet_shm_responses_are_copied_out_of_the_ring():
+    """The client-side copy discipline: results stay valid after the
+    ring record is recycled by later traffic."""
+    from dask_ml_tpu.parallel.fleet import FleetClient, FleetServer
+
+    lp = _loop()
+    server = FleetServer(lp).start()
+    try:
+        with FleetClient(server.address,
+                         shm_ring_bytes=1 << 16) as cli:
+            assert cli._shm is not None
+            rng = np.random.RandomState(3)
+            xs = [rng.randn(40, 4).astype(np.float32) for _ in range(40)]
+            outs = [cli.call("echo", x, timeout=30) for x in xs]
+            for x, out in zip(xs, outs):
+                assert np.array_equal(out, x)  # survived ring reuse
+            lo, hi = _buffer_range(cli._shm)
+            addr = outs[-1].__array_interface__["data"][0]
+            assert not (lo <= addr < hi)  # NOT a view into the segment
+    finally:
+        server.stop()
+        lp.stop()
